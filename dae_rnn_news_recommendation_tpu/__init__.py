@@ -1,0 +1,24 @@
+"""dae_rnn_news_recommendation_tpu — a TPU-native (JAX/XLA/pjit/Pallas) framework with the
+capabilities of louislung/DAE_RNN_News_Recommendation.
+
+Built from scratch, TPU-first: functional JAX core with on-device corruption and triplet
+mining inside the jit-compiled train step, pjit/shard_map data parallelism over a device
+mesh with psum gradient reduction, dense padded shards fed from host-side sparse
+matrices, optax optimizers and orbax-style checkpointing, plus native C++ runtime
+components (StarSpace-style baseline trainer, fast CSR batcher).
+
+Reference capability map (see SURVEY.md):
+  ops/       — corruption, reconstruction losses, triplet mining (triplet_loss_utils.py, utils.py twins)
+  models/    — DAE core + sklearn-style estimators (autoencoder.py, autoencoder_triplet.py twins),
+               stacked DAE pretrain, GRU user-state RNN (the paper's unimplemented half)
+  train/     — jitted train-step factory, optax optimizer zoo, epoch driver
+  parallel/  — mesh construction, data/tensor sharding, global-batch mining collectives
+  data/      — article pipeline, padded batcher, save/read IO (datasets/articles.py, helpers.py twins)
+  eval/      — pairwise similarity, AUROC plots (helpers.py twin)
+  utils/     — config/flags + .env override, provenance, metrics, checkpointing
+  cli/       — main_autoencoder / main_autoencoder_triplet drivers
+"""
+
+__version__ = "0.1.0"
+
+from . import ops  # noqa: F401

@@ -1,0 +1,24 @@
+"""Numerical ops: initializers, corruption, reconstruction losses, triplet mining.
+
+These are the JAX twins of the reference's L2 layer (autoencoder/utils.py and
+autoencoder/triplet_loss_utils.py) — pure functions designed to live *inside* a
+jit-compiled train step (explicit PRNG keys, static shapes, padding-mask aware).
+"""
+
+from .initializers import xavier_init  # noqa: F401
+from .corruption import (  # noqa: F401
+    masking_noise,
+    salt_and_pepper_noise,
+    decay_noise,
+    corrupt,
+    masking_noise_sparse_host,
+)
+from .losses import reconstruction_loss_per_row, weighted_loss, LOSS_FUNCS  # noqa: F401
+from .triplet import (  # noqa: F401
+    anchor_positive_mask,
+    anchor_negative_mask,
+    triplet_mask,
+    batch_all_triplet_loss,
+    batch_hard_triplet_loss,
+    precomputed_triplet_loss,
+)

@@ -1,0 +1,105 @@
+"""Input-corruption transforms for denoising training.
+
+Twins of reference autoencoder/utils.py:94-159 (masking_noise, salt_and_pepper_noise,
+decay_noise) — redesigned TPU-first: pure `f(key, x, ...)` functions with static shapes
+so they run *inside* the jit-compiled train step on device (the reference corrupts the
+whole train set per epoch on host NumPy, autoencoder/autoencoder.py:218).
+
+Distributional semantics are preserved:
+  - masking: each element independently zeroed with prob v (reference draws a 0/1 mask
+    with p=[v, 1-v], utils.py:108).
+  - salt_and_pepper: per row, `n_corrupt` feature indices drawn uniformly *with
+    replacement* (reference `np.random.randint(0, n_features, v)`, utils.py:135) are set
+    to the data min or max by a fair coin flip. `n_corrupt` is the reference's
+    `corruption_ratio = round(corr_frac * n_features)` (autoencoder.py:187). The
+    reference's O(rows*v) lil_matrix Python loop (SURVEY §2.3.9) becomes one vectorized
+    scatter.
+  - decay: multiply by (1 - v) — deterministic, no key needed.
+
+A host-side sparse masking variant is kept for scipy.sparse inputs that never reach the
+device (reference utils.py:111-114 nnz-drop semantics).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def masking_noise(key, x, v):
+    """Zero a fraction v of the elements of x, each chosen independently.
+
+    :param key: jax PRNG key
+    :param x: [B, F] array
+    :param v: corruption fraction in [0, 1] (python float or scalar)
+    """
+    if not 0.0 <= float(v) <= 1.0:
+        raise ValueError(f"corruption fraction must be in [0, 1], got {v}")
+    keep = jax.random.bernoulli(key, p=1.0 - v, shape=x.shape)
+    return jnp.where(keep, x, jnp.zeros_like(x))
+
+
+def salt_and_pepper_noise(key, x, n_corrupt, mn=None, mx=None):
+    """Set `n_corrupt` random positions per row to the min or max value (fair coin).
+
+    :param key: jax PRNG key
+    :param x: [B, F] array
+    :param n_corrupt: static int — number of (with-replacement) positions per row
+    :param mn, mx: corruption extremes. Default: min/max of this batch. Pass the global
+        train-set min/max to reproduce the reference's whole-matrix semantics
+        (utils.py:131-132).
+    """
+    if n_corrupt <= 0:
+        return x
+    if mn is None:
+        mn = jnp.min(x)
+    if mx is None:
+        mx = jnp.max(x)
+    b, f = x.shape
+    k_idx, k_coin = jax.random.split(key)
+    cols = jax.random.randint(k_idx, (b, n_corrupt), 0, f)
+    coin = jax.random.bernoulli(k_coin, p=0.5, shape=(b, n_corrupt))
+    vals = jnp.where(coin, mx, mn).astype(x.dtype)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, n_corrupt))
+    return x.at[rows, cols].set(vals)
+
+
+def decay_noise(x, v):
+    """Decay all elements by fraction v (reference utils.py:147-159)."""
+    return x * (1.0 - v)
+
+
+def corrupt(key, x, corr_type, corr_frac, n_features=None, mn=None, mx=None):
+    """Dispatch on corruption type (reference autoencoder.py:248-270 _corrupt_input).
+
+    `corr_type` must be a static python string (selects the traced graph) and
+    `corr_frac` a static python float in [0, 1] (reference main_autoencoder.py:100).
+    """
+    if corr_type != "none" and not 0.0 <= float(corr_frac) <= 1.0:
+        raise ValueError(f"corr_frac must be in [0, 1], got {corr_frac}")
+    if corr_type == "masking":
+        return masking_noise(key, x, corr_frac)
+    if corr_type == "salt_and_pepper":
+        f = n_features if n_features is not None else x.shape[1]
+        n_corrupt = int(np.round(corr_frac * f))
+        return salt_and_pepper_noise(key, x, n_corrupt, mn=mn, mx=mx)
+    if corr_type == "decay":
+        return decay_noise(x, corr_frac)
+    if corr_type == "none":
+        return x
+    raise ValueError(f"unknown corr_type: {corr_type!r}")
+
+
+def masking_noise_sparse_host(rng, x_sparse, v):
+    """Host-side masking for scipy sparse matrices: drop each stored nnz with prob v.
+
+    Reference semantics utils.py:111-114 (an approximation of element-wise masking:
+    zeros never flip, only stored entries are dropped).
+
+    :param rng: numpy Generator or RandomState
+    :param x_sparse: scipy.sparse matrix
+    :param v: drop fraction
+    """
+    coo = x_sparse.tocoo(copy=True)
+    keep = rng.random(coo.nnz) >= v
+    coo.row, coo.col, coo.data = coo.row[keep], coo.col[keep], coo.data[keep]
+    return coo.tocsr()

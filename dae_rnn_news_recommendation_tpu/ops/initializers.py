@@ -1,0 +1,23 @@
+"""Weight initializers.
+
+Twin of reference autoencoder/utils.py:16-26 (xavier_init): uniform on
+[-c*sqrt(6/(fan_in+fan_out)), +c*sqrt(6/(fan_in+fan_out))] — but as a pure JAX
+function taking an explicit PRNG key instead of mutating global RNG state.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def xavier_init(key, fan_in, fan_out, const=1.0, dtype=jnp.float32):
+    """Xavier-uniform weight init.
+
+    :param key: jax PRNG key
+    :param fan_in: input feature count (n_features)
+    :param fan_out: output feature count (n_components)
+    :param const: multiplicative constant on the bound
+    """
+    bound = const * jnp.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(
+        key, (fan_in, fan_out), minval=-bound, maxval=bound, dtype=dtype
+    )

@@ -1,0 +1,57 @@
+"""Reconstruction losses and triplet-participation re-weighting.
+
+Twin of reference autoencoder/triplet_loss_utils.py:262-277 (weighted_loss): per-row
+reconstruction loss (cross-entropy / mean-squared / cosine-proximity) re-weighted by a
+per-row weight (triplet participation count under online mining; ones otherwise), with
+the reference's exact epsilons and normalization:
+
+    loss = sum_r(per_row_loss[r] * w[r]) / (sum_r w[r] + 1e-16)
+
+TPU notes: rows are dense [B, F] tiles (sparse inputs are densified into padded shards
+on host — TPUs want dense MXU tiles, not scatter/gather); a `row_valid` mask makes
+padded rows contribute exactly zero to both numerator and denominator, so padded batches
+keep XLA shapes static without changing the math.
+"""
+
+import jax.numpy as jnp
+
+LOSS_FUNCS = ("cross_entropy", "mean_squared", "cosine_proximity")
+
+_EPS = 1e-16
+
+
+def _l2_normalize(x, axis=-1, eps=1e-12):
+    # matches tf.nn.l2_normalize: x * rsqrt(max(sum(x^2), eps))
+    sq = jnp.sum(jnp.square(x), axis=axis, keepdims=True)
+    return x * jnp.reciprocal(jnp.sqrt(jnp.maximum(sq, eps)))
+
+
+def reconstruction_loss_per_row(x, decode, loss_func="cross_entropy"):
+    """Per-row reconstruction loss [B] (reference triplet_loss_utils.py:268-273)."""
+    if loss_func == "cross_entropy":
+        return -jnp.sum(
+            x * jnp.log(decode + _EPS) + (1.0 - x) * jnp.log(1.0 - decode + _EPS),
+            axis=1,
+        )
+    if loss_func == "mean_squared":
+        return jnp.sum(jnp.square(x - decode), axis=1)
+    if loss_func == "cosine_proximity":
+        return -jnp.sum(_l2_normalize(x, 1) * _l2_normalize(decode, 1), axis=1)
+    raise ValueError(f"unknown loss_func: {loss_func!r}")
+
+
+def weighted_loss(x, decode, loss_func="cross_entropy", weight=None, row_valid=None):
+    """Weighted mean reconstruction loss (reference triplet_loss_utils.py:262-277).
+
+    :param x: clean input [B, F]
+    :param decode: reconstruction [B, F]
+    :param weight: per-row weight [B]; defaults to ones (reference :266)
+    :param row_valid: optional [B] float/bool mask; padded rows are excluded from both
+        numerator and denominator (net-new — the reference has no padding).
+    """
+    per_row = reconstruction_loss_per_row(x, decode, loss_func)
+    if weight is None:
+        weight = jnp.ones(x.shape[0], dtype=per_row.dtype)
+    if row_valid is not None:
+        weight = weight * row_valid.astype(per_row.dtype)
+    return jnp.sum(per_row * weight) / (jnp.sum(weight) + _EPS)

@@ -1,0 +1,186 @@
+"""Online triplet mining over dot-product similarity, plus the precomputed-triplet loss.
+
+Twin of reference autoencoder/triplet_loss_utils.py — same semantics, rebuilt for XLA:
+
+  - mining runs on the *encoded* batch [B, D] (D = n_components, small), so the B^2
+    dot-product matrix and the batch_all B^3 mask tensor live comfortably in HBM for
+    typical B; for data-parallel global mining the [B, D] embeddings are all_gathered
+    over the mesh (cheap on ICI) before mining — see parallel/.
+  - similarity is the raw dot product (NOT euclidean distance): a triplet's "distance"
+    is  d(a,p,n) = -dot(a,p) + dot(a,n)  and the loss is softplus(d) = -log_sigmoid(-d)
+    (reference :106, :126, :256).
+  - all epsilons (1e-16) and normalizations match the reference exactly so the NumPy
+    oracle tests (tests/test_triplet.py, modeled on the reference's
+    autoencoder/tests/test_triplet_loss_utils.py) agree to float tolerance.
+  - every function takes an optional `row_valid` mask so padded batches (XLA static
+    shapes) mine zero triplets from padding without changing the unpadded math. This is
+    net-new vs the reference, which fed ragged final batches.
+
+Returns follow the reference tuple: (loss, data_weight, fraction_positive, num_triplets)
+(batch_all :131, batch_hard :259). `data_weight` counts each row's participation as
+anchor + positive + negative and re-weights the reconstruction loss (the repo's main
+novelty, SURVEY.md capability 5). `extras` carries the hardest pos/neg dot products the
+reference exports as TF summaries (:232, :244).
+"""
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-16
+
+
+def _as_valid(labels, row_valid):
+    if row_valid is None:
+        return jnp.ones(labels.shape[0], dtype=bool)
+    return row_valid.astype(bool)
+
+
+def anchor_positive_mask(labels, row_valid=None):
+    """mask[a,p] True iff a != p, labels equal, both rows valid (reference :6-26)."""
+    valid = _as_valid(labels, row_valid)
+    b = labels.shape[0]
+    not_eye = ~jnp.eye(b, dtype=bool)
+    label_eq = labels[None, :] == labels[:, None]
+    return not_eye & label_eq & valid[:, None] & valid[None, :]
+
+
+def anchor_negative_mask(labels, row_valid=None):
+    """mask[a,n] True iff labels differ, both rows valid (reference :29-44)."""
+    valid = _as_valid(labels, row_valid)
+    label_eq = labels[None, :] == labels[:, None]
+    return (~label_eq) & valid[:, None] & valid[None, :]
+
+
+def triplet_mask(labels, row_valid=None):
+    """mask[a,p,n] True iff a,p,n distinct, label[a]==label[p]!=label[n], all valid
+    (reference :47-76)."""
+    valid = _as_valid(labels, row_valid)
+    b = labels.shape[0]
+    not_eye = ~jnp.eye(b, dtype=bool)
+    i_ne_j = not_eye[:, :, None]
+    i_ne_k = not_eye[:, None, :]
+    j_ne_k = not_eye[None, :, :]
+    distinct = i_ne_j & i_ne_k & j_ne_k
+
+    label_eq = labels[None, :] == labels[:, None]
+    i_eq_j = label_eq[:, :, None]
+    i_eq_k = label_eq[:, None, :]
+    valid_labels = i_eq_j & (~i_eq_k)
+
+    all_valid = valid[:, None, None] & valid[None, :, None] & valid[None, None, :]
+    return distinct & valid_labels & all_valid
+
+
+def batch_all_triplet_loss(labels, encode, pos_triplets_only=False, row_valid=None):
+    """Mine ALL valid triplets in the batch; average softplus loss over them.
+
+    Twin of reference triplet_loss_utils.py:79-131.
+
+    :param labels: [B] int labels
+    :param encode: [B, D] embeddings
+    :param pos_triplets_only: average over positive-loss triplets only (reference :118)
+    :return: (loss, data_weight[B], fraction_positive, num_positive, extras_dict)
+    """
+    dtype = encode.dtype
+    # dot-product similarity; keep full precision — mining decisions and the 1e-4
+    # loss-parity target are sensitive to bf16 rounding on TPU.
+    dp = jnp.matmul(encode, encode.T, precision=jax.lax.Precision.HIGHEST)
+
+    # d[i,j,k] = -dp(anchor=i, pos=j) + dp(anchor=i, neg=k)   (reference :96-106)
+    dist = -dp[:, :, None] + dp[:, None, :]
+
+    valid_mask = triplet_mask(labels, row_valid).astype(dtype)
+    num_valid = jnp.sum(valid_mask)
+
+    pos_mask = (valid_mask * dist > _EPS).astype(dtype)  # reference :114
+    num_pos = jnp.sum(pos_mask)
+
+    if pos_triplets_only:
+        mask, num = pos_mask, num_pos
+    else:
+        mask, num = valid_mask, num_valid
+
+    # -log_sigmoid(-d) == softplus(d)  (reference :126)
+    loss = jnp.sum(jax.nn.softplus(dist) * mask) / (num + _EPS)
+
+    # participation count: as anchor + as negative + as positive  (reference :129)
+    data_weight = (
+        jnp.sum(mask, axis=(1, 2)) + jnp.sum(mask, axis=(0, 1)) + jnp.sum(mask, axis=(0, 2))
+    )
+
+    fraction = num_pos / (num_valid + _EPS)
+    return loss, data_weight, fraction, num_pos, {}
+
+
+def batch_hard_triplet_loss(labels, encode, row_valid=None):
+    """For each anchor mine the hardest positive (smallest dot) and hardest negative
+    (largest dot); softplus loss over anchors with a violating hard triplet.
+
+    Twin of reference triplet_loss_utils.py:202-259, including its quirks:
+      - invalid negatives enter the hardest-negative max as literal zeros
+        (mask * dp, reference :240) rather than -inf;
+      - data_weight finds the hardest pos/neg columns by exact float equality
+        (reference :251-253), double-counting ties.
+
+    :return: (loss, data_weight[B], fraction, num_triplets, extras_dict) where extras
+        has 'hardest_positive_dotproduct'/'hardest_negative_dotproduct' means
+        (the reference's TF summaries, :232, :244).
+    """
+    dtype = encode.dtype
+    valid = _as_valid(labels, row_valid)
+    validf = valid.astype(dtype)
+    dp = jnp.matmul(encode, encode.T, precision=jax.lax.Precision.HIGHEST)
+
+    # hardest positive: min over valid positives, after shifting invalid entries up by
+    # the row max (reference :227-231). Row max over valid columns only, so padding
+    # can't perturb the shift (equals the reference's full-row max when unpadded).
+    mask_ap = anchor_positive_mask(labels, row_valid).astype(dtype)
+    neg_inf = jnp.asarray(-jnp.inf, dtype)
+    max_row = jnp.max(jnp.where(valid[None, :], dp, neg_inf), axis=1, keepdims=True)
+    max_row = jnp.where(jnp.isfinite(max_row), max_row, jnp.zeros_like(max_row))
+    ap_dp = dp + max_row * (1.0 - mask_ap)
+    hardest_pos = jnp.min(ap_dp, axis=1, keepdims=True)
+
+    # hardest negative: max over mask*dp — invalid entries are zeros, as in reference :240
+    mask_an = anchor_negative_mask(labels, row_valid).astype(dtype)
+    an_dp = mask_an * dp
+    hardest_neg = jnp.max(an_dp, axis=1, keepdims=True)
+
+    dist = jnp.maximum(hardest_neg - hardest_pos, 0.0)  # [B,1]
+    count = (dist > 0.0).astype(dtype) * validf[:, None]  # [B,1]
+
+    # participation: anchor + hardest-pos hits + hardest-neg hits (reference :251-253);
+    # padded columns gated so dp==0 can't spuriously match.
+    eq_pos = (dp == hardest_pos).astype(dtype) * validf[None, :]
+    eq_neg = (dp == hardest_neg).astype(dtype) * validf[None, :]
+    data_weight = (
+        jnp.squeeze(count, axis=1)
+        + jnp.sum(count * eq_pos, axis=0)
+        + jnp.sum(count * eq_neg, axis=0)
+    )
+
+    total = jnp.sum(count)
+    loss = jnp.sum(jax.nn.softplus(dist) * count) / (total + _EPS)
+    n_rows = jnp.sum(validf)
+    fraction = total / jnp.maximum(n_rows, 1.0)
+
+    extras = {
+        "hardest_positive_dotproduct": jnp.sum(hardest_pos[:, 0] * validf) / jnp.maximum(n_rows, 1.0),
+        "hardest_negative_dotproduct": jnp.sum(hardest_neg[:, 0] * validf) / jnp.maximum(n_rows, 1.0),
+    }
+    return loss, data_weight, fraction, total, extras
+
+
+def precomputed_triplet_loss(encode, encode_pos, encode_neg, row_valid=None):
+    """Triplet loss over precomputed anchor/pos/neg encodings.
+
+    Twin of reference autoencoder_triplet.py:308-311:
+        mean(-log_sigmoid(sum(enc*enc_pos - enc*enc_neg, axis=1)))
+    = mean(softplus(-(dot(a,p) - dot(a,n)))).
+    """
+    margin = jnp.sum(encode * encode_pos - encode * encode_neg, axis=1)
+    per_row = jax.nn.softplus(-margin)
+    if row_valid is None:
+        return jnp.mean(per_row)
+    v = row_valid.astype(per_row.dtype)
+    return jnp.sum(per_row * v) / (jnp.sum(v) + _EPS)

@@ -1,0 +1,18 @@
+"""Test harness: force JAX onto CPU with 8 virtual devices BEFORE jax is imported,
+so pjit/shard_map mesh tests run without TPU hardware (SURVEY.md §4 multi-node story).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,89 @@
+"""Tests for corruption ops (reference test_utils.py:108-131 style: statistical checks
+for masking, exact checks where deterministic)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu.ops import corruption as C
+
+
+@pytest.mark.parametrize("v", [0.0, 0.3, 1.0])
+def test_masking_noise_ratio(v, rng):
+    x = jnp.asarray(rng.uniform(0.5, 1.0, size=(200, 300)).astype(np.float32))
+    out = np.asarray(C.masking_noise(jax.random.PRNGKey(0), x, v))
+    # surviving-nonzero ratio ~ 1 - v (reference test_utils.py:108-125, tol 1e-2)
+    ratio = (out != 0).sum() / x.size
+    assert abs(ratio - (1 - v)) < 2e-2
+    # no new nonzeros, survivors unchanged
+    mask = out != 0
+    np.testing.assert_array_equal(out[mask], np.asarray(x)[mask])
+
+
+def test_masking_noise_keeps_zeros(rng):
+    x = np.zeros((10, 20), np.float32)
+    x[0, 0] = 5.0
+    out = np.asarray(C.masking_noise(jax.random.PRNGKey(1), jnp.asarray(x), 0.0))
+    np.testing.assert_array_equal(out, x)
+
+
+def test_salt_and_pepper_noise(rng):
+    x = rng.uniform(0.2, 0.8, size=(50, 40)).astype(np.float32)
+    mn, mx = x.min(), x.max()
+    out = np.asarray(
+        C.salt_and_pepper_noise(jax.random.PRNGKey(2), jnp.asarray(x), n_corrupt=8)
+    )
+    changed = out != x
+    # every changed element is at the min or max
+    assert changed.sum() > 0
+    vals = out[changed]
+    assert np.all((vals == mn) | (vals == mx))
+    # at most n_corrupt changes per row (with replacement can repeat)
+    assert (changed.sum(axis=1) <= 8).all()
+
+
+def test_salt_and_pepper_zero_corrupt(rng):
+    x = jnp.asarray(rng.uniform(size=(5, 6)).astype(np.float32))
+    out = C.salt_and_pepper_noise(jax.random.PRNGKey(3), x, n_corrupt=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_decay_noise(rng):
+    x = rng.uniform(size=(5, 6)).astype(np.float32)
+    out = np.asarray(C.decay_noise(jnp.asarray(x), 0.3))
+    np.testing.assert_allclose(out, x * 0.7, rtol=1e-6)
+
+
+@pytest.mark.parametrize("corr_type", ["masking", "salt_and_pepper", "decay", "none"])
+def test_corrupt_dispatch(corr_type, rng):
+    x = jnp.asarray(rng.uniform(size=(8, 10)).astype(np.float32))
+    out = C.corrupt(jax.random.PRNGKey(4), x, corr_type, 0.3)
+    assert out.shape == x.shape
+
+
+def test_corrupt_dispatch_unknown():
+    with pytest.raises(ValueError):
+        C.corrupt(jax.random.PRNGKey(0), jnp.zeros((2, 2)), "bogus", 0.1)
+
+
+def test_corrupt_is_jittable(rng):
+    x = jnp.asarray(rng.uniform(size=(8, 10)).astype(np.float32))
+    f = jax.jit(lambda k, x: C.corrupt(k, x, "masking", 0.3))
+    out = f(jax.random.PRNGKey(5), x)
+    assert out.shape == x.shape
+
+
+@pytest.mark.parametrize("v", [0.0, 0.3, 1.0])
+def test_masking_noise_sparse_host(v, rng):
+    x = sp.random(100, 200, density=0.1, format="csr", random_state=0)
+    out = C.masking_noise_sparse_host(rng, x, v)
+    assert sp.issparse(out)
+    ratio = out.nnz / max(x.nnz, 1)
+    assert abs(ratio - (1 - v)) < 5e-2
+    # survivors are a subset with unchanged values
+    d_in = x.todense()
+    d_out = out.todense()
+    mask = np.asarray(d_out != 0)
+    np.testing.assert_array_equal(np.asarray(d_out)[mask], np.asarray(d_in)[mask])

@@ -1,0 +1,206 @@
+"""Oracle tests for triplet mining ops.
+
+Modeled on the reference's autoencoder/tests/test_triplet_loss_utils.py: every op is
+verified against a brute-force NumPy re-implementation (triple nested loops), across
+num_classes in {1, 3, 5} (1 exercises the no-valid-triplet edge) — plus net-new
+padded-batch cases for the XLA static-shape path.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu.ops import triplet as T
+
+B, D = 10, 6
+_EPS = 1e-16
+
+
+def _rand(num_classes, rng, b=B):
+    labels = rng.integers(0, num_classes, size=b).astype(np.int32)
+    embed = rng.normal(size=(b, D)).astype(np.float32)
+    return labels, embed
+
+
+def _oracle_triplet_mask(labels):
+    b = len(labels)
+    m = np.zeros((b, b, b), dtype=bool)
+    for i in range(b):
+        for j in range(b):
+            for k in range(b):
+                distinct = i != j and i != k and j != k
+                valid = labels[i] == labels[j] and labels[i] != labels[k]
+                m[i, j, k] = distinct and valid
+    return m
+
+
+@pytest.mark.parametrize("num_classes", [1, 3, 5])
+def test_anchor_positive_mask(num_classes, rng):
+    labels, _ = _rand(num_classes, rng)
+    expected = np.array(
+        [[i != j and labels[i] == labels[j] for j in range(B)] for i in range(B)]
+    )
+    got = np.asarray(T.anchor_positive_mask(jnp.asarray(labels)))
+    np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize("num_classes", [1, 3, 5])
+def test_anchor_negative_mask(num_classes, rng):
+    labels, _ = _rand(num_classes, rng)
+    expected = np.array([[labels[i] != labels[j] for j in range(B)] for i in range(B)])
+    got = np.asarray(T.anchor_negative_mask(jnp.asarray(labels)))
+    np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize("num_classes", [1, 3, 5])
+def test_triplet_mask(num_classes, rng):
+    labels, _ = _rand(num_classes, rng)
+    got = np.asarray(T.triplet_mask(jnp.asarray(labels)))
+    np.testing.assert_array_equal(got, _oracle_triplet_mask(labels))
+
+
+def _oracle_batch_all(labels, embed, pos_triplets_only):
+    b = len(labels)
+    dp = embed @ embed.T
+    mask3 = _oracle_triplet_mask(labels)
+    num_valid = mask3.sum()
+    loss_sum = 0.0
+    num_pos = 0
+    weight = np.zeros(b)
+    pos3 = np.zeros_like(mask3)
+    for i in range(b):
+        for j in range(b):
+            for k in range(b):
+                if not mask3[i, j, k]:
+                    continue
+                d = -dp[i, j] + dp[i, k]
+                if d > _EPS:
+                    num_pos += 1
+                    pos3[i, j, k] = True
+    use3 = pos3 if pos_triplets_only else mask3
+    for i in range(b):
+        for j in range(b):
+            for k in range(b):
+                if use3[i, j, k]:
+                    d = -dp[i, j] + dp[i, k]
+                    loss_sum += np.logaddexp(0.0, d)  # softplus
+                    weight[i] += 1  # anchor
+                    weight[j] += 1  # positive
+                    weight[k] += 1  # negative
+    num = num_pos if pos_triplets_only else num_valid
+    loss = loss_sum / (num + _EPS)
+    frac = num_pos / (num_valid + _EPS)
+    return loss, weight, frac, num_pos
+
+
+@pytest.mark.parametrize("num_classes", [1, 3, 5])
+@pytest.mark.parametrize("pos_only", [False, True])
+def test_batch_all_triplet_loss(num_classes, pos_only, rng):
+    labels, embed = _rand(num_classes, rng)
+    e_loss, e_w, e_frac, e_num = _oracle_batch_all(labels, embed, pos_only)
+    loss, w, frac, num, _ = T.batch_all_triplet_loss(
+        jnp.asarray(labels), jnp.asarray(embed), pos_triplets_only=pos_only
+    )
+    np.testing.assert_allclose(float(loss), e_loss, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w), e_w, rtol=1e-5)
+    np.testing.assert_allclose(float(frac), e_frac, rtol=1e-5, atol=1e-7)
+    assert int(num) == e_num
+
+
+def _oracle_batch_hard(labels, embed):
+    b = len(labels)
+    dp = embed @ embed.T
+    hardest_pos = np.zeros(b)
+    hardest_neg = np.zeros(b)
+    for i in range(b):
+        mask_ap = np.array([i != j and labels[i] == labels[j] for j in range(b)])
+        mask_an = np.array([labels[i] != labels[j] for j in range(b)])
+        row_max = dp[i].max()
+        shifted = dp[i] + row_max * (1.0 - mask_ap.astype(float))
+        hardest_pos[i] = shifted.min()
+        hardest_neg[i] = (mask_an.astype(float) * dp[i]).max()
+    dist = np.maximum(hardest_neg - hardest_pos, 0.0)
+    count = (dist > 0.0).astype(float)
+    weight = count.copy()
+    for r in range(b):
+        for i in range(b):
+            if dp[i, r] == hardest_pos[i]:
+                weight[r] += count[i]
+            if dp[i, r] == hardest_neg[i]:
+                weight[r] += count[i]
+    total = count.sum()
+    loss = (np.logaddexp(0.0, dist) * count).sum() / (total + _EPS)
+    return loss, weight, total / b, total
+
+
+@pytest.mark.parametrize("num_classes", [1, 3, 5])
+def test_batch_hard_triplet_loss(num_classes, rng):
+    labels, embed = _rand(num_classes, rng)
+    e_loss, e_w, e_frac, e_num = _oracle_batch_hard(labels, embed)
+    loss, w, frac, num, extras = T.batch_hard_triplet_loss(
+        jnp.asarray(labels), jnp.asarray(embed)
+    )
+    np.testing.assert_allclose(float(loss), e_loss, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w), e_w, rtol=1e-5)
+    np.testing.assert_allclose(float(frac), e_frac, rtol=1e-5)
+    np.testing.assert_allclose(float(num), e_num, rtol=1e-5)
+    assert "hardest_positive_dotproduct" in extras
+
+
+@pytest.mark.parametrize("num_classes", [3, 5])
+def test_batch_all_padding_equivalence(num_classes, rng):
+    """Padded rows must mine zero triplets: padded result == unpadded result."""
+    labels, embed = _rand(num_classes, rng)
+    pad = 6
+    labels_p = np.concatenate([labels, np.full(pad, -1, np.int32)])
+    embed_p = np.concatenate([embed, rng.normal(size=(pad, D)).astype(np.float32)])
+    valid = np.concatenate([np.ones(B), np.zeros(pad)]).astype(np.float32)
+
+    base = T.batch_all_triplet_loss(jnp.asarray(labels), jnp.asarray(embed))
+    padded = T.batch_all_triplet_loss(
+        jnp.asarray(labels_p), jnp.asarray(embed_p), row_valid=jnp.asarray(valid)
+    )
+    np.testing.assert_allclose(float(padded[0]), float(base[0]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(padded[1])[:B], np.asarray(base[1]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(padded[1])[B:], 0.0)
+    np.testing.assert_allclose(float(padded[3]), float(base[3]))
+
+
+@pytest.mark.parametrize("num_classes", [3, 5])
+def test_batch_hard_padding_masks_rows(num_classes, rng):
+    """Padded rows contribute no anchors and no data_weight."""
+    labels, embed = _rand(num_classes, rng)
+    pad = 6
+    labels_p = np.concatenate([labels, np.full(pad, -1, np.int32)])
+    # padded embeddings are exactly zero in the real model (encode(0) == 0)
+    embed_p = np.concatenate([embed, np.zeros((pad, D), np.float32)])
+    valid = np.concatenate([np.ones(B), np.zeros(pad)]).astype(np.float32)
+
+    loss, w, frac, num, _ = T.batch_hard_triplet_loss(
+        jnp.asarray(labels_p), jnp.asarray(embed_p), row_valid=jnp.asarray(valid)
+    )
+    np.testing.assert_allclose(np.asarray(w)[B:], 0.0)
+    assert np.isfinite(float(loss))
+
+
+def test_precomputed_triplet_loss(rng):
+    a = rng.normal(size=(B, D)).astype(np.float32)
+    p = rng.normal(size=(B, D)).astype(np.float32)
+    n = rng.normal(size=(B, D)).astype(np.float32)
+    margin = (a * p - a * n).sum(1)
+    expected = np.logaddexp(0.0, -margin).mean()
+    got = T.precomputed_triplet_loss(jnp.asarray(a), jnp.asarray(p), jnp.asarray(n))
+    np.testing.assert_allclose(float(got), expected, rtol=1e-5)
+
+
+def test_precomputed_triplet_loss_padding(rng):
+    a = rng.normal(size=(B, D)).astype(np.float32)
+    p = rng.normal(size=(B, D)).astype(np.float32)
+    n = rng.normal(size=(B, D)).astype(np.float32)
+    valid = np.concatenate([np.ones(B - 3), np.zeros(3)]).astype(np.float32)
+    margin = (a * p - a * n).sum(1)[: B - 3]
+    expected = np.logaddexp(0.0, -margin).mean()
+    got = T.precomputed_triplet_loss(
+        jnp.asarray(a), jnp.asarray(p), jnp.asarray(n), row_valid=jnp.asarray(valid)
+    )
+    np.testing.assert_allclose(float(got), expected, rtol=1e-4)
