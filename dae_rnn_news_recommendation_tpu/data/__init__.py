@@ -1,0 +1,8 @@
+from .batcher import (  # noqa: F401
+    resolve_batch_size,
+    densify_rows,
+    PaddedBatcher,
+    gen_batches,
+    gen_batches_triplet,
+)
+from .io import save_file, read_file  # noqa: F401

@@ -1,0 +1,183 @@
+"""Host-side batching: sparse/dense rows -> fixed-shape dense padded shards.
+
+Twin of reference autoencoder/utils.py:29-91 (gen_batches, gen_batches_triplet) with a
+TPU-first redesign: XLA compiles one graph per shape, so every batch this module emits
+has the SAME static [B, F] shape — the ragged final batch is zero-padded and flagged
+via `row_valid` (padded rows embed to exactly 0 and carry zero loss weight, see
+ops/losses.py and models/dae_core.py). Sparse csr rows never reach the device as
+sparse: TPUs want dense MXU tiles, so csr row-slices are densified here (C++ fast path
+in native/fastbatch when built, NumPy fallback otherwise).
+
+batch_size semantics follow the reference (utils.py:47): a float in (0,1] means a
+fraction of the dataset, an int >= 1 is absolute; fractional sizes round with
+`max(round(n*frac), 1)`.
+"""
+
+import numpy as np
+import scipy.sparse as sp
+
+try:  # optional native fast path (native/fastbatch)
+    from ..native_bindings.fastbatch import densify_csr_rows as _native_densify
+except Exception:  # pragma: no cover - absence of the .so is a supported config
+    _native_densify = None
+
+
+def resolve_batch_size(batch_size, n_rows):
+    """Reference utils.py:41-48: fraction-of-data or absolute int."""
+    assert batch_size > 0.0
+    if batch_size < 1.0:
+        batch_size = max(round(n_rows * batch_size), 1)
+    return int(batch_size)
+
+
+def densify_rows(data, idx, out=None):
+    """Gather rows `idx` of `data` as a dense float32 array.
+
+    Accepts np.ndarray, scipy sparse, or pandas DataFrame.
+    """
+    if sp.issparse(data):
+        rows = data[idx]
+        if _native_densify is not None and sp.isspmatrix_csr(rows):
+            return _native_densify(rows, out=out)
+        return np.asarray(rows.todense(), dtype=np.float32)
+    if hasattr(data, "iloc"):  # pandas (3.x copy-on-write hands out read-only views)
+        return np.array(data.iloc[idx], dtype=np.float32)
+    out = np.asarray(data[idx], dtype=np.float32)
+    return out if out.flags.writeable else out.copy()
+
+
+def _labels_at(labels, idx):
+    if labels is None:
+        return None
+    if hasattr(labels, "iloc"):
+        out = np.array(labels.iloc[idx])
+    else:
+        out = np.asarray(labels)[idx]
+    return out.reshape(-1).astype(np.int32, copy=True)
+
+
+class PaddedBatcher:
+    """Shuffled fixed-shape batches over (data, labels).
+
+    Yields dicts {x [B,F] f32, labels [B] i32, row_valid [B] f32} where B is constant
+    (last batch zero-padded). `drop_remainder` drops the ragged tail instead. When a
+    `mesh_batch_multiple` is given, B is rounded up so each device shard is equal.
+    """
+
+    def __init__(self, batch_size, shuffle=True, seed=0, drop_remainder=False,
+                 mesh_batch_multiple=1):
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.rng = np.random.default_rng(seed if seed is not None and seed >= 0 else None)
+        self.drop_remainder = drop_remainder
+        self.mesh_batch_multiple = max(1, int(mesh_batch_multiple))
+
+    def epoch(self, data, labels=None):
+        n = data.shape[0]
+        b = resolve_batch_size(self.batch_size, n)
+        if self.mesh_batch_multiple > 1:
+            b = int(np.ceil(b / self.mesh_batch_multiple) * self.mesh_batch_multiple)
+        index = np.arange(n)
+        if self.shuffle:
+            self.rng.shuffle(index)
+        for start in range(0, n, b):
+            idx = index[start : start + b]
+            n_real = len(idx)
+            if n_real < b:
+                if self.drop_remainder:
+                    return
+                idx = np.concatenate([idx, np.zeros(b - n_real, dtype=idx.dtype)])
+            x = densify_rows(data, idx)
+            valid = np.zeros(b, np.float32)
+            valid[:n_real] = 1.0
+            if n_real < b:
+                x[n_real:] = 0.0
+            batch = {"x": x, "row_valid": valid}
+            lab = _labels_at(labels, idx)
+            if lab is not None:
+                lab[n_real:] = -1  # padded rows never share a label
+                batch["labels"] = lab
+            yield batch
+
+
+def gen_batches(data, data_corrupted, batch_size, data_label=None, random=True, seed=None):
+    """Reference-compatible generator (utils.py:29-70): yields
+    (batch_data, batch_data_corrupted[, batch_label]) in the original ragged shapes.
+
+    Kept for API parity and host-side workflows; the TPU train path uses
+    PaddedBatcher + on-device corruption instead.
+    """
+    assert batch_size > 0.0
+    assert data.shape[0] == data_corrupted.shape[0]
+    assert type(data) == type(data_corrupted), (type(data), type(data_corrupted))
+    if data_label is not None:
+        lab = np.asarray(data_label)
+        assert lab.ndim == 1 or lab.shape[1] == 1
+
+    n = data.shape[0]
+    b = resolve_batch_size(batch_size, n)
+    index = np.arange(n)
+    if random:
+        np.random.default_rng(seed).shuffle(index) if seed is not None else np.random.shuffle(index)
+
+    def take(obj, idx):
+        if hasattr(obj, "iloc"):
+            return obj.iloc[idx]
+        return obj[idx]
+
+    for start in range(0, n, b):
+        idx = index[start : start + b]
+        if data_label is not None:
+            yield take(data, idx), take(data_corrupted, idx), take(data_label, idx)
+        else:
+            yield take(data, idx), take(data_corrupted, idx)
+
+
+def gen_batches_triplet(data, data_corrupted, batch_size, random=True, seed=None):
+    """Reference-compatible triplet generator (utils.py:73-91): dict {org,pos,neg} in,
+    ([org,pos,neg] batches, [corr...] batches) out, shared shuffle order."""
+    assert batch_size > 0.0
+    keys = list(data)
+    for key in keys:
+        assert data[key].shape[0] == data_corrupted[key].shape[0]
+    n = data[keys[0]].shape[0]
+    b = resolve_batch_size(batch_size, n)
+    index = np.arange(n)
+    if random:
+        np.random.default_rng(seed).shuffle(index) if seed is not None else np.random.shuffle(index)
+    for start in range(0, n, b):
+        idx = index[start : start + b]
+        yield (
+            [data[key][idx, :] for key in keys],
+            [data_corrupted[key][idx, :] for key in keys],
+        )
+
+
+class TripletPaddedBatcher(PaddedBatcher):
+    """Fixed-shape batches over {org,pos,neg} dicts for the precomputed-triplet model."""
+
+    def epoch(self, data, labels=None):
+        keys = ("org", "pos", "neg")
+        n = data["org"].shape[0]
+        b = resolve_batch_size(self.batch_size, n)
+        if self.mesh_batch_multiple > 1:
+            b = int(np.ceil(b / self.mesh_batch_multiple) * self.mesh_batch_multiple)
+        index = np.arange(n)
+        if self.shuffle:
+            self.rng.shuffle(index)
+        for start in range(0, n, b):
+            idx = index[start : start + b]
+            n_real = len(idx)
+            if n_real < b:
+                if self.drop_remainder:
+                    return
+                idx = np.concatenate([idx, np.zeros(b - n_real, dtype=idx.dtype)])
+            valid = np.zeros(b, np.float32)
+            valid[:n_real] = 1.0
+            batch = {"row_valid": valid}
+            for key in keys:
+                x = densify_rows(data[key], idx)
+                if n_real < b:
+                    x[n_real:] = 0.0
+                batch[key] = x
+            yield batch

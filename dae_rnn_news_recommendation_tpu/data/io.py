@@ -1,0 +1,94 @@
+"""Type-dispatched save/read for numpy / scipy / pandas artifacts.
+
+Twin of reference helpers.py:138-264 (save_file/read_file): same (type x format)
+matrix — numpy x {csv,tsv,npy}, scipy x {csv,tsv,npz}, DataFrame x
+{csv,tsv,parquet,pkl}, Series x {csv,tsv,pkl} — so the data checkpoint/restore
+workflow (main_autoencoder.py:161-244) round-trips identically.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import scipy.sparse as sparse
+
+
+def _fmt(path, format):
+    return format if format is not None else str(path).lower().split(".")[-1]
+
+
+def save_file(data, path, format=None, **savekwargs):
+    path = str(path)
+    format = _fmt(path, format)
+
+    if sparse.issparse(data):
+        if format in ("csv", "tsv"):
+            np.savetxt(path, np.asarray(data.todense()),
+                       delimiter="," if format == "csv" else "\t", **savekwargs)
+        elif format == "npz":
+            sparse.save_npz(path, data, **savekwargs)
+        else:
+            raise AssertionError(f"unsupported format {format!r} for scipy sparse")
+    elif isinstance(data, np.ndarray):
+        if format in ("csv", "tsv"):
+            np.savetxt(path, data, delimiter="," if format == "csv" else "\t", **savekwargs)
+        elif format == "npy":
+            np.save(path, data, **savekwargs)
+        else:
+            raise AssertionError(f"unsupported format {format!r} for numpy")
+    elif isinstance(data, pd.DataFrame):
+        if format in ("csv", "tsv"):
+            data.to_csv(path, sep="," if format == "csv" else "\t", **savekwargs)
+        elif format == "parquet":
+            data.to_parquet(path, **savekwargs)
+        elif format == "pkl":
+            data.to_pickle(path, **savekwargs)
+        else:
+            raise AssertionError(f"unsupported format {format!r} for DataFrame")
+    elif isinstance(data, pd.Series):
+        if format in ("csv", "tsv"):
+            data.to_csv(path, sep="," if format == "csv" else "\t", header=False, **savekwargs)
+        elif format == "pkl":
+            data.to_pickle(path, **savekwargs)
+        else:
+            raise AssertionError(f"unsupported format {format!r} for Series")
+    else:
+        raise AssertionError(f"unsupported data type {type(data)!r}")
+
+
+def read_file(path, data_type=None, format=None, **readkwargs):
+    path = str(path)
+    assert os.path.isfile(path), f"[Error] {path} is not a file"
+    format = _fmt(path, format)
+
+    if data_type is None:
+        data_type = {"npy": "numpy", "npz": "scipy"}.get(format, "pandas_df")
+
+    if data_type == "numpy":
+        if format in ("csv", "tsv"):
+            return np.loadtxt(path, delimiter="," if format == "csv" else "\t", **readkwargs)
+        if format == "npy":
+            return np.load(path, **readkwargs)
+    elif data_type == "scipy":
+        if format in ("csv", "tsv"):
+            return sparse.csr_matrix(
+                np.loadtxt(path, delimiter="," if format == "csv" else "\t", **readkwargs)
+            )
+        if format == "npz":
+            return sparse.load_npz(path)
+    elif data_type == "pandas_df":
+        if format in ("csv", "tsv"):
+            return pd.read_csv(path, sep="," if format == "csv" else "\t",
+                               index_col=0, **readkwargs)
+        if format == "parquet":
+            return pd.read_parquet(path, **readkwargs)
+        if format == "pkl":
+            return pd.read_pickle(path, **readkwargs)
+    elif data_type == "pandas_series":
+        if format in ("csv", "tsv"):
+            df = pd.read_csv(path, sep="," if format == "csv" else "\t",
+                             index_col=0, header=None, **readkwargs)
+            return df.iloc[:, 0]
+        if format == "pkl":
+            return pd.read_pickle(path, **readkwargs)
+    raise AssertionError(f"unsupported (data_type={data_type!r}, format={format!r})")

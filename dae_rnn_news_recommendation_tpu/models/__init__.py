@@ -1,0 +1,12 @@
+from .dae_core import (  # noqa: F401
+    DAEConfig,
+    init_params,
+    encode,
+    decode,
+    forward,
+    resolve_activation,
+)
+from .estimator import DenoisingAutoencoder  # noqa: F401
+from .estimator_triplet import DenoisingAutoencoderTriplet  # noqa: F401
+from .stacked import StackedDenoisingAutoencoder  # noqa: F401
+from .gru_user import GRUUserModel, gru_init_params, gru_apply  # noqa: F401

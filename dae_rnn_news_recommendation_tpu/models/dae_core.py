@@ -1,0 +1,100 @@
+"""Functional DAE core: a pure-pytree parameterization of the paper's modified
+denoising autoencoder.
+
+Twin of the graph-construction half of reference autoencoder/autoencoder.py:
+
+    encode: H = act(x_corr @ W + bh) - act(bh)      (reference :389 — the Yahoo! paper's
+                                                     modification; guarantees encode(0)=0,
+                                                     which also makes padded rows embed
+                                                     to exactly zero)
+    decode: Y = act(H @ W.T + bv)                   (tied weights, reference :411)
+
+No classes, no graph objects: params are a dict pytree {"W","bh","bv"}; every function
+is pure and jit/pjit/vmap-compatible. dtype policy: params kept in float32; the encode
+matmul can run in bfloat16 on the MXU via `compute_dtype` while mining and losses stay
+float32 (see ops/triplet.py precision note).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.initializers import xavier_init
+
+ACTIVATIONS = ("sigmoid", "tanh", "none")
+
+
+def resolve_activation(name):
+    """Map reference activation names (autoencoder.py:380-387) to jax fns."""
+    if name == "sigmoid":
+        return jax.nn.sigmoid
+    if name == "tanh":
+        return jnp.tanh
+    if name in ("none", None):
+        return lambda x: x
+    raise ValueError(f"unknown activation: {name!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class DAEConfig:
+    """Static model configuration (hashable — safe as a jit static arg)."""
+
+    n_features: int
+    n_components: int
+    enc_act_func: str = "tanh"
+    dec_act_func: str = "none"
+    loss_func: str = "mean_squared"
+    corr_type: str = "masking"
+    corr_frac: float = 0.0
+    triplet_strategy: str = "batch_all"  # batch_all | batch_hard | none
+    alpha: float = 1.0
+    xavier_const: float = 1.0
+    compute_dtype: str = "float32"  # "bfloat16" runs the wide matmuls on the MXU in bf16
+    matmul_precision: str = "default"  # "default" | "high" | "highest" for encode/decode
+
+    def __post_init__(self):
+        assert self.enc_act_func in ACTIVATIONS
+        assert self.dec_act_func in ACTIVATIONS
+        assert self.triplet_strategy in ("batch_all", "batch_hard", "none")
+
+
+def _precision(config):
+    if config.matmul_precision == "default":
+        return None
+    return {"high": jax.lax.Precision.HIGH,
+            "highest": jax.lax.Precision.HIGHEST}[config.matmul_precision]
+
+
+def init_params(key, config):
+    """Xavier W [F, D], zero biases (reference autoencoder.py:356-369)."""
+    return {
+        "W": xavier_init(key, config.n_features, config.n_components, config.xavier_const),
+        "bh": jnp.zeros((config.n_components,), jnp.float32),
+        "bv": jnp.zeros((config.n_features,), jnp.float32),
+    }
+
+
+def encode(params, x, config):
+    """H = act(xW + bh) - act(bh). Returns float32 regardless of compute dtype."""
+    act = resolve_activation(config.enc_act_func)
+    dt = jnp.dtype(config.compute_dtype)
+    w = params["W"].astype(dt)
+    h = jnp.matmul(x.astype(dt), w, precision=_precision(config)).astype(jnp.float32)
+    h = h + params["bh"]
+    return act(h) - act(params["bh"])
+
+
+def decode(params, h, config):
+    """Y = act(h W^T + bv) (tied weights)."""
+    act = resolve_activation(config.dec_act_func)
+    dt = jnp.dtype(config.compute_dtype)
+    w = params["W"].astype(dt)
+    y = jnp.matmul(h.astype(dt), w.T, precision=_precision(config)).astype(jnp.float32)
+    return act(y + params["bv"])
+
+
+def forward(params, x, config):
+    """Full autoencoding pass: (encode, decode)."""
+    h = encode(params, x, config)
+    return h, decode(params, h, config)

@@ -1,0 +1,85 @@
+"""DenoisingAutoencoderTriplet — the precomputed anchor/pos/neg triplet estimator.
+
+Twin of reference autoencoder/autoencoder_triplet.py: three weight-sharing
+encode/decode towers (in JAX simply the same pure function applied to org/pos/neg),
+cost = sum of three reconstruction losses + alpha * softplus(enc.neg - enc.pos)
+(reference :296-315). Fixes the reference's known defects rather than replicating them
+(SURVEY §2.3.4 summary-fetch AttributeError, §2.3.5 stray decode assignment, §2.3.10
+sparse `!= None` comparison).
+
+fit() takes dicts {'org','pos','neg'} of aligned row matrices (reference :40-77).
+"""
+
+import numpy as np
+
+from ..data.batcher import TripletPaddedBatcher
+from ..train.step import triplet_loss_and_metrics
+from ..utils.provenance import write_parameter_file
+from ..utils.metrics import MetricsWriter
+from .estimator import DenoisingAutoencoder
+import os
+
+
+class DenoisingAutoencoderTriplet(DenoisingAutoencoder):
+    _loss_fn = staticmethod(triplet_loss_and_metrics)
+    _needs_labels = False
+    _batcher_cls = TripletPaddedBatcher
+
+    def __init__(self, algo_name="dae_triplet", model_name="dae_triplet",
+                 compress_factor=10, main_dir="dae_triplet/", enc_act_func="tanh",
+                 dec_act_func="none", loss_func="mean_squared", num_epochs=10,
+                 batch_size=10, xavier_init=1, opt="gradient_descent",
+                 learning_rate=0.01, momentum=0.5, corr_type="none", corr_frac=0.0,
+                 verbose=True, verbose_step=5, seed=-1, alpha=1, **tpu_kwargs):
+        super().__init__(
+            algo_name=algo_name, model_name=model_name, compress_factor=compress_factor,
+            main_dir=main_dir, enc_act_func=enc_act_func, dec_act_func=dec_act_func,
+            loss_func=loss_func, num_epochs=num_epochs, batch_size=batch_size,
+            xavier_init=xavier_init, opt=opt, learning_rate=learning_rate,
+            momentum=momentum, corr_type=corr_type, corr_frac=corr_frac,
+            verbose=verbose, verbose_step=verbose_step, seed=seed, alpha=alpha,
+            triplet_strategy="none", **tpu_kwargs)
+
+    def _data_extremes(self, train_set):
+        if self.corr_type != "salt_and_pepper":
+            return {}
+        mns, mxs = [], []
+        for key in ("org", "pos", "neg"):
+            e = super()._data_extremes(train_set[key])
+            mns.append(e["corr_min"]); mxs.append(e["corr_max"])
+        return {"corr_min": np.float32(min(mns)), "corr_max": np.float32(max(mxs))}
+
+    def fit(self, train_set, validation_set=None, restore_previous_model=False):
+        """Fit on {'org','pos','neg'} dicts (reference autoencoder_triplet.py:40-77)."""
+        assert type(train_set["org"]) == type(train_set["pos"])
+        assert type(train_set["org"]) == type(train_set["neg"])
+        assert train_set["org"].shape == train_set["pos"].shape
+        assert train_set["org"].shape == train_set["neg"].shape
+        if validation_set is not None:
+            assert validation_set["org"].shape == validation_set["pos"].shape
+            assert validation_set["org"].shape == validation_set["neg"].shape
+
+        n_features = train_set["org"].shape[1]
+        self.sparse_input = not isinstance(train_set["org"], np.ndarray)
+        self._build(n_features, restore_previous_model)
+        write_parameter_file(self.parameter_file, self._parameter_dict(),
+                             append=restore_previous_model)
+
+        train_writer = MetricsWriter(os.path.join(self.tf_summary_dir, "train/"),
+                                     self.use_tensorboard)
+        val_writer = MetricsWriter(os.path.join(self.tf_summary_dir, "validation/"),
+                                   self.use_tensorboard)
+        extremes = self._data_extremes(train_set)
+        seed = self.seed if self.seed is not None and self.seed >= 0 else None
+        batcher = self._batcher_cls(self.batch_size, shuffle=True, seed=seed,
+                                    mesh_batch_multiple=self._batch_multiple)
+        # triplet mode always reports the 3-way cost split
+        self.triplet_strategy_reported = "precomputed"
+        try:
+            self._train_loop(train_set, None, validation_set, None,
+                             batcher, extremes, train_writer, val_writer)
+        finally:
+            train_writer.close()
+            val_writer.close()
+        self._save(self._epoch0 + self.num_epochs)
+        return self

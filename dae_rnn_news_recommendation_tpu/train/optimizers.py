@@ -1,0 +1,27 @@
+"""Optimizer zoo via optax.
+
+Twin of reference autoencoder/autoencoder.py:444-477 (_create_train_step_node), keeping
+the reference's names and hyperparameter semantics:
+
+  gradient_descent -> plain SGD
+  ada_grad         -> Adagrad with TF1's default initial accumulator 0.1
+  momentum         -> SGD + heavy-ball momentum (TF MomentumOptimizer semantics)
+  adam             -> Adam (the reference's latent fourth path, autoencoder.py:471-472)
+"""
+
+import optax
+
+OPTIMIZERS = ("gradient_descent", "ada_grad", "momentum", "adam")
+
+
+def make_optimizer(opt, learning_rate, momentum=0.5):
+    if opt == "gradient_descent":
+        return optax.sgd(learning_rate)
+    if opt == "ada_grad":
+        # TF1 AdagradOptimizer initializes its accumulator to 0.1, not 0
+        return optax.adagrad(learning_rate, initial_accumulator_value=0.1)
+    if opt == "momentum":
+        return optax.sgd(learning_rate, momentum=momentum, nesterov=False)
+    if opt == "adam":
+        return optax.adam(learning_rate)
+    raise ValueError(f"unknown optimizer: {opt!r} (want one of {OPTIMIZERS})")

@@ -1,0 +1,155 @@
+"""Jit-compiled training and eval steps.
+
+This is the TPU replacement for the reference's Session.run train loop
+(autoencoder/autoencoder.py:206-246): one pure function computes
+corrupt -> encode -> decode -> mine -> loss -> grad -> optax update, entirely
+on device, traced once. Corruption happens *inside* the step from an explicit PRNG key
+(the reference corrupts the whole train set per epoch on host, autoencoder.py:218 —
+moving it on-device removes the host bottleneck and makes runs reproducible by key).
+
+Batches are dicts of arrays with static shapes:
+    x         [B, F] clean dense rows (sparse inputs densified into padded shards)
+    labels    [B]    int32 labels (only consumed when mining)
+    row_valid [B]    1.0 for real rows, 0.0 for padding
+    corr_min/corr_max  scalar corruption extremes (salt_and_pepper only)
+
+`make_train_step(config, optimizer)` returns step(params, opt_state, key, batch) ->
+(params, opt_state, metrics). Metrics mirror the reference's per-batch fetches
+(autoencoder.py:233): cost, autoencoder_loss, triplet_loss, fraction_triplet,
+num_triplet (+ hardest pos/neg dot products for batch_hard).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..models import dae_core
+from ..ops import corruption, losses, triplet
+
+
+def _corrupt_batch(key, batch, config):
+    x = batch["x"]
+    if config.corr_type == "none":
+        return x
+    return corruption.corrupt(
+        key,
+        x,
+        config.corr_type,
+        config.corr_frac,
+        mn=batch.get("corr_min"),
+        mx=batch.get("corr_max"),
+    )
+
+
+def loss_and_metrics(params, batch, key, config):
+    """Full training objective (reference _create_cost_function_node,
+    autoencoder.py:417-442). Returns (cost, metrics_dict)."""
+    x = batch["x"]
+    row_valid = batch.get("row_valid")
+    x_corr = batch.get("x_corr")
+    if x_corr is None:
+        x_corr = _corrupt_batch(key, batch, config)
+
+    h = dae_core.encode(params, x_corr, config)
+    y = dae_core.decode(params, h, config)
+
+    if config.triplet_strategy != "none":
+        if config.triplet_strategy == "batch_all":
+            t_loss, data_weight, fraction, num, extras = triplet.batch_all_triplet_loss(
+                batch["labels"], h, row_valid=row_valid
+            )
+        else:
+            t_loss, data_weight, fraction, num, extras = triplet.batch_hard_triplet_loss(
+                batch["labels"], h, row_valid=row_valid
+            )
+        ae_loss = losses.weighted_loss(
+            x, y, config.loss_func, weight=data_weight, row_valid=row_valid
+        )
+        cost = ae_loss + config.alpha * t_loss
+        metrics = {
+            "cost": cost,
+            "autoencoder_loss": ae_loss,
+            "triplet_loss": t_loss,
+            "fraction_triplet": fraction,
+            "num_triplet": num,
+            **extras,
+        }
+    else:
+        cost = losses.weighted_loss(x, y, config.loss_func, row_valid=row_valid)
+        metrics = {"cost": cost}
+    return cost, metrics
+
+
+def triplet_loss_and_metrics(params, batch, key, config):
+    """Precomputed-triplet objective (reference autoencoder_triplet.py:296-315):
+    three weight-sharing towers — in JAX simply the same pure fn applied thrice —
+    summed reconstruction losses + alpha * softplus margin loss.
+
+    Batch keys: org, pos, neg (clean [B,F] each) + row_valid.
+    """
+    row_valid = batch.get("row_valid")
+    keys = jax.random.split(key, 3)
+    hs, ys = {}, {}
+    for i, name in enumerate(("org", "pos", "neg")):
+        x_corr = batch.get(f"{name}_corr")
+        if x_corr is None:
+            sub = dict(batch, x=batch[name])
+            x_corr = _corrupt_batch(keys[i], sub, config)
+        hs[name] = dae_core.encode(params, x_corr, config)
+        ys[name] = dae_core.decode(params, hs[name], config)
+
+    ae_loss = sum(
+        losses.weighted_loss(batch[n], ys[n], config.loss_func, row_valid=row_valid)
+        for n in ("org", "pos", "neg")
+    )
+    t_loss = triplet.precomputed_triplet_loss(
+        hs["org"], hs["pos"], hs["neg"], row_valid=row_valid
+    )
+    cost = ae_loss + config.alpha * t_loss
+    return cost, {
+        "cost": cost,
+        "autoencoder_loss": ae_loss,
+        "triplet_loss": t_loss,
+    }
+
+
+def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True):
+    """Build the jitted train step. `config` is static; params/opt_state are donated
+    so XLA updates them in place in HBM."""
+
+    def step(params, opt_state, key, batch):
+        (cost, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, key, config
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, metrics
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_eval_step(config, loss_fn=loss_and_metrics):
+    """Validation step: no corruption (the reference feeds the clean set as both
+    inputs, autoencoder.py:300-304), no parameter update."""
+
+    def step(params, batch):
+        eval_cfg = config
+        batch = dict(batch)
+        # feed clean data as the "corrupted" input, like the reference
+        if "org" in batch:
+            for n in ("org", "pos", "neg"):
+                batch[f"{n}_corr"] = batch[n]
+        else:
+            batch["x_corr"] = batch["x"]
+        _, metrics = loss_fn(params, batch, jax.random.PRNGKey(0), eval_cfg)
+        return metrics
+
+    return jax.jit(step)
+
+
+def make_encode_fn(config, donate=False):
+    """Jitted encode pass (the reference's transform, autoencoder.py:479-505)."""
+
+    def run(params, x):
+        return dae_core.encode(params, x, config)
+
+    return jax.jit(run)

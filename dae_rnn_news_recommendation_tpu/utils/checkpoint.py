@@ -1,0 +1,100 @@
+"""Checkpoint/restore of model + optimizer state.
+
+Twin of the reference's tf.train.Saver usage (autoencoder.py:156, :166, :169-170,
+:491) with two deliberate upgrades (SURVEY §2.3.12): periodic mid-run saves for fault
+tolerance, and the epoch stored inside the checkpoint so resume continues the schedule.
+
+Layout per checkpoint:  <ckpt_dir>/step_<N>/
+    params/     model weights — orbax when importable (JAX-native, sharding-aware for
+                multi-host), .npz fallback otherwise
+    aux.npz     flattened optimizer-state leaves + epoch (structure comes from the
+                caller's `like` pytree at restore, so weights stay loadable even when
+                the restoring process uses a different optimizer — e.g. load_model)
+"""
+
+import os
+import re
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+except Exception:  # pragma: no cover
+    ocp = None
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def save_checkpoint(ckpt_dir, state, step, use_orbax=True):
+    """Save {'params':…, 'opt_state':…, 'epoch':…} at `step`; returns the path."""
+    base = os.path.abspath(os.path.join(ckpt_dir, f"step_{step}"))
+    os.makedirs(base, exist_ok=True)
+
+    params_path = os.path.join(base, "params")
+    if use_orbax and ocp is not None:
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(params_path, state["params"], force=True)
+        ckptr.wait_until_finished()
+    else:
+        leaves, _ = jax.tree_util.tree_flatten(state["params"])
+        np.savez(params_path + ".npz", *[np.asarray(x) for x in leaves])
+
+    opt_leaves, _ = jax.tree_util.tree_flatten(state.get("opt_state"))
+    np.savez(os.path.join(base, "aux.npz"),
+             *[np.asarray(x) for x in opt_leaves],
+             epoch=np.asarray(int(state.get("epoch", 0))))
+    return base
+
+
+def latest_checkpoint(ckpt_dir):
+    """(path, step) of the newest checkpoint under ckpt_dir, or (None, -1)."""
+    if not os.path.isdir(ckpt_dir):
+        return None, -1
+    best, best_step = None, -1
+    for name in os.listdir(ckpt_dir):
+        m = _STEP_RE.match(name)
+        if m:
+            step = int(m.group(1))
+            if step > best_step:
+                best, best_step = os.path.join(ckpt_dir, name), step
+    return best, best_step
+
+
+def load_params(ckpt_path, params_like):
+    """Restore just the model weights from a checkpoint directory."""
+    params_path = os.path.join(ckpt_path, "params")
+    if os.path.isdir(params_path) and ocp is not None:
+        ckptr = ocp.StandardCheckpointer()
+        abstract = jax.tree_util.tree_map(np.asarray, params_like)
+        return ckptr.restore(os.path.abspath(params_path), abstract)
+    npz = params_path + ".npz"
+    if os.path.isfile(npz):
+        data = np.load(npz)
+        leaves, treedef = jax.tree_util.tree_flatten(params_like)
+        return jax.tree_util.tree_unflatten(
+            treedef, [data[f"arr_{i}"] for i in range(len(leaves))])
+    raise FileNotFoundError(f"no params under {ckpt_path}")
+
+
+def load_checkpoint(ckpt_path, like):
+    """Restore the full {'params','opt_state','epoch'} state; `like` provides the
+    pytree structure (must use the same optimizer that produced the checkpoint)."""
+    params = load_params(ckpt_path, like["params"])
+    aux_path = os.path.join(ckpt_path, "aux.npz")
+    out = {"params": params, "opt_state": like.get("opt_state"), "epoch": 0}
+    if os.path.isfile(aux_path):
+        data = np.load(aux_path)
+        out["epoch"] = int(data["epoch"])
+        if like.get("opt_state") is not None:
+            leaves, treedef = jax.tree_util.tree_flatten(like["opt_state"])
+            n_saved = sum(1 for k in data.files if k.startswith("arr_"))
+            if n_saved == len(leaves):
+                restored = [data[f"arr_{i}"] for i in range(len(leaves))]
+                out["opt_state"] = jax.tree_util.tree_unflatten(treedef, restored)
+            else:
+                raise ValueError(
+                    f"checkpoint at {ckpt_path} was saved with a different optimizer "
+                    f"({n_saved} state leaves vs {len(leaves)} expected); restore with "
+                    "the same `opt`, or load weights only via load_params")
+    return out

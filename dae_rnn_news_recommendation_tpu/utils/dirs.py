@@ -1,0 +1,24 @@
+"""Run directory layout, matching the reference artifact tree
+(autoencoder/autoencoder.py:544-564):
+
+    results/<algo_name>/<main_dir>/{models, data, logs, data/tsv, data/plot}
+"""
+
+import os
+
+
+def create_run_directories(algo_name, main_dir, root="results"):
+    algo = algo_name if algo_name.endswith("/") else algo_name + "/"
+    main = main_dir if main_dir.endswith("/") else main_dir + "/"
+    base = os.path.join(root, algo + main)
+
+    models_dir = os.path.join(base, "models/")
+    data_dir = os.path.join(base, "data/")
+    summary_dir = os.path.join(base, "logs/")
+    tsv_dir = os.path.join(data_dir, "tsv/")
+    plot_dir = os.path.join(data_dir, "plot/")
+
+    for d in (models_dir, data_dir, summary_dir, tsv_dir, plot_dir):
+        os.makedirs(d, exist_ok=True)
+
+    return models_dir, data_dir, summary_dir, tsv_dir, plot_dir

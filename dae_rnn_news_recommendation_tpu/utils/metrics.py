@@ -1,0 +1,70 @@
+"""Observability: scalar/histogram metrics writer.
+
+Twin of the reference's TensorBoard summaries (autoencoder.py:391-393, :431-442,
+:172-173: scalar losses per train step, histograms of W/biases/embeddings, separate
+train/validation writers). Primary sink is newline-delimited JSON under
+logs/{train,validation}/metrics.jsonl — dependency-free and machine-readable; a
+TensorBoard event sink is attached automatically when `tensorboard` is importable.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+try:  # optional TensorBoard sink
+    from torch.utils.tensorboard import SummaryWriter as _TBWriter
+except Exception:  # pragma: no cover
+    _TBWriter = None
+
+
+class MetricsWriter:
+    def __init__(self, logdir, use_tensorboard=True):
+        os.makedirs(logdir, exist_ok=True)
+        self._path = os.path.join(logdir, "metrics.jsonl")
+        self._f = open(self._path, "a", buffering=1)
+        self._tb = None
+        if use_tensorboard and _TBWriter is not None:
+            try:
+                self._tb = _TBWriter(log_dir=logdir)
+            except Exception:
+                self._tb = None
+
+    def scalar(self, tag, value, step):
+        rec = {"tag": tag, "value": float(value), "step": int(step), "ts": time.time()}
+        self._f.write(json.dumps(rec) + "\n")
+        if self._tb is not None:
+            self._tb.add_scalar(tag, float(value), int(step))
+
+    def scalars(self, mapping, step):
+        for tag, value in mapping.items():
+            self.scalar(tag, value, step)
+
+    def histogram(self, tag, values, step):
+        """Summary-stats histogram (the reference logs full TB histograms; JSONL keeps
+        min/max/mean/std/percentiles, TB sink keeps the full histogram)."""
+        v = np.asarray(values).ravel()
+        rec = {
+            "tag": tag, "step": int(step), "ts": time.time(),
+            "hist": {
+                "min": float(v.min()), "max": float(v.max()),
+                "mean": float(v.mean()), "std": float(v.std()),
+                "p5": float(np.percentile(v, 5)), "p50": float(np.percentile(v, 50)),
+                "p95": float(np.percentile(v, 95)), "n": int(v.size),
+            },
+        }
+        self._f.write(json.dumps(rec) + "\n")
+        if self._tb is not None:
+            self._tb.add_histogram(tag, v, int(step))
+
+    def close(self):
+        self._f.close()
+        if self._tb is not None:
+            self._tb.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,12 @@
+"""Parameter provenance file, matching reference autoencoder.py:101-124: every
+hyperparameter appended (restore) or written (fresh) as key=value lines under a
+dashed separator, so runs are auditable from logs/parameter.txt alone."""
+
+
+def write_parameter_file(path, params, append=False):
+    """:param params: ordered dict of name -> value"""
+    mode = "a+" if append else "w"
+    with open(path, mode) as f:
+        print("---------------------------------------", file=f)
+        for k, v in params.items():
+            print(f"{k}={v}", file=f)

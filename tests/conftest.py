@@ -1,13 +1,22 @@
-"""Test harness: force JAX onto CPU with 8 virtual devices BEFORE jax is imported,
-so pjit/shard_map mesh tests run without TPU hardware (SURVEY.md §4 multi-node story).
+"""Test harness: force JAX onto CPU with 8 virtual devices so pjit/shard_map mesh
+tests run without TPU hardware (SURVEY.md §4 multi-node story).
+
+Note: this environment pre-imports jax at interpreter startup (PYTHONPATH site hook)
+with JAX_PLATFORMS=axon pointing at a real TPU. Backends initialize lazily, so
+flipping the platform via jax.config BEFORE any device use still works — env vars
+alone do not, because the env was already read.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

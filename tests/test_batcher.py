@@ -1,0 +1,123 @@
+"""Batcher tests, modeled on reference autoencoder/tests/test_utils.py:11-106: the
+identity-column trick (data column 0 = row index) verifies (data, label) alignment
+after shuffling; exact-coverage check verifies every row appears exactly once."""
+
+import numpy as np
+import pandas as pd
+import pytest
+import scipy.sparse as sp
+
+from dae_rnn_news_recommendation_tpu.data import batcher as B
+
+N, F = 23, 6
+
+
+def _identity_data(kind):
+    x = np.zeros((N, F), np.float32)
+    x[:, 0] = np.arange(N)
+    x[:, 1:] = np.random.default_rng(0).uniform(1, 2, (N, F - 1))
+    if kind == "csr":
+        return sp.csr_matrix(x)
+    if kind == "df":
+        return pd.DataFrame(x)
+    return x
+
+
+@pytest.mark.parametrize("kind", ["ndarray", "csr", "df"])
+@pytest.mark.parametrize("batch_size", [4, 0.3])
+@pytest.mark.parametrize("label_kind", [None, "np1d", "np2d", "series", "df"])
+def test_padded_batcher_alignment(kind, batch_size, label_kind):
+    data = _identity_data(kind)
+    labels = None
+    if label_kind == "np1d":
+        labels = np.arange(N)
+    elif label_kind == "np2d":
+        labels = np.arange(N).reshape(-1, 1)
+    elif label_kind == "series":
+        labels = pd.Series(np.arange(N))
+    elif label_kind == "df":
+        labels = pd.DataFrame(np.arange(N))
+
+    row_show = np.zeros(N)
+    for batch in B.PaddedBatcher(batch_size, seed=1).epoch(data, labels):
+        x, valid = batch["x"], batch["row_valid"]
+        bsz = x.shape[0]
+        assert valid.shape == (bsz,)
+        real = valid > 0
+        ids = x[real, 0].astype(int)
+        row_show[ids] += 1
+        # padded rows are all-zero
+        np.testing.assert_array_equal(x[~real], 0.0)
+        if labels is not None:
+            lab = batch["labels"]
+            # label rides with its row through the shuffle
+            np.testing.assert_array_equal(lab[real], ids)
+            np.testing.assert_array_equal(lab[~real], -1)
+    assert row_show.sum() == N
+    assert (row_show == 1).all()
+
+
+def test_batch_shapes_are_static():
+    data = _identity_data("ndarray")
+    shapes = {b["x"].shape for b in B.PaddedBatcher(4, seed=0).epoch(data)}
+    assert shapes == {(4, F)}  # 23 rows -> 6 batches, last one padded
+
+
+def test_mesh_batch_multiple_rounds_up():
+    data = _identity_data("ndarray")
+    shapes = {b["x"].shape for b in B.PaddedBatcher(6, seed=0, mesh_batch_multiple=8).epoch(data)}
+    assert shapes == {(8, F)}
+
+
+def test_resolve_batch_size():
+    assert B.resolve_batch_size(4, 100) == 4
+    assert B.resolve_batch_size(0.3, 23) == max(round(23 * 0.3), 1)
+    assert B.resolve_batch_size(0.0001, 100) == 1
+    with pytest.raises(AssertionError):
+        B.resolve_batch_size(0, 10)
+
+
+@pytest.mark.parametrize("batch_size", [4, 0.3])
+def test_gen_batches_parity(batch_size):
+    """Reference-compatible generator keeps ragged shapes and type fidelity."""
+    data = _identity_data("ndarray")
+    corr = data * 0.5
+    labels = np.arange(N)
+    seen = []
+    for x, xc, lab in B.gen_batches(data, corr, batch_size, data_label=labels, seed=3):
+        np.testing.assert_allclose(xc, x * 0.5)
+        np.testing.assert_array_equal(lab, x[:, 0].astype(int))
+        seen.extend(x[:, 0].astype(int))
+    assert sorted(seen) == list(range(N))
+
+
+def test_gen_batches_triplet_shared_shuffle():
+    org = _identity_data("ndarray")
+    d = {"org": org, "pos": org + 100, "neg": org + 200}
+    dc = {k: v for k, v in d.items()}
+    for (xs, xcs) in B.gen_batches_triplet(d, dc, 5, seed=4):
+        base = xs[0][:, 0]
+        np.testing.assert_array_equal(xs[1][:, 0], base + 100)
+        np.testing.assert_array_equal(xs[2][:, 0], base + 200)
+
+
+def test_triplet_padded_batcher_alignment():
+    org = _identity_data("csr")
+    data = {"org": org, "pos": sp.csr_matrix(org.toarray() + 100),
+            "neg": sp.csr_matrix(org.toarray() + 200)}
+    row_show = np.zeros(N)
+    for batch in B.TripletPaddedBatcher(5, seed=5).epoch(data):
+        real = batch["row_valid"] > 0
+        base = batch["org"][real, 0]
+        np.testing.assert_array_equal(batch["pos"][real, 0], base + 100)
+        np.testing.assert_array_equal(batch["neg"][real, 0], base + 200)
+        row_show[base.astype(int)] += 1
+    assert (row_show == 1).all()
+
+
+def test_densify_rows_types():
+    x = np.eye(5, dtype=np.float32)
+    for data in (x, sp.csr_matrix(x), pd.DataFrame(x)):
+        out = B.densify_rows(data, np.array([2, 0]))
+        assert out.dtype == np.float32
+        np.testing.assert_array_equal(out, x[[2, 0]])

@@ -1,0 +1,65 @@
+"""IO round-trip tests over the (type x format) matrix, modeled on reference
+tests/test_helpers.py:8-61."""
+
+import numpy as np
+import pandas as pd
+import pytest
+import scipy.sparse as sp
+
+from dae_rnn_news_recommendation_tpu.data import read_file, save_file
+
+
+@pytest.fixture
+def arr():
+    return np.random.default_rng(0).uniform(size=(6, 4))
+
+
+@pytest.mark.parametrize("fmt", ["csv", "tsv", "npy"])
+def test_numpy_roundtrip(arr, fmt, tmp_path):
+    path = tmp_path / f"a.{fmt}"
+    save_file(arr, path)
+    back = read_file(path, data_type="numpy")
+    np.testing.assert_allclose(back, arr, rtol=1e-6)
+
+
+@pytest.mark.parametrize("fmt", ["csv", "tsv", "npz"])
+def test_scipy_roundtrip(arr, fmt, tmp_path):
+    m = sp.csr_matrix(np.where(arr > 0.5, arr, 0))
+    path = tmp_path / f"s.{fmt}"
+    save_file(m, path)
+    back = read_file(path, data_type="scipy")
+    assert sp.issparse(back)
+    np.testing.assert_allclose(back.toarray(), m.toarray(), rtol=1e-6)
+
+
+@pytest.mark.parametrize("fmt", ["csv", "tsv", "parquet", "pkl"])
+def test_dataframe_roundtrip(arr, fmt, tmp_path):
+    df = pd.DataFrame(arr, columns=[f"c{i}" for i in range(arr.shape[1])])
+    path = tmp_path / f"d.{fmt}"
+    save_file(df, path)
+    back = read_file(path, data_type="pandas_df")
+    np.testing.assert_allclose(back.values, df.values, rtol=1e-6)
+
+
+@pytest.mark.parametrize("fmt", ["csv", "tsv", "pkl"])
+def test_series_roundtrip(arr, fmt, tmp_path):
+    s = pd.Series(arr[:, 0])
+    path = tmp_path / f"x.{fmt}"
+    save_file(s, path)
+    back = read_file(path, data_type="pandas_series")
+    np.testing.assert_allclose(np.asarray(back), s.values, rtol=1e-6)
+
+
+def test_format_autodetect(tmp_path, arr):
+    save_file(arr, tmp_path / "a.npy")
+    assert isinstance(read_file(tmp_path / "a.npy"), np.ndarray)
+    m = sp.csr_matrix(arr)
+    save_file(m, tmp_path / "m.npz")
+    assert sp.issparse(read_file(tmp_path / "m.npz"))
+
+
+def test_unsupported_combo_raises(tmp_path, arr):
+    with pytest.raises(AssertionError):
+        save_file(arr, tmp_path / "a.parquet")
+    with pytest.raises(AssertionError):
+        read_file(tmp_path / "nope.csv")

@@ -1,0 +1,208 @@
+"""Model core + estimator tests: the paper-encoder property, train-step learning,
+checkpoint resume, reference API surface (fit/transform/load_model/get_model_parameters),
+triplet estimator, stacked DAE, GRU user model."""
+
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu.models import (
+    DAEConfig, DenoisingAutoencoder, DenoisingAutoencoderTriplet,
+    GRUUserModel, StackedDenoisingAutoencoder, init_params, encode, forward,
+)
+from dae_rnn_news_recommendation_tpu.train import make_optimizer, make_train_step
+
+
+def _cfg(**kw):
+    base = dict(n_features=32, n_components=8, enc_act_func="tanh",
+                dec_act_func="none", loss_func="mean_squared",
+                corr_type="none", corr_frac=0.0, triplet_strategy="none")
+    base.update(kw)
+    return DAEConfig(**base)
+
+
+def test_encode_zero_is_zero():
+    """H = f(Wx+b) - f(b) guarantees encode(0) == 0 (reference autoencoder.py:389) —
+    the property padding correctness relies on."""
+    for act in ("sigmoid", "tanh", "none"):
+        cfg = _cfg(enc_act_func=act)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        params["bh"] = jnp.asarray(np.random.default_rng(0).normal(size=8), jnp.float32)
+        h = encode(params, jnp.zeros((3, 32)), cfg)
+        np.testing.assert_allclose(np.asarray(h), 0.0, atol=1e-7)
+
+
+def test_forward_shapes_and_tied_weights():
+    cfg = _cfg(matmul_precision="highest")
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    x = jnp.asarray(np.random.default_rng(1).uniform(size=(5, 32)), jnp.float32)
+    h, y = forward(params, x, cfg)
+    assert h.shape == (5, 8) and y.shape == (5, 32)
+    # decode uses W^T of the same W (tied): y = h @ W.T + bv for dec_act none
+    expect = np.asarray(h) @ np.asarray(params["W"]).T + np.asarray(params["bv"])
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("opt", ["gradient_descent", "ada_grad", "momentum", "adam"])
+def test_train_step_learns(opt):
+    cfg = _cfg(corr_type="masking", corr_frac=0.2)
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    optimizer = make_optimizer(opt, 0.05)
+    opt_state = optimizer.init(params)
+    step = make_train_step(cfg, optimizer)
+    x = (np.random.default_rng(2).uniform(size=(16, 32)) < 0.3).astype(np.float32)
+    batch = {"x": jnp.asarray(x), "row_valid": jnp.ones(16)}
+    key = jax.random.PRNGKey(3)
+    costs = []
+    for i in range(30):
+        key, sub = jax.random.split(key)
+        params, opt_state, metrics = step(params, opt_state, sub, batch)
+        costs.append(float(metrics["cost"]))
+    assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+
+def test_train_step_triplet_strategies():
+    labels = np.random.default_rng(3).integers(0, 3, 16).astype(np.int32)
+    x = (np.random.default_rng(4).uniform(size=(16, 32)) < 0.3).astype(np.float32)
+    for strategy in ("batch_all", "batch_hard"):
+        cfg = _cfg(triplet_strategy=strategy, alpha=1.0)
+        params = init_params(jax.random.PRNGKey(4), cfg)
+        optimizer = make_optimizer("ada_grad", 0.1)
+        opt_state = optimizer.init(params)
+        step = make_train_step(cfg, optimizer)
+        batch = {"x": jnp.asarray(x), "labels": jnp.asarray(labels),
+                 "row_valid": jnp.ones(16)}
+        params, opt_state, metrics = step(params, opt_state, jax.random.PRNGKey(5), batch)
+        for k in ("cost", "autoencoder_loss", "triplet_loss", "fraction_triplet", "num_triplet"):
+            assert np.isfinite(float(metrics[k])), (strategy, k)
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def _fit_small(workdir, **kw):
+    rng = np.random.default_rng(0)
+    X = sp.random(60, 24, density=0.3, format="csr", random_state=0, dtype=np.float32)
+    labels = rng.integers(0, 4, 60)
+    defaults = dict(model_name="t", compress_factor=6, num_epochs=3, batch_size=16,
+                    opt="ada_grad", learning_rate=0.1, corr_type="masking",
+                    corr_frac=0.3, verbose=False, verbose_step=2, seed=11,
+                    triplet_strategy="batch_all", use_tensorboard=False)
+    defaults.update(kw)
+    m = DenoisingAutoencoder(**defaults)
+    m.fit(X, validation_set=X[:20], train_set_label=labels,
+          validation_set_label=labels[:20])
+    return m, X, labels
+
+
+def test_estimator_end_to_end(workdir):
+    m, X, labels = _fit_small(workdir)
+    enc = m.transform(X, name="enc", save=True)
+    assert enc.shape == (60, 4)
+    assert np.isfinite(enc).all()
+    # artifact tree (reference autoencoder.py:544-564)
+    for d in (m.models_dir, m.data_dir, m.tf_summary_dir, m.tsv_dir, m.plot_dir):
+        assert os.path.isdir(d)
+    assert os.path.isfile(m.parameter_file)
+    assert os.path.isfile(os.path.join(m.data_dir, "enc.npy"))
+    assert os.path.isfile(os.path.join(m.tf_summary_dir, "train/metrics.jsonl"))
+    p = m.get_model_parameters()
+    assert p["enc_w"].shape == (24, 4)
+    assert p["enc_b"].shape == (4,)
+    assert p["dec_b"].shape == (24,)
+
+
+def test_estimator_restore_continues(workdir):
+    m, X, labels = _fit_small(workdir)
+    w0 = m.get_model_parameters()["enc_w"]
+    m2 = DenoisingAutoencoder(model_name="t", compress_factor=6, num_epochs=2,
+                              batch_size=16, opt="ada_grad", learning_rate=0.1,
+                              verbose=False, seed=11, triplet_strategy="batch_all",
+                              use_tensorboard=False)
+    m2.fit(X, train_set_label=labels, restore_previous_model=True)
+    assert m2._epoch0 == 3  # resumed from epoch 3
+    w1 = m2.get_model_parameters()["enc_w"]
+    assert not np.allclose(w0, w1)  # training continued
+
+
+def test_estimator_dense_input_and_none_strategy(workdir):
+    X = (np.random.default_rng(1).uniform(size=(40, 24)) < 0.3).astype(np.float32)
+    m = DenoisingAutoencoder(model_name="d", compress_factor=6, num_epochs=2,
+                             batch_size=10, enc_act_func="sigmoid",
+                             dec_act_func="sigmoid", loss_func="cross_entropy",
+                             verbose=False, seed=1, triplet_strategy="none",
+                             use_tensorboard=False)
+    m.fit(X)
+    enc = m.transform(X)
+    assert enc.shape == (40, 4)
+
+
+def test_load_model_roundtrip(workdir):
+    m, X, _ = _fit_small(workdir)
+    enc1 = m.transform(X)
+    m2 = DenoisingAutoencoder(model_name="t", use_tensorboard=False, verbose=False)
+    m2.load_model((24, 4), m.model_path)
+    enc2 = m2.transform(X, from_checkpoint=False)
+    np.testing.assert_allclose(enc1, enc2, rtol=1e-5, atol=1e-6)
+
+
+def test_triplet_estimator(workdir):
+    rng = np.random.default_rng(2)
+    org = sp.random(40, 24, density=0.3, format="csr", random_state=1, dtype=np.float32)
+    pos = sp.random(40, 24, density=0.3, format="csr", random_state=2, dtype=np.float32)
+    neg = sp.random(40, 24, density=0.3, format="csr", random_state=3, dtype=np.float32)
+    train = {"org": org, "pos": pos, "neg": neg}
+    m = DenoisingAutoencoderTriplet(model_name="trip", compress_factor=6, num_epochs=3,
+                                    batch_size=10, opt="ada_grad", learning_rate=0.1,
+                                    corr_type="masking", corr_frac=0.2, verbose=False,
+                                    seed=5, alpha=1, use_tensorboard=False)
+    m.fit(train, validation_set={k: v[:10] for k, v in train.items()})
+    enc = m.transform(org)
+    assert enc.shape == (40, 4)
+    assert np.isfinite(enc).all()
+
+
+def test_stacked_dae():
+    X = (np.random.default_rng(3).uniform(size=(50, 32)) < 0.3).astype(np.float32)
+    m = StackedDenoisingAutoencoder([12, 6], num_epochs=2, batch_size=16,
+                                    corr_frac=0.2, seed=0)
+    m.fit(X)
+    code = m.encode(X)
+    assert code.shape == (50, 6)
+    # zero input -> zero code at every depth
+    z = m.encode(np.zeros((2, 32), np.float32))
+    np.testing.assert_allclose(z, 0.0, atol=1e-6)
+
+
+def test_gru_user_model_learns():
+    rng = np.random.default_rng(4)
+    N, T, D = 64, 5, 8
+    # synthetic: positive articles align with the mean of the browse history
+    seq = rng.normal(size=(N, T, D)).astype(np.float32)
+    pos = seq + 0.1 * rng.normal(size=(N, T, D)).astype(np.float32)
+    neg = -seq + 0.1 * rng.normal(size=(N, T, D)).astype(np.float32)
+    mask = np.ones((N, T), np.float32)
+    mask[:, -1] = 0.0  # ragged tails
+
+    m = GRUUserModel(d_embed=D, d_hidden=8, num_epochs=1, batch_size=32, seed=0)
+    from dae_rnn_news_recommendation_tpu.models.gru_user import pairwise_rank_loss
+    import jax.numpy as jnp
+    m.fit(seq, pos, neg, mask)
+    l1 = float(pairwise_rank_loss(m.params, jnp.asarray(seq), jnp.asarray(pos),
+                                  jnp.asarray(neg), jnp.asarray(mask)))
+    m2 = GRUUserModel(d_embed=D, d_hidden=8, num_epochs=8, batch_size=32, seed=0)
+    m2.fit(seq, pos, neg, mask)
+    l2 = float(pairwise_rank_loss(m2.params, jnp.asarray(seq), jnp.asarray(pos),
+                                  jnp.asarray(neg), jnp.asarray(mask)))
+    assert l2 < l1, (l1, l2)
+    states = m2.user_state(seq, mask)
+    assert states.shape == (N, 8)
+    scores = m2.score(seq, rng.normal(size=(7, 8)).astype(np.float32), mask)
+    assert scores.shape == (N, 7)
