@@ -1,0 +1,242 @@
+"""End-to-end experiment driver: data prep -> DAE fit (online triplet mining) ->
+encode -> AUROC plots -> nearest-neighbor printout.
+
+Twin of reference main_autoencoder.py (flags :23-111, data prep :161-263, fit :277,
+eval tail :303-360), with its known defects fixed rather than replicated:
+  - restore path actually appends the validation rows (SURVEY §2.3.3)
+  - validation labels come from the validation split, not the train split (§2.3.2)
+  - corr_type/corr_frac env keys are wired correctly (§2.3.1, in utils/config.py)
+
+Run: python -m dae_rnn_news_recommendation_tpu.cli.main_autoencoder \
+        --model_name uci --verbose --synthetic --num_epochs 5
+"""
+
+import os
+
+import joblib
+import numpy as np
+import pandas as pd
+
+from ..data import articles, io as hio
+from ..eval import nearest_neighbor_report, pairwise_similarity, visualize_pairwise_similarity
+from ..models import DenoisingAutoencoder
+from ..ops.corruption import decay_noise
+from ..utils.config import parse_flags
+
+
+def prepare_or_restore_data(model, FLAGS):
+    """Reference main_autoencoder.py:161-263."""
+    train_row, validate_row = FLAGS.train_row, FLAGS.validate_row
+
+    if FLAGS.restore_previous_data:
+        d = model.data_dir
+        article_contents = pd.concat([
+            hio.read_file(d + "article.snappy.parquet"),
+            hio.read_file(d + "article_validate.snappy.parquet"),
+        ])
+        X = hio.read_file(d + "article_binary_count_vectorized.npz")
+        X_validate = hio.read_file(d + "article_binary_count_vectorized_validate.npz")
+        labels = {
+            ("category_publish_name", "train"): hio.read_file(
+                d + "article_label_category_publish_name.pkl", data_type="pandas_series"),
+            ("category_publish_name", "validate"): hio.read_file(
+                d + "article_label_category_publish_name_validate.pkl", data_type="pandas_series"),
+            ("story", "train"): hio.read_file(
+                d + "article_label_story.pkl", data_type="pandas_series"),
+            ("story", "validate"): hio.read_file(
+                d + "article_label_story_validate.pkl", data_type="pandas_series"),
+        }
+        X_tfidf = hio.read_file(d + "article_tfidf_vectorized.npz")
+        X_tfidf_validate = hio.read_file(d + "article_tfidf_vectorized_validate.npz")
+        return article_contents, X, X_validate, X_tfidf, X_tfidf_validate, labels
+
+    if FLAGS.synthetic:
+        n = train_row + validate_row
+        article_contents = articles.synthetic_articles(
+            n_articles=max(n, 100), seed=max(FLAGS.seed, 0))
+    else:
+        article_contents = articles.read_articles(path=FLAGS.data_path)
+    article_contents = article_contents.sort_index(ascending=False)
+
+    # label engineering (reference :180-198)
+    story_counts = article_contents.story.value_counts()
+    story_idx = article_contents.story.isin(story_counts[story_counts > 0].index)
+    article_contents["label_story_valid"] = 0
+    article_contents.loc[story_idx, "label_story_valid"] = 1
+    article_contents["label_story"] = pd.factorize(article_contents.story)[0]
+
+    cate = article_contents.category_publish_name.map(lambda s: s.lstrip("即時"))
+    cate_counts = article_contents.category_publish_name.value_counts()
+    cate_idx = article_contents.category_publish_name.isin(
+        cate_counts[cate_counts > 0].index)
+    article_contents["label_category_publish_name_valid"] = 0
+    article_contents.loc[cate_idx, "label_category_publish_name_valid"] = 1
+    article_contents["label_category_publish_name"] = pd.factorize(cate)[0]
+
+    if FLAGS.triplet_strategy != "none":
+        article_contents = article_contents.loc[
+            article_contents["label_" + FLAGS.label + "_valid"] == 1, ]
+
+    article_contents = (article_contents.iloc[: train_row + validate_row]
+                        .sample(frac=1, random_state=max(FLAGS.seed, 0)))
+    article_contents = article_contents.sort_values("article_id")
+    train_row = min(train_row, len(article_contents))
+
+    count_vectorizer, X, _, _ = articles.count_vectorize(
+        article_contents.main_content[:train_row],
+        tokenizer=None, stop_words="english",
+        min_df=FLAGS.min_df, max_df=FLAGS.max_df,
+        max_features=FLAGS.max_features, binary=False)
+    X_validate = count_vectorizer.transform(
+        article_contents.main_content[train_row : train_row + validate_row])
+    tfidf_transformer, X_tfidf = articles.tfidf_transform(X)
+    X_tfidf_validate = tfidf_transformer.transform(X_validate)
+
+    labels = {}
+    for lab in ("category_publish_name", "story"):
+        labels[(lab, "train")] = article_contents["label_" + lab][:train_row]
+        labels[(lab, "validate")] = article_contents["label_" + lab][
+            train_row : train_row + validate_row]
+
+    # save artifacts (reference :227-244)
+    d = model.data_dir
+    hio.save_file(article_contents.iloc[:train_row], d + "article.snappy.parquet")
+    hio.save_file(article_contents.iloc[train_row : train_row + validate_row],
+                  d + "article_validate.snappy.parquet")
+    hio.save_file(labels[("category_publish_name", "train")],
+                  d + "article_label_category_publish_name.pkl")
+    hio.save_file(labels[("category_publish_name", "validate")],
+                  d + "article_label_category_publish_name_validate.pkl")
+    hio.save_file(labels[("story", "train")], d + "article_label_story.pkl")
+    hio.save_file(labels[("story", "validate")], d + "article_label_story_validate.pkl")
+    hio.save_file(X, d + "article_count_vectorized.npz")
+    hio.save_file(X_validate, d + "article_count_vectorized_validate.npz")
+    X = X.copy(); X.data = np.ones_like(X.data)
+    X_validate = X_validate.copy(); X_validate.data = np.ones_like(X_validate.data)
+    hio.save_file(X, d + "article_binary_count_vectorized.npz")
+    hio.save_file(X_validate, d + "article_binary_count_vectorized_validate.npz")
+    hio.save_file(X_tfidf, d + "article_tfidf_vectorized.npz")
+    hio.save_file(X_tfidf_validate, d + "article_tfidf_vectorized_validate.npz")
+    joblib.dump(count_vectorizer, d + "count_vectorizer.joblib")
+    joblib.dump(tfidf_transformer, d + "tfidf_transformer.joblib")
+
+    return article_contents, X, X_validate, X_tfidf, X_tfidf_validate, labels
+
+
+def main(argv=None):
+    FLAGS = parse_flags(argv)
+    print(__file__ + ": Start")
+
+    model = DenoisingAutoencoder(
+        seed=FLAGS.seed, model_name=FLAGS.model_name,
+        compress_factor=FLAGS.compress_factor, enc_act_func=FLAGS.enc_act_func,
+        dec_act_func=FLAGS.dec_act_func, xavier_init=FLAGS.xavier_init,
+        corr_type=FLAGS.corr_type, corr_frac=FLAGS.corr_frac,
+        loss_func=FLAGS.loss_func, main_dir=FLAGS.main_dir, opt=FLAGS.opt,
+        learning_rate=FLAGS.learning_rate, momentum=FLAGS.momentum,
+        verbose=FLAGS.verbose, verbose_step=FLAGS.verbose_step,
+        num_epochs=FLAGS.num_epochs, batch_size=FLAGS.batch_size,
+        alpha=FLAGS.alpha, triplet_strategy=FLAGS.triplet_strategy,
+        n_devices=FLAGS.n_devices, mining_scope=FLAGS.mining_scope,
+        compute_dtype=FLAGS.compute_dtype, checkpoint_every=FLAGS.checkpoint_every)
+
+    (article_contents, X, X_validate, X_tfidf, X_tfidf_validate,
+     labels) = prepare_or_restore_data(model, FLAGS)
+
+    data_dict = {
+        "binary": {"train": X, "validate": X_validate},
+        "tfidf": {"train": X_tfidf, "validate": X_tfidf_validate},
+        "label_category_publish_name": {
+            "train": labels[("category_publish_name", "train")],
+            "validate": labels[("category_publish_name", "validate")]},
+        "label_story": {"train": labels[("story", "train")],
+                        "validate": labels[("story", "validate")]},
+    }
+
+    trX = data_dict[FLAGS.input_format]["train"]
+    trX_label = data_dict["label_" + FLAGS.label]["train"]
+    vlX = vlX_label = None
+    if FLAGS.validation:
+        vlX = data_dict[FLAGS.input_format]["validate"]
+        # fixed: the reference fed TRAIN labels here (SURVEY §2.3.2)
+        vlX_label = data_dict["label_" + FLAGS.label]["validate"]
+
+    print("fit")
+    model.fit(train_set=trX, validation_set=vlX, train_set_label=trX_label,
+              validation_set_label=vlX_label,
+              restore_previous_model=FLAGS.restore_previous_model)
+    with open(model.parameter_file, "a+") as f:
+        for k in ("train_row", "validate_row", "input_format", "label",
+                  "restore_previous_data", "restore_previous_model"):
+            print(f"{k}={getattr(FLAGS, k)}", file=f)
+    print("fit done")
+
+    # encode with expected-value scaling of the masking corruption (reference :289-290)
+    X_encoded = model.transform(
+        np.asarray(decay_noise(data_dict[FLAGS.input_format]["train"], FLAGS.corr_frac).todense()),
+        name="article_encoded", save=FLAGS.encode_full)
+    X_encoded_validate = model.transform(
+        np.asarray(decay_noise(data_dict[FLAGS.input_format]["validate"], FLAGS.corr_frac).todense()),
+        name="article_encoded_validate", save=FLAGS.encode_full)
+
+    if FLAGS.save_tsv:
+        hio.save_file(X_tfidf, model.tsv_dir + "article_tfidf_vectorized.tsv")
+        hio.save_file(X_tfidf_validate, model.tsv_dir + "article_tfidf_vectorized_validate.tsv")
+        hio.save_file(X, model.tsv_dir + "article_binary_count_vectorized.tsv")
+        hio.save_file(X_validate, model.tsv_dir + "article_binary_count_vectorized_validate.tsv")
+        cols = ["label_story", "label_category_publish_name", "title", "story",
+                "category_publish_name"]
+        n_train = len(labels[("category_publish_name", "train")])
+        hio.save_file(article_contents.iloc[:n_train][cols],
+                      model.tsv_dir + "article_label.tsv")
+        hio.save_file(article_contents.iloc[n_train:][cols],
+                      model.tsv_dir + "article_label_validate.tsv")
+        hio.save_file(X_encoded, model.tsv_dir + "article_encoded.tsv")
+        hio.save_file(X_encoded_validate, model.tsv_dir + "article_encoded_validate.tsv")
+
+    print("calculate similarity")
+    sims = {
+        "binary_count": pairwise_similarity(X, metric="cosine"),
+        "binary_count_validate": pairwise_similarity(X_validate, metric="cosine"),
+        "tfidf": pairwise_similarity(X_tfidf, metric="linear kernel"),
+        "tfidf_validate": pairwise_similarity(X_tfidf_validate, metric="linear kernel"),
+        "encoded": pairwise_similarity(X_encoded, metric="cosine"),
+        "encoded_validate": pairwise_similarity(X_encoded_validate, metric="cosine"),
+    }
+    print("calculate similarity done")
+
+    print("plot")
+    aurocs = {}
+    for lab in ("label_category_publish_name", "label_story"):
+        suffix = "(Category)" if lab == "label_category_publish_name" else "(Story)"
+        for kind, name in (("tfidf", "TFIDF Vectorized"),
+                           ("binary_count", "Binary Count Vectorized"),
+                           ("encoded", "Encoded")):
+            for split in ("train", "validate"):
+                sim = sims[kind if split == "train" else kind + "_validate"]
+                key = f"similarity_boxplot_{kind}{'_validate' if split=='validate' else ''}{suffix}"
+                aurocs[key] = visualize_pairwise_similarity(
+                    np.asarray(data_dict[lab][split]), sim, plot="boxplot",
+                    title=f"Cosine Similarity ({name}) ({split.title()} Data){suffix}",
+                    save_path=model.plot_dir + key + ".png")
+    print("plot done")
+    for k, v in sorted(aurocs.items()):
+        print(f"AUROC {k}: {v:.4f}")
+
+    n_train = len(labels[("category_publish_name", "train")])
+    for row in nearest_neighbor_report(article_contents.iloc[:n_train],
+                                       sims["encoded"], sims["binary_count"]):
+        print(row["article"])
+        print("most similar article using count vectorizer")
+        print(row["most_similar_by_count"])
+        print("most similar article using DAE")
+        print(row["most_similar_by_embedding"])
+        print(f"score: {row['score']}")
+        print()
+
+    print(__file__ + ": End")
+    return model, aurocs
+
+
+if __name__ == "__main__":
+    main()

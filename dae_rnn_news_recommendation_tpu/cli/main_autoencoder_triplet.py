@@ -1,0 +1,124 @@
+"""Experiment driver for the precomputed-triplet DAE: per-category pos/neg article
+mapping -> three aligned matrices -> DenoisingAutoencoderTriplet fit -> same eval tail.
+
+Twin of reference main_autoencoder_triplet.py (flags :16-71, triplet prep :142-156
+via similar_articles, fit :240, eval :249-321).
+
+Run: python -m dae_rnn_news_recommendation_tpu.cli.main_autoencoder_triplet \
+        --model_name uci_triplet --verbose --synthetic --num_epochs 5
+"""
+
+import numpy as np
+import pandas as pd
+
+from ..data import articles, io as hio
+from ..eval import pairwise_similarity, visualize_pairwise_similarity
+from ..models import DenoisingAutoencoderTriplet
+from ..ops.corruption import decay_noise
+from ..utils.config import parse_flags
+
+
+def main(argv=None):
+    FLAGS = parse_flags(argv, triplet_mode=True)
+    print(__file__ + ": Start")
+
+    model = DenoisingAutoencoderTriplet(
+        seed=FLAGS.seed, model_name=FLAGS.model_name,
+        compress_factor=FLAGS.compress_factor, enc_act_func=FLAGS.enc_act_func,
+        dec_act_func=FLAGS.dec_act_func, xavier_init=FLAGS.xavier_init,
+        corr_type=FLAGS.corr_type, corr_frac=FLAGS.corr_frac,
+        loss_func=FLAGS.loss_func, main_dir=FLAGS.main_dir, opt=FLAGS.opt,
+        learning_rate=FLAGS.learning_rate, momentum=FLAGS.momentum,
+        verbose=FLAGS.verbose, verbose_step=FLAGS.verbose_step,
+        num_epochs=FLAGS.num_epochs, batch_size=FLAGS.batch_size, alpha=FLAGS.alpha,
+        n_devices=FLAGS.n_devices, compute_dtype=FLAGS.compute_dtype,
+        checkpoint_every=FLAGS.checkpoint_every)
+
+    train_row, validate_row = FLAGS.train_row, FLAGS.validate_row
+
+    if FLAGS.synthetic:
+        article_contents = articles.synthetic_articles(
+            n_articles=max(train_row + validate_row, 100), seed=max(FLAGS.seed, 0))
+    else:
+        article_contents = articles.read_articles(path=FLAGS.data_path)
+
+    # label engineering (same as the online-mining driver)
+    article_contents["label_story"] = pd.factorize(article_contents.story)[0]
+    article_contents["label_category_publish_name"] = pd.factorize(
+        article_contents.category_publish_name.map(lambda s: s.lstrip("即時")))[0]
+
+    # per-category positive/negative mapping (reference similar_articles)
+    article_contents = articles.similar_articles(
+        article_contents, id_colname="article_id",
+        cate_colname="category_publish_name", min_cate=2,
+        seed=max(FLAGS.seed, 0))
+    valid = article_contents[article_contents.valid_triplet_data == 1]
+    valid = valid.iloc[: train_row + validate_row]
+    train_row = min(train_row, len(valid))
+
+    content = article_contents.main_content
+    org_series = valid.main_content[:train_row]
+    pos_series = content.loc[valid.article_id_pos[:train_row]]
+    neg_series = content.loc[valid.article_id_neg[:train_row]]
+
+    count_vectorizer, X, X_pos, X_neg = articles.count_vectorize(
+        org_series, pos_series, neg_series,
+        tokenizer=None, stop_words="english",
+        min_df=FLAGS.min_df, max_df=FLAGS.max_df,
+        max_features=FLAGS.max_features, binary=False)
+
+    def binarize(m):
+        m = m.copy(); m.data = np.ones_like(m.data); return m
+
+    tfidf_transformer, X_tfidf = articles.tfidf_transform(X)
+    if FLAGS.input_format == "binary":
+        train = {"org": binarize(X), "pos": binarize(X_pos), "neg": binarize(X_neg)}
+        trX = binarize(X)
+    else:
+        train = {"org": X_tfidf,
+                 "pos": tfidf_transformer.transform(X_pos),
+                 "neg": tfidf_transformer.transform(X_neg)}
+        trX = X_tfidf
+
+    validation = None
+    if FLAGS.validation and len(valid) > train_row:
+        vo = content.loc[valid.article_id[train_row:]]
+        vp = content.loc[valid.article_id_pos[train_row:]]
+        vn = content.loc[valid.article_id_neg[train_row:]]
+        vo_m, vp_m, vn_m = (count_vectorizer.transform(s) for s in (vo, vp, vn))
+        if FLAGS.input_format == "binary":
+            validation = {"org": binarize(vo_m), "pos": binarize(vp_m),
+                          "neg": binarize(vn_m)}
+        else:
+            validation = {"org": tfidf_transformer.transform(vo_m),
+                          "pos": tfidf_transformer.transform(vp_m),
+                          "neg": tfidf_transformer.transform(vn_m)}
+
+    print("fit")
+    model.fit(train_set=train, validation_set=validation,
+              restore_previous_model=FLAGS.restore_previous_model)
+    print("fit done")
+
+    X_encoded = model.transform(
+        np.asarray(decay_noise(trX, FLAGS.corr_frac).todense()),
+        name="article_encoded", save=FLAGS.encode_full)
+
+    sims = {
+        "count": pairwise_similarity(trX, metric="cosine"),
+        "encoded": pairwise_similarity(X_encoded, metric="cosine"),
+    }
+    labels = valid["label_" + FLAGS.label][:train_row]
+    aurocs = {}
+    for kind, sim in sims.items():
+        aurocs[kind] = visualize_pairwise_similarity(
+            np.asarray(labels), sim, plot="boxplot",
+            title=f"Cosine Similarity ({kind}) (Triplet)",
+            save_path=model.plot_dir + f"similarity_boxplot_{kind}_triplet.png")
+        print(f"AUROC {kind}: {aurocs[kind]:.4f}")
+
+    print(__file__ + ": End")
+    return model, aurocs
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Article data pipeline: parquet -> labels -> bag-of-words / tf-idf matrices.
+
+Twin of reference datasets/articles.py: read_articles (:47-68 incl. the story-regex
+title extraction), similar_articles pos/neg mapping (:83-128), CountVectorizer /
+TfidfTransformer wrappers (:131-174), and the optional jieba Chinese tokenizer (:32-44,
+gated — jieba may be absent). sklearn stays on host: vectorization is one-time prep,
+not the compute path (SURVEY §7.5).
+
+Because the reference's uci_news.snappy.parquet is stripped from this mount
+(.MISSING_LARGE_BLOBS), `synthetic_articles` generates a UCI-news-shaped corpus
+(articles with category/story structure and Zipfian vocabulary) so every driver, test,
+and benchmark runs end to end without the blob.
+"""
+
+import numpy as np
+import pandas as pd
+from sklearn.feature_extraction.text import CountVectorizer, TfidfTransformer
+
+try:  # optional Chinese tokenizer (reference requirements.txt:6)
+    import jieba
+
+    def tokenizer_chinese(text):
+        """Reference datasets/articles.py:32-44."""
+        return [w for w in jieba.cut(text) if len(w) > 1 and not w.isdigit()]
+except Exception:  # pragma: no cover
+    jieba = None
+    tokenizer_chinese = None
+
+
+def read_articles(path):
+    """Read the article parquet, drop empty bodies, extract 'story' from the title
+    (reference datasets/articles.py:47-68)."""
+    out_df = pd.read_parquet(path)
+    out_df.index = out_df.article_id
+    out_df.index.name = None  # pandas 3.x: index label must not shadow the column
+    out_df = out_df[out_df.main_content.str.strip() != ""]
+    out_df = out_df[out_df.main_content.notna()]
+    if "story" not in out_df.columns:
+        out_df["story"] = out_df.title.str.extract("【(.*?)[（|】]")
+    return out_df
+
+
+def save_articles(in_df, save_path):
+    in_df.to_parquet(save_path)
+
+
+def similar_articles(out_df, id_colname="article_id", cate_colname="main_category_id",
+                     min_cate=2, max_cate=None, seed=None):
+    """Map a positive (next same-category article) and negative (random
+    other-category article) to every row; valid_triplet_data=1 iff both exist
+    (reference datasets/articles.py:83-128)."""
+    rng = np.random.default_rng(seed)
+    id_pos, id_neg = id_colname + "_pos", id_colname + "_neg"
+    counts = out_df[cate_colname].value_counts()
+    hi = np.inf if max_cate is None else max_cate
+    counts = counts[(counts <= hi) & (counts >= min_cate)]
+
+    out_df = out_df.copy()
+    out_df[id_pos] = 0
+    out_df[id_neg] = 0
+    for cate_id in counts.index:
+        in_cate = out_df[cate_colname] == cate_id
+        # positive: the next article in this category (shift -1)
+        shifted = out_df.loc[in_cate, id_colname].shift(-1)
+        has_pos = shifted.notna()
+        idx = shifted.index[has_pos]
+        out_df.loc[idx, id_pos] = shifted[has_pos].astype(int).to_numpy()
+        # negative: random article from any other category
+        others = out_df.loc[~in_cate, id_colname].to_numpy()
+        if len(others) and len(idx):
+            out_df.loc[idx, id_neg] = rng.choice(others, size=len(idx), replace=True)
+
+    out_df["valid_triplet_data"] = 0
+    ok = (out_df[id_pos] != 0) & out_df[id_pos].notna() & \
+         (out_df[id_neg] != 0) & out_df[id_neg].notna()
+    out_df.loc[ok, "valid_triplet_data"] = 1
+    return out_df
+
+
+def count_vectorize(in_series, in_pos_series=None, in_neg_series=None,
+                    tokenizer=tokenizer_chinese, **param_count_vectorizer):
+    """Fit a CountVectorizer on in_series; transform pos/neg with the same vocab
+    (reference datasets/articles.py:131-157)."""
+    count_vectorizer = CountVectorizer(tokenizer=tokenizer, **param_count_vectorizer)
+    X = count_vectorizer.fit_transform(in_series)
+    X_pos = None if in_pos_series is None else count_vectorizer.transform(in_pos_series)
+    X_neg = None if in_neg_series is None else count_vectorizer.transform(in_neg_series)
+    if X_pos is not None:
+        assert X.shape[1] == X_pos.shape[1]
+    if X_neg is not None:
+        assert X.shape[1] == X_neg.shape[1]
+    return count_vectorizer, X, X_pos, X_neg
+
+
+def tfidf_transform(in_matrix, **param_tfidf_transformer):
+    """Reference datasets/articles.py:160-174."""
+    tfidf_transformer = TfidfTransformer(**param_tfidf_transformer)
+    X = tfidf_transformer.fit_transform(in_matrix)
+    return tfidf_transformer, X
+
+
+# --------------------------------------------------------------------- synthetic
+
+_CATEGORIES = ["business", "science", "entertainment", "health", "technology",
+               "sports", "politics", "world"]
+
+
+def synthetic_articles(n_articles=2000, vocab_size=3000, words_per_article=80,
+                       n_stories=120, seed=0):
+    """UCI-news-shaped synthetic corpus: articles carry a category and (some) a story;
+    each category/story biases a Zipfian vocabulary slice so labels are learnable from
+    bag-of-words — the property the AUROC eval measures.
+
+    Columns match what the drivers consume (reference main_autoencoder.py:177-198):
+    article_id, title, main_content, category_publish_name, story.
+    """
+    rng = np.random.default_rng(seed)
+    vocab = np.array([f"w{i:05d}" for i in range(vocab_size)])
+    # Zipfian base distribution
+    base_p = 1.0 / np.arange(1, vocab_size + 1)
+    base_p /= base_p.sum()
+
+    cat_names = _CATEGORIES[: min(len(_CATEGORIES), 8)]
+    n_cat = len(cat_names)
+    # each category prefers a contiguous vocab slice
+    cat_slices = [np.arange(i * vocab_size // n_cat, (i + 1) * vocab_size // n_cat)
+                  for i in range(n_cat)]
+    story_ids = rng.integers(0, n_stories, n_articles)
+    has_story = rng.uniform(size=n_articles) < 0.35
+    story_slices = rng.integers(0, vocab_size - 50, n_stories)
+
+    rows = []
+    for i in range(n_articles):
+        cat = int(rng.integers(0, n_cat))
+        p = base_p.copy()
+        p[cat_slices[cat]] *= 8.0  # category signal
+        if has_story[i]:
+            s = story_slices[story_ids[i]]
+            p[s : s + 50] *= 25.0  # stronger story signal
+        p /= p.sum()
+        words = rng.choice(vocab, size=words_per_article, p=p)
+        story = f"story_{story_ids[i]:03d}" if has_story[i] else None
+        title = (f"【{story}（x】 headline {i}" if story else f"headline {i}")
+        rows.append({
+            "article_id": i + 1,
+            "title": title,
+            "main_content": " ".join(words),
+            "category_publish_name": cat_names[cat],
+            "story": story,
+        })
+    df = pd.DataFrame(rows)
+    df.index = df.article_id
+    df.index.name = None  # pandas 3.x: index label must not shadow the column
+    return df

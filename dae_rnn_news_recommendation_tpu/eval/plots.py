@@ -1,0 +1,121 @@
+"""AUROC + distribution plots for related-vs-unrelated article similarity.
+
+Twin of reference helpers.py:53-135 (visualize_scatter, visualize_pairwise_similarity):
+labels with value < 0 are treated as missing and masked out; "related" pairs share a
+label, "unrelated" pairs differ; the similarity scores of the two populations feed an
+ROC curve (AUROC is the headline quality metric) plus a boxplot/scatter panel.
+
+`related_unrelated_auroc` exposes the number without matplotlib so quality checks can
+run headless; the visualize_* functions render the reference's two-panel figure.
+"""
+
+import numpy as np
+import pandas as pd
+import scipy.sparse as sparse
+from sklearn.metrics import auc, roc_curve
+
+
+def _plt():
+    """Lazy pyplot import: keeps `related_unrelated_auroc` matplotlib-free and avoids
+    forcing a backend on importers (headless envs auto-select Agg)."""
+    from matplotlib import pyplot as plt
+
+    return plt
+
+
+def _related_unrelated(labels, sim):
+    labels = np.asarray(labels)
+    assert labels.shape[0] == sim.shape[0]
+    assert sim.shape[0] == sim.shape[1]
+    if labels.ndim == 1:
+        labels = labels[:, None]
+    not_nan = np.squeeze((labels[None, :] >= 0) & (labels[:, None] >= 0))
+    eq = np.squeeze(labels[None, :] == labels[:, None])
+    related_mask = sparse.coo_matrix(np.tril(eq & not_nan, -1))
+    related = sim[related_mask.row, related_mask.col]
+    unrelated_mask = sparse.coo_matrix(np.tril(~eq & not_nan, -1))
+    unrelated = sim[unrelated_mask.row, unrelated_mask.col]
+    return related, unrelated
+
+
+def related_unrelated_auroc(labels, sim):
+    """AUROC of 'same-label pair' vs similarity score (reference helpers.py:99-101)."""
+    related, unrelated = _related_unrelated(labels, sim)
+    if len(related) == 0 or len(unrelated) == 0:
+        return float("nan")
+    y = ["Related"] * len(related) + ["Unrelated"] * len(unrelated)
+    fpr, tpr, _ = roc_curve(y, np.concatenate([related, unrelated]),
+                            pos_label="Related")
+    return auc(fpr, tpr)
+
+
+def visualize_pairwise_similarity(labels, pairwise_similarity_metrics, plot="boxplot",
+                                  title=None, figsize=(16, 9), save_path=None,
+                                  max_data_limit=int(1e7), **plot_kwargs):
+    """ROC panel + boxplot/scatter panel (reference helpers.py:79-135). Returns the
+    AUROC."""
+    assert plot in ("scatter", "boxplot")
+    related, unrelated = _related_unrelated(labels, pairwise_similarity_metrics)
+
+    if len(related) == 0 or len(unrelated) == 0:
+        # degenerate label structure (e.g. all labels missing): no curve to draw
+        return float("nan")
+    y = ["Related"] * len(related) + ["Unrelated"] * len(unrelated)
+    fpr, tpr, _ = roc_curve(y, np.concatenate([related, unrelated]),
+                            pos_label="Related")
+    auroc = auc(fpr, tpr)
+
+    plt = _plt()
+    plt.figure(figsize=figsize)
+    plt.subplot(121)
+    plt.plot(fpr, tpr, color="darkorange", lw=2,
+             label=f"ROC curve (area = {auroc:0.2f})")
+    plt.plot([0, 1], [0, 1], color="navy", lw=2, linestyle="--")
+    plt.xlim([0.0, 1.0])
+    plt.ylim([0.0, 1.05])
+    plt.xlabel("False Positive Rate")
+    plt.ylabel("True Positive Rate")
+    plt.legend(loc="lower right")
+    if title is not None:
+        plt.title("ROC - " + title)
+
+    rng = np.random.default_rng(0)
+    if len(related) > max_data_limit:
+        related = rng.choice(related, max_data_limit, replace=False)
+    if len(unrelated) > max_data_limit:
+        unrelated = rng.choice(unrelated, max_data_limit, replace=False)
+
+    plt.subplot(122)
+    if plot == "scatter":
+        plt.scatter(["Related"] * len(related), related, **plot_kwargs)
+        plt.scatter(["Unrelated"] * len(unrelated), unrelated, **plot_kwargs)
+    else:
+        plt.boxplot([related, unrelated], **plot_kwargs)
+        plt.xticks([1, 2], labels=["Related", "Unrelated"])
+    if title is not None:
+        plt.title(title)
+
+    if save_path is not None:
+        plt.savefig(save_path)
+    plt.close()
+    return auroc
+
+
+def visualize_scatter(data_2d, label, title, figsize=(20, 20), save_path=None):
+    """2-D scatter colored by label (reference helpers.py:53-76)."""
+    plt = _plt()
+    plt.figure(figsize=figsize)
+    plt.grid()
+    codes, uniques = pd.factorize(label)
+    nb = max(len(uniques), 1)
+    for label_id in np.unique(codes):
+        pts = data_2d[codes == label_id]
+        plt.scatter(pts[:, 0], pts[:, 1], marker="o",
+                    color=plt.cm.gist_ncar((label_id + 1) / float(nb)),
+                    linewidth=1, alpha=0.8, label=str(uniques[label_id]))
+    plt.legend(loc="best")
+    if title is not None:
+        plt.title(title)
+    if save_path is not None:
+        plt.savefig(save_path)
+    plt.close()

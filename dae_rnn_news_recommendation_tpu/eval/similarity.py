@@ -1,0 +1,89 @@
+"""Pairwise similarity — the O(N^2) eval kernel, on device and blockwise.
+
+Twin of reference helpers.py:11-50 (pairwise_similarity): cosine or linear-kernel
+(dot-product) similarity with optional l1/l2/max row normalization and a zeroed
+diagonal. The reference computes the full N x N matrix in one sklearn call on host;
+here row blocks stream through the device so N is bounded by host memory for the
+output, not HBM — and on a mesh the ring variant (parallel/ring.py) shards the rows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+
+def _normalize_host(x, norm):
+    """sklearn.preprocessing.normalize semantics (reference helpers.py:42-43)."""
+    if norm == "":
+        return x
+    if norm == "l2":
+        denom = np.sqrt((x * x).sum(axis=1, keepdims=True))
+    elif norm == "l1":
+        denom = np.abs(x).sum(axis=1, keepdims=True)
+    elif norm == "max":
+        denom = np.abs(x).max(axis=1, keepdims=True)
+    else:
+        raise ValueError(f"unknown norm: {norm!r}")
+    denom = np.where(denom == 0, 1.0, denom)
+    return x / denom
+
+
+def pairwise_similarity(in_df, norm="", metric="cosine", set_diagonal_zero=True,
+                        block_size=2048, mesh=None):
+    """Pairwise similarity matrix [N, N] as float32 ndarray.
+
+    :param in_df: ndarray / scipy sparse / list — rows are items
+    :param metric: 'cosine' | 'linear kernel' (dot product, reference helpers.py:33)
+    :param mesh: optional jax Mesh — uses the ring-allgather collective instead of
+        host-blocked streaming (rows must divide the mesh size)
+    """
+    assert metric in ("cosine", "linear kernel")
+    x = in_df.toarray() if sp.issparse(in_df) else np.asarray(in_df, np.float32)
+    x = np.asarray(x, np.float32)
+    x = _normalize_host(x, norm)
+
+    if mesh is not None:
+        from ..parallel.ring import ring_pairwise_similarity
+
+        out = np.asarray(ring_pairwise_similarity(
+            jnp.asarray(x), mesh, normalize=(metric == "cosine"),
+            set_diagonal_zero=set_diagonal_zero))
+        return out
+
+    n = x.shape[0]
+    if metric == "cosine" and norm != "l2":  # l2-normed rows are already unit length
+        x = _normalize_host(x, "l2")
+
+    xd = jnp.asarray(x)
+
+    @jax.jit
+    def block(rows):
+        return jnp.matmul(rows, xd.T, precision=jax.lax.Precision.HIGHEST)
+
+    out = np.empty((n, n), np.float32)
+    for start in range(0, n, block_size):
+        out[start : start + block_size] = np.asarray(block(xd[start : start + block_size]))
+    if set_diagonal_zero:
+        np.fill_diagonal(out, 0.0)
+    return out
+
+
+def nearest_neighbor_report(article_df, sim_embed, sim_count, top=5):
+    """Top-similar-article printout rows (reference main_autoencoder.py:352-360):
+    for the first `top` articles, the most similar article under the count-vector
+    metric and under the learned embedding."""
+    count_argmax = np.nanargmax(sim_count, 1)
+    embed_argmax = np.nanargmax(sim_embed, 1)
+    rows = []
+    for i in range(min(top, len(embed_argmax))):
+        v = embed_argmax[i]
+        rows.append({
+            "article": article_df[["category_publish_name", "title"]].iloc[i].to_dict(),
+            "most_similar_by_count": article_df[["category_publish_name", "title"]]
+                .iloc[count_argmax[i]].to_dict(),
+            "most_similar_by_embedding": article_df[["category_publish_name", "title"]]
+                .iloc[v].to_dict(),
+            "score": float(sim_embed[i, v]),
+        })
+    return rows

@@ -182,7 +182,9 @@ class DenoisingAutoencoder:
             self._eval_step = make_parallel_eval_step(
                 self.config, self.mesh, mining_scope=self.mining_scope,
                 loss_fn=self._loss_fn)
-            self._batch_multiple = int(np.prod([self.mesh.devices.size]))
+            # rows shard over the data axis only — pad batches to that extent
+            self._batch_multiple = int(self.mesh.shape.get("data",
+                                                           self.mesh.devices.size))
         else:
             self._train_step = make_train_step(self.config, self.optimizer,
                                                loss_fn=self._loss_fn)
@@ -216,6 +218,8 @@ class DenoisingAutoencoder:
             assert validation_set.shape[0] == len(validation_set_label)
 
         n_features = train_set.shape[1]
+        # informational only (reference-parity attribute, autoencoder.py:143):
+        # sparse rows are densified into padded shards by the batcher either way
         self.sparse_input = not isinstance(train_set, np.ndarray)
         self._build(n_features, restore_previous_model)
         write_parameter_file(self.parameter_file, self._parameter_dict(),
@@ -245,7 +249,10 @@ class DenoisingAutoencoder:
         labels = train_set_label if self._needs_labels else None
         from ..data.batcher import resolve_batch_size
         n_rows = train_set["org"].shape[0] if isinstance(train_set, dict) else train_set.shape[0]
-        n_batches = int(np.ceil(n_rows / resolve_batch_size(self.batch_size, n_rows)))
+        b = resolve_batch_size(self.batch_size, n_rows)
+        if self._batch_multiple > 1:  # mirror the batcher's mesh round-up
+            b = int(np.ceil(b / self._batch_multiple) * self._batch_multiple)
+        n_batches = int(np.ceil(n_rows / b))
         ran_validation = False
         for e in range(self.num_epochs):
             epoch = self._epoch0 + e + 1
@@ -370,9 +377,13 @@ class DenoisingAutoencoder:
         return out
 
     def _restore_latest(self):
-        path, step = latest_checkpoint(self.model_path)
+        # honor an explicit load_model() path over this run's model_path
+        root = getattr(self, "_loaded_path", None) or self.model_path
+        path, step = latest_checkpoint(root)
+        if path is None and getattr(self, "_loaded_path", None):
+            path = self._loaded_path  # load_model was given a checkpoint dir directly
         if path is None:
-            raise FileNotFoundError(f"no checkpoint under {self.model_path}")
+            raise FileNotFoundError(f"no checkpoint under {root}")
         if self.params is None:
             raise RuntimeError("call fit() or load_model() before transform() so shapes are known")
         self.params = load_params(path, self.params)
@@ -392,6 +403,7 @@ class DenoisingAutoencoder:
         self._encode_fn = make_encode_fn(self.config)
         path, _ = latest_checkpoint(model_path)
         self.params = load_params(path or model_path, self.params)
+        self._loaded_path = model_path  # transform() restores from here, not model_path
         return self
 
     def get_model_parameters(self):

@@ -27,10 +27,17 @@ def _l2_normalize(x, axis=-1, eps=1e-12):
 
 
 def reconstruction_loss_per_row(x, decode, loss_func="cross_entropy"):
-    """Per-row reconstruction loss [B] (reference triplet_loss_utils.py:268-273)."""
+    """Per-row reconstruction loss [B] (reference triplet_loss_utils.py:268-273).
+
+    The reference guards the logs with `+ 1e-16`; under XLA fusion that guard can be
+    reassociated away ((1 - d) + eps -> (1 + eps) - d == 0 when d == 1), yielding
+    0 * log(0) = NaN — so we clip instead, which is reassociation-proof and
+    numerically identical in float32 (adding 1e-16 to any normal float32 is already
+    a no-op)."""
     if loss_func == "cross_entropy":
         return -jnp.sum(
-            x * jnp.log(decode + _EPS) + (1.0 - x) * jnp.log(1.0 - decode + _EPS),
+            x * jnp.log(jnp.clip(decode, _EPS, None))
+            + (1.0 - x) * jnp.log(jnp.clip(1.0 - decode, _EPS, None)),
             axis=1,
         )
     if loss_func == "mean_squared":
@@ -54,4 +61,5 @@ def weighted_loss(x, decode, loss_func="cross_entropy", weight=None, row_valid=N
         weight = jnp.ones(x.shape[0], dtype=per_row.dtype)
     if row_valid is not None:
         weight = weight * row_valid.astype(per_row.dtype)
-    return jnp.sum(per_row * weight) / (jnp.sum(weight) + _EPS)
+    # maximum() not (+ eps): see reconstruction_loss_per_row's reassociation note
+    return jnp.sum(per_row * weight) / jnp.maximum(jnp.sum(weight), _EPS)

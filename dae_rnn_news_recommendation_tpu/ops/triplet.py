@@ -1,5 +1,9 @@
 """Online triplet mining over dot-product similarity, plus the precomputed-triplet loss.
 
+Division guards use maximum(x, eps) rather than the reference's x + eps: identical
+values in float32 (counts are integers or 0), but immune to XLA reassociating the
+guard away inside fusions (see ops/losses.py).
+
 Twin of reference autoencoder/triplet_loss_utils.py — same semantics, rebuilt for XLA:
 
   - mining runs on the *encoded* batch [B, D] (D = n_components, small), so the B^2
@@ -101,14 +105,14 @@ def batch_all_triplet_loss(labels, encode, pos_triplets_only=False, row_valid=No
         mask, num = valid_mask, num_valid
 
     # -log_sigmoid(-d) == softplus(d)  (reference :126)
-    loss = jnp.sum(jax.nn.softplus(dist) * mask) / (num + _EPS)
+    loss = jnp.sum(jax.nn.softplus(dist) * mask) / jnp.maximum(num, _EPS)
 
     # participation count: as anchor + as negative + as positive  (reference :129)
     data_weight = (
         jnp.sum(mask, axis=(1, 2)) + jnp.sum(mask, axis=(0, 1)) + jnp.sum(mask, axis=(0, 2))
     )
 
-    fraction = num_pos / (num_valid + _EPS)
+    fraction = num_pos / jnp.maximum(num_valid, _EPS)
     return loss, data_weight, fraction, num_pos, {}
 
 
@@ -160,7 +164,7 @@ def batch_hard_triplet_loss(labels, encode, row_valid=None):
     )
 
     total = jnp.sum(count)
-    loss = jnp.sum(jax.nn.softplus(dist) * count) / (total + _EPS)
+    loss = jnp.sum(jax.nn.softplus(dist) * count) / jnp.maximum(total, _EPS)
     n_rows = jnp.sum(validf)
     fraction = total / jnp.maximum(n_rows, 1.0)
 
@@ -183,4 +187,4 @@ def precomputed_triplet_loss(encode, encode_pos, encode_neg, row_valid=None):
     if row_valid is None:
         return jnp.mean(per_row)
     v = row_valid.astype(per_row.dtype)
-    return jnp.sum(per_row * v) / (jnp.sum(v) + _EPS)
+    return jnp.sum(per_row * v) / jnp.maximum(jnp.sum(v), _EPS)

@@ -1,0 +1,140 @@
+"""Config/flag system: every hyperparameter is a flag; a .env file overrides flags.
+
+Twin of the reference's tf.app.flags blocks + dotenv override
+(main_autoencoder.py:13-111), rebuilt on argparse with the same flag names, defaults,
+and cross-field validation — and with the reference's miswired env keys fixed
+(SURVEY §2.3.1: corr_type/corr_frac were read from os.environ['compress_factor']).
+
+Boolean envs are presence-triggered like the reference (:36-42): defining `verbose`
+in .env sets it True regardless of value.
+"""
+
+import argparse
+import os
+from pathlib import Path
+
+_BOOL_FLAGS = ("verbose", "encode_full", "validation", "save_tsv",
+               "restore_previous_data", "restore_previous_model", "synthetic")
+
+
+def load_dotenv(path=".env"):
+    """Minimal .env parser (KEY=VALUE lines; '#' comments). Returns dict and also
+    injects into os.environ like python-dotenv (reference main_autoencoder.py:13-17)."""
+    path = Path(path)
+    out = {}
+    if not path.exists():
+        return out
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        k, _, v = line.partition("=")
+        k, v = k.strip(), v.strip().strip("'\"")
+        out[k] = v
+        os.environ.setdefault(k, v)
+    return out
+
+
+def build_parser(triplet_mode=False):
+    p = argparse.ArgumentParser(
+        description="TPU-native DAE article-embedding trainer "
+                    "(capabilities of louislung/DAE_RNN_News_Recommendation)")
+    # global configuration (reference main_autoencoder.py:27-44)
+    p.add_argument("--verbose", action="store_true", default=False)
+    p.add_argument("--verbose_step", type=int, default=5)
+    p.add_argument("--encode_full", action="store_true", default=False)
+    p.add_argument("--validation", action="store_true", default=False)
+    p.add_argument("--input_format", default="binary", choices=["binary", "tfidf"])
+    p.add_argument("--label", default="category_publish_name",
+                   choices=["category_publish_name", "story"])
+    p.add_argument("--save_tsv", action="store_true", default=False)
+    p.add_argument("--train_row", type=int, default=8000)
+    p.add_argument("--validate_row", type=int, default=2000)
+    # vectorizer (reference :47-54)
+    p.add_argument("--restore_previous_data", action="store_true", default=False)
+    p.add_argument("--min_df", type=float, default=0.0)
+    p.add_argument("--max_df", type=float, default=0.99)
+    p.add_argument("--max_features", type=int, default=10000)
+    # model (reference :57-92)
+    p.add_argument("--model_name", default="")
+    p.add_argument("--restore_previous_model", action="store_true", default=False)
+    p.add_argument("--seed", type=int, default=-1)
+    p.add_argument("--compress_factor", type=int, default=20)
+    p.add_argument("--corr_type", default="masking",
+                   choices=["none", "masking", "salt_and_pepper", "decay"])
+    p.add_argument("--corr_frac", type=float, default=0.3)
+    p.add_argument("--xavier_init", type=int, default=1)
+    p.add_argument("--enc_act_func", default="sigmoid", choices=["sigmoid", "tanh"])
+    p.add_argument("--dec_act_func", default="sigmoid",
+                   choices=["sigmoid", "tanh", "none"])
+    p.add_argument("--main_dir", default="")
+    p.add_argument("--loss_func", default="cross_entropy",
+                   choices=["cross_entropy", "mean_squared", "cosine_proximity"])
+    p.add_argument("--opt", default="gradient_descent",
+                   choices=["gradient_descent", "ada_grad", "momentum", "adam"])
+    p.add_argument("--learning_rate", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.5)
+    p.add_argument("--num_epochs", type=int, default=50)
+    p.add_argument("--batch_size", type=float, default=0.1)
+    p.add_argument("--alpha", type=float, default=1.0)
+    if not triplet_mode:
+        p.add_argument("--triplet_strategy", default="batch_all",
+                       choices=["batch_all", "batch_hard", "none"])
+    # --- TPU-native extras ---
+    p.add_argument("--data_path", default="datasets/uci_news.snappy.parquet",
+                   help="article parquet; --synthetic generates data instead")
+    p.add_argument("--synthetic", action="store_true", default=False,
+                   help="use the built-in synthetic UCI-like corpus")
+    p.add_argument("--n_devices", type=int, default=1)
+    p.add_argument("--mining_scope", default="global", choices=["global", "shard"])
+    p.add_argument("--compute_dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--checkpoint_every", type=int, default=0)
+    return p
+
+
+def apply_env_overrides(args, env=os.environ):
+    """Reference behavior: presence of a key in the environment overrides the flag
+    (main_autoencoder.py:36-92) — with the corr_type/corr_frac miswiring fixed."""
+    for name in vars(args):
+        if name not in env:
+            continue
+        raw = env[name]
+        if name in _BOOL_FLAGS:
+            setattr(args, name, True)
+        else:
+            cur = getattr(args, name)
+            if isinstance(cur, bool):
+                setattr(args, name, True)
+            elif isinstance(cur, int):
+                setattr(args, name, int(raw))
+            elif isinstance(cur, float):
+                setattr(args, name, float(raw))
+            else:
+                setattr(args, name, raw)
+    return args
+
+
+def validate(args, triplet_mode=False):
+    """Cross-field asserts (reference main_autoencoder.py:94-111)."""
+    assert 0.0 <= args.min_df <= 1.0
+    assert 0.0 <= args.max_df <= 1.0
+    assert args.max_features >= 1
+    assert 0.0 <= args.corr_frac <= 1.0
+    assert args.verbose_step > 0
+    if args.input_format == "tfidf":
+        assert args.loss_func in ("mean_squared", "cosine_proximity"), (
+            "tfidf input is not Bernoulli — cross_entropy is invalid "
+            "(reference main_autoencoder.py:108-109)")
+    if args.main_dir == "":
+        args.main_dir = args.model_name
+    return args
+
+
+def parse_flags(argv=None, triplet_mode=False, dotenv_path=".env"):
+    if Path(dotenv_path).exists():
+        print(".env found, will override all flags using values in .env")
+        load_dotenv(dotenv_path)
+    args = build_parser(triplet_mode).parse_args(argv)
+    apply_env_overrides(args)
+    return validate(args, triplet_mode)

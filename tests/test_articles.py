@@ -1,0 +1,65 @@
+"""Article pipeline tests: label engineering, pos/neg mapping, vectorization,
+synthetic corpus shape."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dae_rnn_news_recommendation_tpu.data import articles
+
+
+@pytest.fixture
+def df():
+    return articles.synthetic_articles(n_articles=300, vocab_size=500,
+                                       words_per_article=40, seed=1)
+
+
+def test_synthetic_articles_schema(df):
+    for col in ("article_id", "title", "main_content", "category_publish_name", "story"):
+        assert col in df.columns
+    assert df.main_content.str.len().min() > 0
+    assert df.category_publish_name.nunique() > 2
+    assert df.story.notna().sum() > 0
+
+
+def test_read_articles_story_extraction(tmp_path, df):
+    path = tmp_path / "a.parquet"
+    df.drop(columns=["story"]).to_parquet(path)
+    back = articles.read_articles(path)
+    # story re-extracted from the 【...（ title pattern
+    assert back.story.notna().sum() > 0
+    extracted = back[back.story.notna()].story.iloc[0]
+    assert extracted.startswith("story_")
+
+
+def test_similar_articles_mapping(df):
+    out = articles.similar_articles(df, id_colname="article_id",
+                                    cate_colname="category_publish_name", seed=0)
+    valid = out[out.valid_triplet_data == 1]
+    assert len(valid) > 0
+    by_id = out.set_index("article_id")
+    for _, row in valid.head(20).iterrows():
+        # positive shares the category, negative does not
+        assert by_id.loc[row.article_id_pos].category_publish_name == row.category_publish_name
+        assert by_id.loc[row.article_id_neg].category_publish_name != row.category_publish_name
+
+
+def test_count_vectorize_shared_vocab(df):
+    out = articles.similar_articles(df, cate_colname="category_publish_name", seed=0)
+    valid = out[out.valid_triplet_data == 1].head(50)
+    content = out.main_content
+    cv, X, X_pos, X_neg = articles.count_vectorize(
+        valid.main_content, content.loc[valid.article_id_pos],
+        content.loc[valid.article_id_neg], tokenizer=None, max_features=200)
+    assert X.shape == X_pos.shape == X_neg.shape
+    assert X.shape[1] <= 200
+
+
+def test_tfidf_transform(df):
+    cv, X, _, _ = articles.count_vectorize(df.main_content, tokenizer=None,
+                                           max_features=100)
+    tt, X_tfidf = articles.tfidf_transform(X)
+    assert X_tfidf.shape == X.shape
+    # sklearn l2-normalizes rows by default
+    norms = np.sqrt(np.asarray(X_tfidf.multiply(X_tfidf).sum(axis=1))).ravel()
+    np.testing.assert_allclose(norms[norms > 0], 1.0, rtol=1e-6)

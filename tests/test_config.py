@@ -1,0 +1,80 @@
+"""Config/flag system tests: defaults, env overrides (presence-triggered booleans,
+fixed miswiring), cross-field validation, .env parsing."""
+
+import pytest
+
+from dae_rnn_news_recommendation_tpu.utils import config as C
+
+
+def test_defaults_match_reference():
+    args = C.build_parser().parse_args([])
+    assert args.verbose is False
+    assert args.verbose_step == 5
+    assert args.input_format == "binary"
+    assert args.train_row == 8000
+    assert args.validate_row == 2000
+    assert args.max_features == 10000
+    assert args.compress_factor == 20
+    assert args.corr_type == "masking"
+    assert args.corr_frac == 0.3
+    assert args.loss_func == "cross_entropy"
+    assert args.opt == "gradient_descent"
+    assert args.learning_rate == 0.1
+    assert args.num_epochs == 50
+    assert args.batch_size == 0.1
+    assert args.triplet_strategy == "batch_all"
+
+
+def test_env_override_correct_keys():
+    """The reference miswired corr_type/corr_frac to os.environ['compress_factor']
+    (main_autoencoder.py:79-80) — fixed here."""
+    args = C.build_parser().parse_args([])
+    env = {"corr_type": "decay", "corr_frac": "0.5", "compress_factor": "99"}
+    C.apply_env_overrides(args, env)
+    assert args.corr_type == "decay"
+    assert args.corr_frac == 0.5
+    assert args.compress_factor == 99
+
+
+def test_env_bool_presence_triggered():
+    args = C.build_parser().parse_args([])
+    C.apply_env_overrides(args, {"verbose": "0", "validation": "false"})
+    # presence wins regardless of value (reference :36-42 semantics)
+    assert args.verbose is True
+    assert args.validation is True
+
+
+def test_tfidf_forbids_cross_entropy():
+    args = C.build_parser().parse_args(["--input_format", "tfidf"])
+    with pytest.raises(AssertionError):
+        C.validate(args)
+    args2 = C.build_parser().parse_args(
+        ["--input_format", "tfidf", "--loss_func", "mean_squared"])
+    C.validate(args2)  # ok
+
+
+def test_main_dir_defaults_to_model_name():
+    args = C.build_parser().parse_args(["--model_name", "foo"])
+    C.validate(args)
+    assert args.main_dir == "foo"
+
+
+def test_load_dotenv(tmp_path, monkeypatch):
+    envfile = tmp_path / ".env"
+    envfile.write_text("# comment\nalpha=10\nopt=ada_grad\nverbose=1\n")
+    monkeypatch.delenv("alpha", raising=False)
+    monkeypatch.delenv("opt", raising=False)
+    out = C.load_dotenv(envfile)
+    assert out == {"alpha": "10", "opt": "ada_grad", "verbose": "1"}
+    args = C.build_parser().parse_args([])
+    C.apply_env_overrides(args, out)
+    assert args.alpha == 10.0
+    assert args.opt == "ada_grad"
+    assert args.verbose is True
+
+
+def test_parse_flags_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = C.parse_flags(["--model_name", "m", "--num_epochs", "3"])
+    assert args.num_epochs == 3
+    assert args.main_dir == "m"
