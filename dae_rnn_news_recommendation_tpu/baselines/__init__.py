@@ -1,0 +1,5 @@
+from .starspace import (StarSpaceConfig, embed_docs, export_fasttext_format,
+                        train_starspace)
+
+__all__ = ["StarSpaceConfig", "train_starspace", "embed_docs",
+           "export_fasttext_format"]

@@ -1,0 +1,267 @@
+"""StarSpace-equivalent baseline: native C++ trainer + fastText-format export.
+
+The reference benchmarks its DAE embeddings against Facebook's StarSpace C++
+binary, invoked out of process on fastText-formatted text files
+(reference starspace/prepare_starspace_formatted_data.ipynb: cell 4-5 write
+"w1 w2 ... __label__<category>" lines, cell 6 runs `starspace train -trainFile
+... -dim 50 -similarity cosine -loss hinge -adagrad true -thread 20`, cell 7
+runs `embed_doc`; the argument dump is starspace/train.log:1-28 and the early-
+stopped validation error 0.018963 is train.log:115-121).
+
+Here the trainer is an in-repo native component (native/src/starspace.cc,
+hogwild adagrad hinge-loss over cosine similarity) driven through ctypes, with
+a NumPy implementation of identical semantics as fallback/oracle. The fastText
+format export is kept so the artifacts stay interchangeable with the real
+binary's.
+"""
+
+import dataclasses
+
+import numpy as np
+import scipy.sparse as sp
+
+from .. import native
+
+
+@dataclasses.dataclass
+class StarSpaceConfig:
+    """Mirrors the knobs the reference passes to the binary (train.log:2-28)."""
+
+    dim: int = 50           # train.log:4
+    lr: float = 0.01        # train.log:2
+    margin: float = 0.05    # train.log:9
+    epochs: int = 50        # notebook cell 6: -epoch 50
+    neg: int = 10           # maxNegSamples, train.log:11
+    threads: int = 20       # train.log:13
+    patience: int = 10      # validationPatience, train.log:21
+    seed: int = 0
+
+
+def _as_csr(docs):
+    # only the structure (indptr/indices) is consumed — a doc is its word set —
+    # so stored values are never touched or copied
+    docs = docs.tocsr()
+    return (docs.indptr.astype(np.int64), docs.indices.astype(np.int32),
+            docs.shape)
+
+
+def train_starspace(train_docs, train_labels, val_docs=None, val_labels=None,
+                    config=None, force_numpy=False):
+    """Train word+label embeddings on bag-of-words csr docs.
+
+    :param train_docs: scipy sparse [N, V]; column = vocabulary word. Stored
+        values are ignored (a doc is its set of words, as in the fastText
+        format export the reference feeds the binary).
+    :param train_labels: int array [N] of label (category) ids
+    :param val_docs/val_labels: optional held-out set for early stopping
+    :param config: StarSpaceConfig
+    :param force_numpy: skip the native library (used by tests as the oracle)
+    :return: dict with 'word_emb' [V, dim], 'label_emb' [L, dim],
+        'best_val_error', 'epoch_errors' (list, early-stopped tail omitted)
+    """
+    config = config or StarSpaceConfig()
+    if not 0 < config.dim <= 512:
+        raise ValueError(f"dim must be in (0, 512], got {config.dim}")
+    indptr, indices, (n, vocab) = _as_csr(train_docs)
+    labels = np.ascontiguousarray(train_labels, np.int32)
+    if labels.size and labels.min() < 0:
+        # pd.factorize emits -1 for missing categories; these must be filtered
+        # by the caller, not silently indexed (OOB in the native trainer)
+        raise ValueError("negative label ids (missing categories?) not allowed")
+    n_labels = int(labels.max()) + 1 if labels.size else 0
+
+    rng = np.random.default_rng(config.seed)
+    bound = 1.0 / np.sqrt(config.dim)
+    word_emb = rng.uniform(-bound, bound,
+                           (vocab, config.dim)).astype(np.float32)
+    label_emb = rng.uniform(-bound, bound,
+                            (n_labels, config.dim)).astype(np.float32)
+
+    has_val = val_docs is not None and val_docs.shape[0] > 0
+    if has_val:
+        v_indptr, v_indices, _ = _as_csr(val_docs)
+        v_labels = np.ascontiguousarray(val_labels, np.int32)
+        if v_labels.min() < 0 or int(v_labels.max()) + 1 > n_labels:
+            raise ValueError("validation labels outside training label set")
+    else:
+        v_indptr = v_indices = v_labels = None
+
+    lib = None if force_numpy else native.load()
+    epoch_errors = np.full(config.epochs, -1.0)
+    if lib is not None:
+        import ctypes
+
+        best = lib.starspace_train(
+            native.as_ptr(indptr, ctypes.c_int64),
+            native.as_ptr(indices, ctypes.c_int32),
+            n, native.as_ptr(labels, ctypes.c_int32),
+            vocab, n_labels, config.dim, config.lr, config.margin, config.neg,
+            config.epochs, config.threads, config.patience,
+            native.as_ptr(v_indptr, ctypes.c_int64) if has_val else None,
+            native.as_ptr(v_indices, ctypes.c_int32) if has_val else None,
+            len(v_labels) if has_val else 0,
+            native.as_ptr(v_labels, ctypes.c_int32) if has_val else None,
+            native.as_ptr(word_emb, ctypes.c_float),
+            native.as_ptr(label_emb, ctypes.c_float),
+            config.seed, native.as_ptr(epoch_errors, ctypes.c_double),
+        )
+        if best < 0:
+            raise RuntimeError("native starspace_train rejected its inputs")
+    else:
+        best = _train_numpy(indptr, indices, labels, n_labels, word_emb,
+                            label_emb, config, v_indptr, v_indices, v_labels,
+                            epoch_errors)
+    return {
+        "word_emb": word_emb,
+        "label_emb": label_emb,
+        "best_val_error": float(best),
+        "epoch_errors": [e for e in epoch_errors.tolist() if e >= 0],
+    }
+
+
+def embed_docs(docs, word_emb):
+    """`embed_doc` equivalent: mean of word embeddings per csr row."""
+    indptr, indices, (n, _) = _as_csr(docs)
+    dim = word_emb.shape[1]
+    out = np.zeros((n, dim), np.float32)
+    lib = native.load()
+    if lib is not None:
+        import ctypes
+
+        w = np.ascontiguousarray(word_emb, np.float32)
+        lib.starspace_embed_docs(
+            native.as_ptr(indptr, ctypes.c_int64),
+            native.as_ptr(indices, ctypes.c_int32), n,
+            native.as_ptr(w, ctypes.c_float), dim,
+            native.as_ptr(out, ctypes.c_float))
+        return out
+    for i in range(n):
+        lo, hi = indptr[i], indptr[i + 1]
+        if hi > lo:
+            out[i] = word_emb[indices[lo:hi]].mean(axis=0)
+    return out
+
+
+def export_fasttext_format(token_lists, labels, path):
+    """Write "w1 w2 ... __label__<label>" lines (notebook cells 4-5 format) so
+    artifacts stay interchangeable with the real StarSpace binary."""
+    with open(path, "w") as f:
+        for tokens, label in zip(token_lists, labels):
+            f.write(" ".join(str(t) for t in tokens) + f" __label__{label}\n")
+
+
+def tokens_from_csr(docs, vocabulary=None):
+    """Inverse-transform csr rows to token lists (notebook cell 3 uses
+    CountVectorizer.inverse_transform); vocabulary maps column -> word."""
+    docs = docs.tocsr()
+    out = []
+    for i in range(docs.shape[0]):
+        cols = docs.indices[docs.indptr[i]:docs.indptr[i + 1]]
+        out.append([vocabulary[c] if vocabulary is not None else f"w{c}"
+                    for c in cols])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NumPy reference implementation — identical semantics to starspace.cc, used
+# as the test oracle and as fallback when the native build is unavailable.
+# ---------------------------------------------------------------------------
+
+def _cos_and_grad(a, b):
+    na = np.sqrt(a @ a) + 1e-8
+    nb = np.sqrt(b @ b) + 1e-8
+    c = (a @ b) / (na * nb)
+    return c, b / (na * nb) - c * a / (na * na)
+
+
+def _adagrad_row(emb, g2, row, grad, lr):
+    g2[row] += grad @ grad
+    emb[row] -= lr / np.sqrt(g2[row] + 1e-8) * grad
+
+
+def _eval_numpy(indptr, indices, labels, word_emb, label_emb, margin, neg,
+                seed):
+    rng = np.random.RandomState(seed & 0xFFFFFFFF)
+    n_labels = label_emb.shape[0]
+    total = 0.0
+    n = len(labels)
+    for i in range(n):
+        lo, hi = indptr[i], indptr[i + 1]
+        if hi == lo:
+            continue
+        doc = word_emb[indices[lo:hi]].mean(axis=0)
+        cp, _ = _cos_and_grad(doc, label_emb[labels[i]])
+        for _ in range(neg):
+            yn = rng.randint(0, n_labels)
+            if yn == labels[i]:
+                yn = (yn + 1) % n_labels
+            cn, _ = _cos_and_grad(doc, label_emb[yn])
+            total += max(0.0, margin - cp + cn)
+    return total / max(n, 1)
+
+
+def _train_numpy(indptr, indices, labels, n_labels, word_emb, label_emb,
+                 config, v_indptr, v_indices, v_labels, epoch_errors):
+    """Single-threaded trainer with the same update rule as the native code.
+
+    RNG streams differ from the C++ (std::mt19937 shuffling vs RandomState),
+    so runs are statistically — not bitwise — equivalent.
+    """
+    word_g2 = np.zeros(word_emb.shape[0], np.float32)
+    label_g2 = np.zeros(n_labels, np.float32)
+    has_val = v_indptr is not None
+    best = np.inf
+    best_snap = None
+    since_best = 0
+    n = len(labels)
+    rng = np.random.RandomState(config.seed & 0xFFFFFFFF)
+    for epoch in range(config.epochs):
+        order = rng.permutation(n)
+        train_loss = 0.0
+        for i in order:
+            lo, hi = indptr[i], indptr[i + 1]
+            if hi == lo or n_labels < 2:
+                continue
+            words = indices[lo:hi]
+            doc = word_emb[words].mean(axis=0)
+            y = labels[i]
+            cp, gpos = _cos_and_grad(doc, label_emb[y])
+            gdoc = np.zeros_like(doc)
+            active = 0
+            for _ in range(config.neg):
+                yn = rng.randint(0, n_labels)
+                if yn == y:
+                    yn = (yn + 1) % n_labels
+                cn, gneg = _cos_and_grad(doc, label_emb[yn])
+                l = config.margin - cp + cn
+                if l <= 0:
+                    continue
+                train_loss += l
+                active += 1
+                gdoc += gneg - gpos
+                _, glab = _cos_and_grad(label_emb[yn], doc)
+                _adagrad_row(label_emb, label_g2, yn, glab, config.lr)
+            if active:
+                _, glab = _cos_and_grad(label_emb[y], doc)
+                _adagrad_row(label_emb, label_g2, y, -active * glab, config.lr)
+                gw = gdoc / len(words)
+                for w in words:
+                    _adagrad_row(word_emb, word_g2, int(w), gw, config.lr)
+        if has_val:
+            err = _eval_numpy(v_indptr, v_indices, v_labels, word_emb,
+                              label_emb, config.margin, config.neg,
+                              config.seed)
+        else:
+            err = train_loss / n
+        epoch_errors[epoch] = err
+        if err < best:
+            best, since_best = err, 0
+            if has_val:
+                best_snap = (word_emb.copy(), label_emb.copy())
+        elif has_val:
+            since_best += 1
+            if config.patience > 0 and since_best >= config.patience:
+                break
+    if best_snap is not None:
+        word_emb[:], label_emb[:] = best_snap
+    return best

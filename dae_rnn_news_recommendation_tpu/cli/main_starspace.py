@@ -1,0 +1,129 @@
+"""StarSpace baseline driver: the CLI equivalent of the reference's
+starspace/prepare_starspace_formatted_data.ipynb — export fastText-format
+files, train the native StarSpace-style embedding trainer, embed train and
+validation docs, and compare AUROC against tf-idf similarity.
+
+Reference flow (notebook cells): 3 inverse-transform token lists, 4-5 write
+"w1 w2 ... __label__cat" files, 6 `starspace train -dim 50 -epoch 50
+-thread 20`, 7 `embed_doc`, 9-13 AUROC comparison. The external binary is
+replaced by the in-repo native trainer (native/src/starspace.cc).
+
+Run: python -m dae_rnn_news_recommendation_tpu.cli.main_starspace \
+        --model_name uci_starspace --synthetic --train_row 500 --validate_row 200
+"""
+
+import argparse
+import os
+
+import numpy as np
+import pandas as pd
+
+from ..baselines import (StarSpaceConfig, embed_docs, export_fasttext_format,
+                         train_starspace)
+from ..baselines.starspace import tokens_from_csr
+from ..data import articles, io as hio
+from ..eval import pairwise_similarity, visualize_pairwise_similarity
+
+
+def parse_flags(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model_name", default="uci_starspace")
+    p.add_argument("--main_dir", default="")
+    p.add_argument("--data_path", default="datasets/uci_news.snappy.parquet")
+    p.add_argument("--synthetic", action="store_true",
+                   help="generate a synthetic UCI-news-shaped corpus")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--train_row", type=int, default=5000)   # train.log:26
+    p.add_argument("--validate_row", type=int, default=5348)
+    p.add_argument("--max_features", type=int, default=10000)
+    p.add_argument("--dim", type=int, default=50)           # train.log:4
+    p.add_argument("--lr", type=float, default=0.01)        # train.log:2
+    p.add_argument("--margin", type=float, default=0.05)    # train.log:9
+    p.add_argument("--epochs", type=int, default=50)
+    p.add_argument("--neg", type=int, default=10)           # train.log:11
+    p.add_argument("--threads", type=int, default=20)       # train.log:13
+    p.add_argument("--patience", type=int, default=10)      # train.log:21
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    FLAGS = parse_flags(argv)
+    print(__file__ + ": Start")
+    out_dir = os.path.join("results", "starspace",
+                           FLAGS.main_dir or FLAGS.model_name) + os.sep
+    os.makedirs(out_dir, exist_ok=True)
+
+    n = FLAGS.train_row + FLAGS.validate_row
+    if FLAGS.synthetic:
+        contents = articles.synthetic_articles(n_articles=max(n, 100),
+                                               seed=FLAGS.seed)
+    else:
+        contents = articles.read_articles(path=FLAGS.data_path)
+    # factorize gives -1 for missing categories, which the trainer rejects
+    contents = contents[contents.category_publish_name.notna()].iloc[:n]
+    contents = contents.copy()
+    contents["label_category"] = pd.factorize(
+        contents.category_publish_name)[0]
+    tr = contents.iloc[: FLAGS.train_row]
+    vl = contents.iloc[FLAGS.train_row : n]
+
+    vec, X, _, _ = articles.count_vectorize(
+        tr.main_content, tokenizer=None, stop_words="english",
+        max_features=FLAGS.max_features, binary=True)
+    X_vl = vec.transform(vl.main_content)
+    vocab = {v: k for k, v in vec.vocabulary_.items()}
+
+    # fastText-format artifacts, interchangeable with the real binary's input
+    export_fasttext_format(tokens_from_csr(X, vocab),
+                           tr.category_publish_name,
+                           out_dir + "uci_train_starspace.txt")
+    export_fasttext_format(tokens_from_csr(X_vl, vocab),
+                           vl.category_publish_name,
+                           out_dir + "uci_validate_starspace.txt")
+
+    config = StarSpaceConfig(dim=FLAGS.dim, lr=FLAGS.lr, margin=FLAGS.margin,
+                             epochs=FLAGS.epochs, neg=FLAGS.neg,
+                             threads=FLAGS.threads, patience=FLAGS.patience,
+                             seed=FLAGS.seed)
+    result = train_starspace(
+        X, tr.label_category.to_numpy(),
+        X_vl, vl.label_category.to_numpy(), config=config)
+    print(f"early stopping loss is {result['best_val_error']:.6f}")
+    for e, err in enumerate(result["epoch_errors"]):
+        print(f"epoch {e} validation error {err:.6f}")
+
+    emb_tr = embed_docs(X, result["word_emb"])
+    emb_vl = embed_docs(X_vl, result["word_emb"])
+    # embedding dumps in the reference's uci_*_embed.txt shape (rows x dim tsv)
+    np.savetxt(out_dir + "uci_train_starspace_embed.txt", emb_tr, fmt="%.6f",
+               delimiter="\t")
+    np.savetxt(out_dir + "uci_validate_starspace_embed.txt", emb_vl,
+               fmt="%.6f", delimiter="\t")
+
+    # AUROC comparison vs tf-idf (notebook cells 9-13)
+    tfidf_tf, X_tfidf = articles.tfidf_transform(X)
+    X_tfidf_vl = tfidf_tf.transform(X_vl)
+    aurocs = {}
+    for name, sim, labels in (
+        ("starspace_train", pairwise_similarity(emb_tr, metric="cosine"),
+         tr.label_category),
+        ("starspace_validate", pairwise_similarity(emb_vl, metric="cosine"),
+         vl.label_category),
+        ("tfidf_train", pairwise_similarity(X_tfidf, metric="linear kernel"),
+         tr.label_category),
+        ("tfidf_validate",
+         pairwise_similarity(X_tfidf_vl, metric="linear kernel"),
+         vl.label_category),
+    ):
+        aurocs[name] = visualize_pairwise_similarity(
+            labels.to_numpy(), sim, plot="boxplot",
+            title=f"Cosine Similarity ({name})",
+            save_path=out_dir + f"similarity_{name}.png")
+    for k, v in sorted(aurocs.items()):
+        print(f"AUROC {k}: {v:.4f}")
+    print(__file__ + ": End")
+    return result, aurocs
+
+
+if __name__ == "__main__":
+    main()

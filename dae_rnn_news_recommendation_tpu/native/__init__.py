@@ -1,0 +1,109 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Two components live in one shared library (`_native.so`):
+
+  - csr -> padded-batch packer (src/packer.cc) — the host-side hot path of the
+    sparse TPU feed (ops/sparse_ingest.py delegates here when available)
+  - StarSpace-style hinge-loss embedding trainer (src/starspace.cc) — the
+    native equivalent of the external C++ baseline the reference shells out to
+    (reference starspace/prepare_starspace_formatted_data.ipynb cells 6-7)
+
+The library is compiled on demand with g++ (single translation-unit rebuild,
+~2s, cached next to the sources) so the repo needs no build step to import.
+Every caller must handle `load() is None` (no compiler / build failure) by
+falling back to the pure-Python path.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src")
+_LIB_PATH = os.path.join(_HERE, "_native.so")
+_SOURCES = ("packer.cc", "starspace.cc")
+
+_lock = threading.Lock()
+_lib = None
+_failed_mtimes = None  # source mtimes at last failed build (don't respawn g++)
+
+
+def _build():
+    srcs = [os.path.join(_SRC, s) for s in _SOURCES]
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           "-o", _LIB_PATH, *srcs]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _stale():
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_SRC, s)) > lib_mtime for s in _SOURCES
+    )
+
+
+def _bind(lib):
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+
+    for name, idxp in (("pack_csr_u16", u16p), ("pack_csr_u32", u32p)):
+        fn = getattr(lib, name)
+        fn.argtypes = [i64p, i32p, f32p, ctypes.c_int64, ctypes.c_int64,
+                       ctypes.c_int64, idxp, f32p, ctypes.c_int]
+        fn.restype = None
+
+    lib.starspace_train.argtypes = [
+        i64p, i32p, ctypes.c_int64, i32p,            # train docs + labels
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,    # vocab, n_labels, dim
+        ctypes.c_float, ctypes.c_float, ctypes.c_int,  # lr, margin, neg
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,    # epochs, threads, patience
+        i64p, i32p, ctypes.c_int64, i32p,            # val docs + labels
+        f32p, f32p, ctypes.c_uint64, f64p,           # embs, seed, epoch_errors
+    ]
+    lib.starspace_train.restype = ctypes.c_double
+
+    lib.starspace_embed_docs.argtypes = [i64p, i32p, ctypes.c_int64, f32p,
+                                         ctypes.c_int, f32p]
+    lib.starspace_embed_docs.restype = None
+    return lib
+
+
+def _mtimes():
+    return tuple(os.path.getmtime(os.path.join(_SRC, s)) for s in _SOURCES)
+
+
+def load():
+    """Return the bound ctypes library, building it if needed; None on failure.
+
+    A failed build is cached against the source mtimes so hot-path callers
+    (pad_csr_batch at feed rates) never respawn g++; editing a source retries.
+    """
+    global _lib, _failed_mtimes
+    if _lib is not None:
+        return _lib
+    if _failed_mtimes is not None and _failed_mtimes == _mtimes():
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if _stale():
+                _build()
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+            _failed_mtimes = None
+        except Exception:
+            _lib = None
+            _failed_mtimes = _mtimes()
+    return _lib
+
+
+def as_ptr(arr, ctype):
+    """numpy array -> ctypes pointer (no copy; caller keeps arr alive)."""
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))

@@ -1,0 +1,291 @@
+// Native StarSpace-style embedding trainer: the external C++ baseline the
+// reference compares against (reference starspace/prepare_starspace_formatted_data.ipynb
+// cells 6-7 shell out to Facebook's `starspace train ... -dim 50 -similarity cosine
+// -loss hinge -adagrad true -thread 20`; its arg dump is starspace/train.log:1-28).
+// The reference does not vendor the binary; this file is a from-scratch native
+// equivalent of the trainMode=0 document/label path it uses:
+//
+//   - a document embeds as the mean of its word embeddings
+//   - similarity(doc, label) = cosine
+//   - loss = hinge: sum_neg max(0, margin - cos(doc, pos) + cos(doc, neg)),
+//     negatives drawn uniformly from the other labels (maxNegSamples)
+//   - per-row adagrad updates, hogwild over `threads` std::threads
+//   - per-epoch validation error with best-epoch early stopping (patience),
+//     matching the reference run's "early stopping loss is 0.018963 / patience 10"
+//     (starspace/train.log:115-121)
+//
+// C ABI only; driven from Python via ctypes (native/__init__.py), wrapped with
+// format export + NumPy oracle in baselines/starspace.py.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Model {
+  float* word_emb;   // [V, dim]
+  float* label_emb;  // [L, dim]
+  float* word_g2;    // adagrad accumulators, per row
+  float* label_g2;
+  int dim;
+  int vocab;
+  int n_labels;
+  float lr;
+  float margin;
+  int neg;
+};
+
+inline void doc_embed(const Model& m, const int32_t* words, int64_t n,
+                      float* out) {
+  std::memset(out, 0, sizeof(float) * m.dim);
+  if (n == 0) return;
+  for (int64_t j = 0; j < n; ++j) {
+    const float* w = m.word_emb + static_cast<int64_t>(words[j]) * m.dim;
+    for (int d = 0; d < m.dim; ++d) out[d] += w[d];
+  }
+  const float inv = 1.0f / static_cast<float>(n);
+  for (int d = 0; d < m.dim; ++d) out[d] *= inv;
+}
+
+inline float dot(const float* a, const float* b, int dim) {
+  float s = 0.f;
+  for (int d = 0; d < dim; ++d) s += a[d] * b[d];
+  return s;
+}
+
+inline float norm(const float* a, int dim) {
+  return std::sqrt(dot(a, a, dim)) + 1e-8f;
+}
+
+// d cos(a,b) / d a = b/(|a||b|) - cos * a/|a|^2
+inline void cos_grad_a(const float* a, const float* b, int dim, float* out,
+                       float* cos_out) {
+  const float na = norm(a, dim), nb = norm(b, dim);
+  const float c = dot(a, b, dim) / (na * nb);
+  *cos_out = c;
+  const float inv_ab = 1.0f / (na * nb), inv_aa = c / (na * na);
+  for (int d = 0; d < dim; ++d) out[d] = b[d] * inv_ab - a[d] * inv_aa;
+}
+
+inline void adagrad_row(float* row, float* g2, const float* grad, int dim,
+                        float lr) {
+  float gn2 = 0.f;
+  for (int d = 0; d < dim; ++d) gn2 += grad[d] * grad[d];
+  *g2 += gn2;  // per-row accumulator (StarSpace-style scalar adagrad)
+  const float step = lr / std::sqrt(*g2 + 1e-8f);
+  for (int d = 0; d < dim; ++d) row[d] -= step * grad[d];
+}
+
+// One training example: doc i with positive label y against `neg` sampled
+// negatives. Returns the example loss.
+float train_example(Model& m, const int32_t* words, int64_t n_words, int32_t y,
+                    std::mt19937& rng, std::vector<float>& scratch) {
+  if (n_words == 0 || m.n_labels < 2) return 0.f;
+  const int dim = m.dim;
+  scratch.resize(static_cast<size_t>(dim) * 4);
+  float* doc = scratch.data();
+  float* gpos = doc + dim;   // d cos(doc,pos)/d doc
+  float* gneg = gpos + dim;  // d cos(doc,neg)/d doc for current neg
+  float* gdoc = gneg + dim;  // accumulated gradient w.r.t. doc embedding
+
+  doc_embed(m, words, n_words, doc);
+  float* pos_row = m.label_emb + static_cast<int64_t>(y) * dim;
+  float cos_pos;
+  cos_grad_a(doc, pos_row, dim, gpos, &cos_pos);
+
+  std::memset(gdoc, 0, sizeof(float) * dim);
+  std::uniform_int_distribution<int> pick(0, m.n_labels - 1);
+  float loss = 0.f;
+  int active = 0;
+  for (int k = 0; k < m.neg; ++k) {
+    int yn = pick(rng);
+    if (yn == y) yn = (yn + 1) % m.n_labels;
+    float* neg_row = m.label_emb + static_cast<int64_t>(yn) * dim;
+    float cos_neg;
+    cos_grad_a(doc, neg_row, dim, gneg, &cos_neg);
+    const float l = m.margin - cos_pos + cos_neg;
+    if (l <= 0.f) continue;
+    loss += l;
+    ++active;
+    // d l / d doc = -gpos + gneg ; d l / d pos = -dcos(doc,pos)/dpos ; etc.
+    for (int d = 0; d < dim; ++d) gdoc[d] += gneg[d] - gpos[d];
+    float grad_label[512];
+    float c;
+    // gradient w.r.t. the negative label row
+    cos_grad_a(neg_row, doc, dim, grad_label, &c);
+    adagrad_row(neg_row, m.label_g2 + yn, grad_label, dim, m.lr);
+  }
+  if (active > 0) {
+    float grad_label[512];
+    float c;
+    cos_grad_a(pos_row, doc, dim, grad_label, &c);
+    for (int d = 0; d < dim; ++d) grad_label[d] *= -static_cast<float>(active);
+    adagrad_row(pos_row, m.label_g2 + y, grad_label, dim, m.lr);
+    // doc gradient distributes over its words: doc = mean(words) so each word
+    // row sees gdoc / n_words.
+    const float scale = 1.0f / static_cast<float>(n_words);
+    std::vector<float> gw(dim);
+    for (int64_t j = 0; j < n_words; ++j) {
+      const int32_t w = words[j];
+      for (int d = 0; d < dim; ++d) gw[d] = gdoc[d] * scale;
+      adagrad_row(m.word_emb + static_cast<int64_t>(w) * dim, m.word_g2 + w,
+                  gw.data(), dim, m.lr);
+    }
+  }
+  return loss;
+}
+
+// Mean hinge loss over a (held-out) set, negatives sampled with a fixed seed so
+// the metric is deterministic across calls.
+double eval_loss(const Model& m, const int64_t* indptr, const int32_t* indices,
+                 int64_t n_docs, const int32_t* labels, int neg, uint64_t seed) {
+  if (n_docs == 0) return 0.0;
+  std::mt19937 rng(static_cast<uint32_t>(seed));
+  std::uniform_int_distribution<int> pick(0, m.n_labels - 1);
+  std::vector<float> doc(m.dim), g(m.dim);
+  double total = 0.0;
+  for (int64_t i = 0; i < n_docs; ++i) {
+    const int64_t lo = indptr[i], n = indptr[i + 1] - lo;
+    if (n == 0) continue;
+    doc_embed(m, indices + lo, n, doc.data());
+    float cos_pos;
+    cos_grad_a(doc.data(), m.label_emb + static_cast<int64_t>(labels[i]) * m.dim,
+               m.dim, g.data(), &cos_pos);
+    for (int k = 0; k < neg; ++k) {
+      int yn = pick(rng);
+      if (yn == labels[i]) yn = (yn + 1) % m.n_labels;
+      float cos_neg;
+      cos_grad_a(doc.data(), m.label_emb + static_cast<int64_t>(yn) * m.dim,
+                 m.dim, g.data(), &cos_neg);
+      const float l = m.margin - cos_pos + cos_neg;
+      if (l > 0.f) total += l;
+    }
+  }
+  return total / static_cast<double>(n_docs);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Train word/label embeddings; returns the best validation error seen (or the
+// final train error when no validation set is given). Arrays word_emb [V,dim]
+// and label_emb [L,dim] must be pre-initialized by the caller (uniform small
+// random, as StarSpace does); they are updated in place, and on early stop the
+// best-epoch snapshot is restored into them.
+//
+// epoch_errors (nullable): float64[epochs], filled with the per-epoch
+// validation (or train) error, -1 for epochs not reached (early stop).
+double starspace_train(const int64_t* indptr, const int32_t* indices,
+                       int64_t n_docs, const int32_t* labels, int vocab,
+                       int n_labels, int dim, float lr, float margin, int neg,
+                       int epochs, int threads, int patience,
+                       const int64_t* val_indptr, const int32_t* val_indices,
+                       int64_t n_val, const int32_t* val_labels,
+                       float* word_emb, float* label_emb, uint64_t seed,
+                       double* epoch_errors) {
+  if (dim > 512 || n_docs <= 0 || vocab <= 0 || n_labels <= 0) return -1.0;
+  Model m;
+  m.word_emb = word_emb;
+  m.label_emb = label_emb;
+  m.dim = dim;
+  m.vocab = vocab;
+  m.n_labels = n_labels;
+  m.lr = lr;
+  m.margin = margin;
+  m.neg = neg;
+  std::vector<float> word_g2(static_cast<size_t>(vocab), 0.f);
+  std::vector<float> label_g2(static_cast<size_t>(n_labels), 0.f);
+  m.word_g2 = word_g2.data();
+  m.label_g2 = label_g2.data();
+
+  const bool has_val = val_indptr != nullptr && n_val > 0;
+  std::vector<float> best_words, best_labels;
+  double best_err = 1e30;
+  int since_best = 0;
+
+  if (epoch_errors != nullptr)
+    for (int e = 0; e < epochs; ++e) epoch_errors[e] = -1.0;
+
+  for (int epoch = 0; epoch < epochs; ++epoch) {
+    const int nt = threads < 1 ? 1 : threads;
+    std::vector<std::thread> pool;
+    std::vector<double> thread_loss(static_cast<size_t>(nt), 0.0);
+    const int64_t per = (n_docs + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
+      const int64_t lo = t * per;
+      const int64_t hi = std::min<int64_t>(lo + per, n_docs);
+      if (lo >= hi) break;
+      pool.emplace_back([&, t, lo, hi] {
+        std::mt19937 rng(static_cast<uint32_t>(seed + 1315423911ull * (epoch * nt + t + 1)));
+        std::vector<float> scratch;
+        // hogwild: embedding rows are updated without locks; races are benign
+        std::vector<int64_t> order(static_cast<size_t>(hi - lo));
+        for (int64_t i = lo; i < hi; ++i) order[static_cast<size_t>(i - lo)] = i;
+        std::shuffle(order.begin(), order.end(), rng);
+        double loss = 0.0;
+        for (int64_t i : order) {
+          const int64_t plo = indptr[i];
+          loss += train_example(m, indices + plo, indptr[i + 1] - plo, labels[i],
+                                rng, scratch);
+        }
+        thread_loss[static_cast<size_t>(t)] = loss;
+      });
+    }
+    for (auto& th : pool) th.join();
+
+    double err;
+    if (has_val) {
+      err = eval_loss(m, val_indptr, val_indices, n_val, val_labels, neg, seed);
+    } else {
+      double s = 0.0;
+      for (double v : thread_loss) s += v;
+      err = s / static_cast<double>(n_docs);
+    }
+    if (epoch_errors != nullptr) epoch_errors[epoch] = err;
+
+    if (err < best_err) {
+      best_err = err;
+      since_best = 0;
+      if (has_val) {
+        best_words.assign(word_emb,
+                          word_emb + static_cast<int64_t>(vocab) * dim);
+        best_labels.assign(label_emb,
+                           label_emb + static_cast<int64_t>(n_labels) * dim);
+      }
+    } else if (has_val && ++since_best >= patience && patience > 0) {
+      break;  // early stop: restore best snapshot below
+    }
+  }
+  if (has_val && !best_words.empty()) {
+    std::memcpy(word_emb, best_words.data(), best_words.size() * sizeof(float));
+    std::memcpy(label_emb, best_labels.data(),
+                best_labels.size() * sizeof(float));
+  }
+  return best_err;
+}
+
+// embed_doc equivalent (notebook cell 7): mean of word embeddings per csr row.
+void starspace_embed_docs(const int64_t* indptr, const int32_t* indices,
+                          int64_t n_docs, const float* word_emb, int dim,
+                          float* out) {
+  for (int64_t i = 0; i < n_docs; ++i) {
+    const int64_t lo = indptr[i], n = indptr[i + 1] - lo;
+    float* o = out + i * dim;
+    std::memset(o, 0, sizeof(float) * dim);
+    if (n == 0) continue;
+    for (int64_t j = 0; j < n; ++j) {
+      const float* w = word_emb + static_cast<int64_t>(indices[lo + j]) * dim;
+      for (int d = 0; d < dim; ++d) o[d] += w[d];
+    }
+    const float inv = 1.0f / static_cast<float>(n);
+    for (int d = 0; d < dim; ++d) o[d] *= inv;
+  }
+}
+
+}  // extern "C"

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
+from .. import native
+
 
 def pad_csr_batch(rows, k=None, k_multiple=64, index_dtype=np.uint16, binary=False):
     """csr matrix -> padded {indices [B,K], values [B,K] or None, k}.
@@ -44,8 +46,31 @@ def pad_csr_batch(rows, k=None, k_multiple=64, index_dtype=np.uint16, binary=Fal
     nnz = np.diff(rows.indptr)
     kk = int(nnz.max(initial=1)) if k is None else int(k)
     kk = max(k_multiple, int(np.ceil(kk / k_multiple) * k_multiple))
-    indices = np.full((b, kk), pad_index, index_dtype)
-    values = None if binary else np.zeros((b, kk), np.float32)
+    indices = np.empty((b, kk), index_dtype)
+    values = None if binary else np.empty((b, kk), np.float32)
+
+    lib = native.load()
+    if lib is not None and index_dtype in (np.uint16, np.uint32):
+        import ctypes
+
+        indptr = np.ascontiguousarray(rows.indptr, np.int64)
+        cols = np.ascontiguousarray(rows.indices, np.int32)
+        # binary mode never reads values: skip the data conversion entirely
+        data = None if binary else np.ascontiguousarray(rows.data, np.float32)
+        ctype = ctypes.c_uint16 if index_dtype == np.uint16 else ctypes.c_uint32
+        pack = lib.pack_csr_u16 if index_dtype == np.uint16 else lib.pack_csr_u32
+        pack(native.as_ptr(indptr, ctypes.c_int64),
+             native.as_ptr(cols, ctypes.c_int32),
+             None if binary else native.as_ptr(data, ctypes.c_float),
+             b, kk, pad_index,
+             native.as_ptr(indices, ctype),
+             None if binary else native.as_ptr(values, ctypes.c_float),
+             min(8, max(1, b // 8192)))
+        return {"indices": indices, "values": values, "k": kk}
+
+    indices.fill(pad_index)
+    if values is not None:
+        values.fill(0.0)
     for i in range(b):
         lo, hi = rows.indptr[i], rows.indptr[i + 1]
         n = min(hi - lo, kk)

@@ -59,3 +59,24 @@ def test_main_autoencoder_triplet_end_to_end(workdir):
     ])
     assert set(aurocs) == {"count", "encoded"}
     assert all(0.0 <= v <= 1.0 for v in aurocs.values())
+
+
+def test_main_starspace_end_to_end(workdir):
+    from dae_rnn_news_recommendation_tpu.cli.main_starspace import main
+
+    result, aurocs = main([
+        "--model_name", "ss", "--synthetic", "--train_row", "150",
+        "--validate_row", "60", "--epochs", "4", "--threads", "2",
+        "--dim", "16", "--max_features", "300",
+    ])
+    assert len(result["epoch_errors"]) <= 4
+    assert np.isfinite(result["best_val_error"])
+    assert set(aurocs) == {"starspace_train", "starspace_validate",
+                           "tfidf_train", "tfidf_validate"}
+    d = "results/starspace/ss/"
+    for f in ("uci_train_starspace.txt", "uci_validate_starspace.txt",
+              "uci_train_starspace_embed.txt",
+              "uci_validate_starspace_embed.txt"):
+        assert os.path.isfile(d + f), f
+    emb = np.loadtxt(d + "uci_train_starspace_embed.txt")
+    assert emb.shape == (150, 16)

@@ -1,0 +1,149 @@
+"""Native (C++) components: csr packer and StarSpace-style baseline trainer.
+
+Test strategy follows the reference's oracle pattern (SURVEY.md §4): every
+native path is checked against a pure-Python/NumPy re-implementation.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from dae_rnn_news_recommendation_tpu import native
+from dae_rnn_news_recommendation_tpu.baselines import (
+    StarSpaceConfig, embed_docs, export_fasttext_format, train_starspace)
+from dae_rnn_news_recommendation_tpu.baselines.starspace import tokens_from_csr
+from dae_rnn_news_recommendation_tpu.ops.sparse_ingest import pad_csr_batch
+
+
+def _rand_csr(rng, n, f, density=0.05):
+    return sp.random(n, f, density=density, format="csr", dtype=np.float32,
+                     random_state=np.random.RandomState(rng.integers(1 << 30)))
+
+
+def test_native_library_builds():
+    """The build rules require real native components — the library must load
+    on this image (g++ is baked in), not silently fall back."""
+    assert native.load() is not None
+
+
+def _pad_py(rows, k=None, k_multiple=64, index_dtype=np.uint16, binary=False):
+    """The original pure-Python packer, kept verbatim as the oracle."""
+    rows = rows.tocsr()
+    b, f = rows.shape
+    pad_index = f if binary else 0
+    if f + (1 if binary else 0) > np.iinfo(index_dtype).max + 1:
+        index_dtype = np.uint32
+    nnz = np.diff(rows.indptr)
+    kk = int(nnz.max(initial=1)) if k is None else int(k)
+    kk = max(k_multiple, int(np.ceil(kk / k_multiple) * k_multiple))
+    indices = np.full((b, kk), pad_index, index_dtype)
+    values = None if binary else np.zeros((b, kk), np.float32)
+    for i in range(b):
+        lo, hi = rows.indptr[i], rows.indptr[i + 1]
+        n = min(hi - lo, kk)
+        indices[i, :n] = rows.indices[lo : lo + n].astype(index_dtype)
+        if not binary:
+            values[i, :n] = rows.data[lo : lo + n]
+    return {"indices": indices, "values": values, "k": kk}
+
+
+@pytest.mark.parametrize("binary", [False, True])
+@pytest.mark.parametrize("f", [500, 100_000])  # uint16 and uint32 index paths
+def test_native_packer_matches_python_oracle(binary, f):
+    rng = np.random.default_rng(0)
+    m = _rand_csr(rng, 257, f, density=0.03)
+    if binary:
+        m.data[:] = 1.0
+    got = pad_csr_batch(m, binary=binary)
+    want = _pad_py(m, binary=binary)
+    assert got["k"] == want["k"]
+    assert got["indices"].dtype == want["indices"].dtype
+    np.testing.assert_array_equal(got["indices"], want["indices"])
+    if binary:
+        assert got["values"] is None and want["values"] is None
+    else:
+        np.testing.assert_array_equal(got["values"], want["values"])
+
+
+def test_native_packer_truncates_and_pads():
+    # k smaller than a row's nnz -> truncation to first k; empty row -> all pad
+    m = sp.csr_matrix(np.array([[1, 2, 3, 4], [0, 0, 0, 0]], np.float32))
+    out = pad_csr_batch(m, k=2, k_multiple=2)
+    np.testing.assert_array_equal(out["indices"],
+                                  [[0, 1], [0, 0]])
+    np.testing.assert_array_equal(out["values"],
+                                  [[1, 2], [0, 0]])
+
+
+def _toy_corpus(rng, n=120, vocab=60, n_labels=3, words_per_doc=8):
+    """Separable corpus: each label owns a vocab slice."""
+    per = vocab // n_labels
+    labels = rng.integers(0, n_labels, n).astype(np.int32)
+    rows, cols = [], []
+    for i, y in enumerate(labels):
+        ws = y * per + rng.integers(0, per, words_per_doc)
+        rows.extend([i] * words_per_doc)
+        cols.extend(ws.tolist())
+    docs = sp.csr_matrix(
+        (np.ones(len(rows), np.float32), (rows, cols)), shape=(n, vocab))
+    return docs, labels
+
+
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_starspace_learns_separable_corpus(force_numpy):
+    """Training error must drop and learned embeddings must rank same-label
+    docs above other-label docs (hinge loss semantics, train.log:32-118 shows
+    the real binary's error dropping 0.078 -> 0.0008)."""
+    rng = np.random.default_rng(1)
+    docs, labels = _toy_corpus(rng)
+    config = StarSpaceConfig(dim=16, epochs=12, neg=5, threads=2, seed=3)
+    out = train_starspace(docs, labels, config=config,
+                          force_numpy=force_numpy)
+    errs = out["epoch_errors"]
+    assert len(errs) == config.epochs
+    assert errs[-1] < errs[0] * 0.5, errs
+
+    emb = embed_docs(docs, out["word_emb"])
+    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-8)
+    sim = emb @ emb.T
+    np.fill_diagonal(sim, 0.0)
+    same = labels[:, None] == labels[None, :]
+    np.fill_diagonal(same, False)
+    assert sim[same].mean() > sim[~same].mean() + 0.2
+
+
+def test_starspace_early_stopping_restores_best():
+    rng = np.random.default_rng(2)
+    docs, labels = _toy_corpus(rng, n=80)
+    vdocs, vlabels = _toy_corpus(rng, n=40)
+    config = StarSpaceConfig(dim=8, epochs=40, neg=3, threads=1, patience=3,
+                             seed=5)
+    out = train_starspace(docs, labels, vdocs, vlabels, config=config)
+    errs = out["epoch_errors"]
+    # early stop may trigger before all epochs ran
+    assert len(errs) <= config.epochs
+    assert out["best_val_error"] == pytest.approx(min(errs), abs=1e-9)
+
+
+def test_embed_docs_native_matches_numpy():
+    rng = np.random.default_rng(3)
+    docs = _rand_csr(rng, 50, 40, density=0.2)
+    word_emb = rng.normal(size=(40, 6)).astype(np.float32)
+    got = embed_docs(docs, word_emb)
+    docs_csr = docs.tocsr()
+    for i in range(50):
+        cols = docs_csr.indices[docs_csr.indptr[i]:docs_csr.indptr[i + 1]]
+        want = (word_emb[cols].mean(axis=0) if len(cols)
+                else np.zeros(6, np.float32))
+        np.testing.assert_allclose(got[i], want, rtol=1e-5, atol=1e-6)
+
+
+def test_fasttext_format_roundtrip(tmp_path):
+    docs = sp.csr_matrix(np.array([[1, 0, 1], [0, 1, 0]], np.float32))
+    vocab = {0: "alpha", 1: "beta", 2: "gamma"}
+    tokens = tokens_from_csr(docs, vocab)
+    assert tokens == [["alpha", "gamma"], ["beta"]]
+    path = tmp_path / "train.txt"
+    export_fasttext_format(tokens, ["b", "e"], path)
+    lines = path.read_text().splitlines()
+    assert lines == ["alpha gamma __label__b", "beta __label__e"]
