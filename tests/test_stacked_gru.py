@@ -1,0 +1,193 @@
+"""Tests for the net-new deep half of the pipeline: stacked DAE pretraining and the
+GRU user-state model (SURVEY.md §7 step 10 — the reference never implemented the RNN,
+reference README.md:5). Oracle style follows the reference's NumPy-loop pattern
+(reference autoencoder/tests/test_triplet_loss_utils.py:73-203)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu.models.stacked import StackedDenoisingAutoencoder
+from dae_rnn_news_recommendation_tpu.models.gru_user import (
+    GRUUserModel, gru_apply, gru_cell, gru_init_params, pairwise_rank_loss)
+
+
+# ---------------------------------------------------------------- stacked DAE
+
+def _toy_data(rng, n=96, f=30):
+    return (rng.uniform(size=(n, f)) < 0.15).astype(np.float32)
+
+
+def test_stacked_fit_encode_shapes(rng):
+    X = _toy_data(rng)
+    sdae = StackedDenoisingAutoencoder([16, 8], num_epochs=2, batch_size=32, seed=0)
+    sdae.fit(X)
+    assert len(sdae.params) == 2 and len(sdae.configs) == 2
+    assert sdae.configs[0].n_features == 30 and sdae.configs[0].n_components == 16
+    assert sdae.configs[1].n_features == 16 and sdae.configs[1].n_components == 8
+    codes = sdae.encode(X)
+    assert codes.shape == (96, 8)
+    assert np.isfinite(codes).all()
+
+
+def test_stacked_zero_row_embeds_to_zero(rng):
+    """The paper's modified encoder H=f(Wx+b)-f(b) maps x=0 to H=0; composition
+    through the stack preserves this (reference autoencoder.py:389 semantics at
+    every depth)."""
+    X = _toy_data(rng)
+    X[0] = 0.0
+    sdae = StackedDenoisingAutoencoder([12, 6], num_epochs=1, batch_size=32, seed=1)
+    sdae.fit(X)
+    codes = sdae.encode(X)
+    np.testing.assert_allclose(codes[0], 0.0, atol=1e-6)
+    assert np.abs(codes[1:]).sum() > 0
+
+
+def test_stacked_accepts_sparse_input(rng):
+    X = sp.csr_matrix(_toy_data(rng))
+    sdae = StackedDenoisingAutoencoder([10], num_epochs=1, batch_size=32, seed=2)
+    sdae.fit(X)
+    codes = sdae.encode(X)
+    assert codes.shape == (96, 10) and np.isfinite(codes).all()
+
+
+def test_stacked_corruption_only_at_data_layer(rng):
+    sdae = StackedDenoisingAutoencoder([8, 4], corr_type="masking", corr_frac=0.4,
+                                       num_epochs=1, batch_size=32)
+    sdae.fit(_toy_data(rng))
+    assert sdae.configs[0].corr_type == "masking"
+    assert sdae.configs[0].corr_frac == pytest.approx(0.4)
+    assert sdae.configs[1].corr_type == "none"
+    assert sdae.configs[1].corr_frac == 0.0
+
+
+def test_stacked_pretraining_reduces_reconstruction_error(rng):
+    """Layer-0 reconstruction after training beats the untrained init."""
+    from dae_rnn_news_recommendation_tpu.models.dae_core import (
+        DAEConfig, forward, init_params)
+
+    X = _toy_data(rng, n=128)
+    sdae = StackedDenoisingAutoencoder([16], num_epochs=8, batch_size=32,
+                                       learning_rate=0.5, seed=3)
+    sdae.fit(X)
+    cfg = sdae.configs[0]
+    x = jnp.asarray(X)
+
+    def mse(params):
+        _, recon = forward(params, x, cfg)
+        return float(jnp.mean((recon - x) ** 2))
+
+    untrained = init_params(jax.random.PRNGKey(99), cfg)
+    assert mse(sdae.params[0]) < mse(untrained)
+
+
+# ---------------------------------------------------------------- GRU cell/apply
+
+def _np_gru_cell(p, h, x):
+    """NumPy oracle of the standard GRU update."""
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    z = sig(x @ p["Wz"] + h @ p["Uz"] + p["bz"])
+    r = sig(x @ p["Wr"] + h @ p["Ur"] + p["br"])
+    n = np.tanh(x @ p["Wn"] + (r * h) @ p["Un"] + p["bn"])
+    return (1.0 - z) * n + z * h
+
+
+def test_gru_cell_matches_numpy_oracle(rng):
+    d, hdim, b = 5, 7, 4
+    params = gru_init_params(jax.random.PRNGKey(0), d, hdim)
+    p_np = {k: np.asarray(v) for k, v in params.items()}
+    h = rng.normal(size=(b, hdim)).astype(np.float32)
+    x = rng.normal(size=(b, d)).astype(np.float32)
+    got = np.asarray(gru_cell(params, jnp.asarray(h), jnp.asarray(x)))
+    np.testing.assert_allclose(got, _np_gru_cell(p_np, h, x), atol=1e-5)
+
+
+def test_gru_apply_matches_stepwise_oracle(rng):
+    d, hdim, b, t = 4, 6, 3, 5
+    params = gru_init_params(jax.random.PRNGKey(1), d, hdim)
+    p_np = {k: np.asarray(v) for k, v in params.items()}
+    seq = rng.normal(size=(b, t, d)).astype(np.float32)
+    states, final = gru_apply(params, jnp.asarray(seq))
+    h = np.zeros((b, hdim), np.float32)
+    for step in range(t):
+        h = _np_gru_cell(p_np, h, seq[:, step])
+        np.testing.assert_allclose(np.asarray(states[:, step]), h, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(final), h, atol=1e-5)
+
+
+def test_gru_mask_carries_state_through(rng):
+    """A masked (padding) step must leave the state unchanged: running [x1, x2, pad]
+    yields the same final state as running [x1, x2]."""
+    d, hdim = 4, 5
+    params = gru_init_params(jax.random.PRNGKey(2), d, hdim)
+    seq = rng.normal(size=(2, 3, d)).astype(np.float32)
+    mask = np.array([[1.0, 1.0, 0.0], [1.0, 1.0, 0.0]], np.float32)
+    _, final_masked = gru_apply(params, jnp.asarray(seq), jnp.asarray(mask))
+    _, final_short = gru_apply(params, jnp.asarray(seq[:, :2]))
+    np.testing.assert_allclose(np.asarray(final_masked), np.asarray(final_short),
+                               atol=1e-6)
+
+
+def test_rank_loss_prefers_separating_params(rng):
+    """Loss is softplus(-(s_pos - s_neg)): params scoring pos above neg must have a
+    lower loss than params scoring them equally (softplus(0)=log 2)."""
+    d, hdim, b, t = 3, 3, 4, 2
+    params = gru_init_params(jax.random.PRNGKey(3), d, hdim)
+    seq = rng.normal(size=(b, t, d)).astype(np.float32)
+    states, _ = gru_apply(params, jnp.asarray(seq))
+    st = np.asarray(states)
+    pos = st * 100.0 / (np.linalg.norm(st, axis=-1, keepdims=True) + 1e-8)
+    neg = -pos                               # aligned with the state -> s_pos >> s_neg
+    loss_sep = float(pairwise_rank_loss(params, jnp.asarray(seq), jnp.asarray(pos),
+                                        jnp.asarray(neg)))
+    loss_tied = float(pairwise_rank_loss(params, jnp.asarray(seq), jnp.asarray(pos),
+                                         jnp.asarray(pos)))
+    assert loss_sep < 0.05 < loss_tied
+    assert loss_tied == pytest.approx(np.log(2.0), abs=1e-5)
+
+
+def test_gru_user_model_learns_and_scores(rng):
+    """End-to-end: training reduces the rank loss on a learnable synthetic task
+    (clicked articles point along a fixed direction, negatives opposite)."""
+    n, t, d = 32, 4, 8
+    direction = rng.normal(size=(d,)).astype(np.float32)
+    direction /= np.linalg.norm(direction)
+    seq = rng.normal(size=(n, t, d)).astype(np.float32) * 0.1 + direction
+    pos = np.broadcast_to(direction, (n, t, d)).astype(np.float32)
+    neg = -pos + rng.normal(size=(n, t, d)).astype(np.float32) * 0.01
+
+    model = GRUUserModel(d_embed=d, d_hidden=d, num_epochs=1, batch_size=16, seed=0)
+    model.fit(seq[:2], pos[:2], neg[:2])  # barely-trained baseline
+    loss_before = float(pairwise_rank_loss(
+        model.params, jnp.asarray(seq), jnp.asarray(pos), jnp.asarray(neg)))
+
+    model = GRUUserModel(d_embed=d, d_hidden=d, num_epochs=30, batch_size=16, seed=0)
+    model.fit(seq, pos, neg)
+    loss_after = float(pairwise_rank_loss(
+        model.params, jnp.asarray(seq), jnp.asarray(pos), jnp.asarray(neg)))
+    assert loss_after < loss_before
+
+    states = model.user_state(seq)
+    assert states.shape == (n, d)
+    cands = np.stack([direction, -direction])
+    scores = model.score(seq, cands)
+    assert scores.shape == (n, 2)
+    # the trained user state should prefer the clicked direction
+    assert (scores[:, 0] > scores[:, 1]).mean() > 0.9
+
+
+def test_gru_fit_with_ragged_mask(rng):
+    n, t, d = 8, 5, 4
+    seq = rng.normal(size=(n, t, d)).astype(np.float32)
+    pos = rng.normal(size=(n, t, d)).astype(np.float32)
+    neg = rng.normal(size=(n, t, d)).astype(np.float32)
+    lengths = rng.integers(1, t + 1, size=n)
+    mask = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float32)
+    model = GRUUserModel(d_embed=d, num_epochs=2, batch_size=4, seed=1)
+    model.fit(seq, pos, neg, mask)
+    assert model.params is not None
+    states = model.user_state(seq, mask)
+    assert np.isfinite(states).all()
