@@ -1,0 +1,91 @@
+"""Legacy image-dataset driver: DAE on MNIST / CIFAR-10, unsupervised.
+
+Twin of the reference's autoencoder/run_autoencoder.py (flags :9-46, main :48-90),
+which is BROKEN upstream — it passes n_components=/dataset= kwargs the current ctor
+does not accept and imports an empty package (SURVEY §2.3.7). This version actually
+runs: the estimator grew an explicit `n_components` override, and the dataset flag
+only selects the loader.
+
+Run: python -m dae_rnn_news_recommendation_tpu.cli.run_autoencoder \
+        --dataset mnist --n_components 64 --num_epochs 5 --verbose
+"""
+
+import argparse
+
+from ..data.image_datasets import MNIST_SHAPE, load_cifar10_dataset, load_mnist_dataset
+from ..models import DenoisingAutoencoder
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="DAE on legacy image datasets (MNIST/CIFAR-10)")
+    # global configuration (reference run_autoencoder.py:13-21)
+    p.add_argument("--model_name", default="dae")
+    p.add_argument("--dataset", default="mnist", choices=["mnist", "cifar10"])
+    p.add_argument("--cifar_dir", default="")
+    p.add_argument("--mnist_dir", default="MNIST_data/")
+    p.add_argument("--seed", type=int, default=-1)
+    p.add_argument("--restore_previous_model", action="store_true", default=False)
+    p.add_argument("--encode_train", action="store_true", default=False)
+    p.add_argument("--encode_valid", action="store_true", default=False)
+    p.add_argument("--encode_test", action="store_true", default=False)
+    # model parameters (reference :24-40)
+    p.add_argument("--n_components", type=int, default=256)
+    p.add_argument("--corr_type", default="none",
+                   choices=["none", "masking", "salt_and_pepper", "decay"])
+    p.add_argument("--corr_frac", type=float, default=0.0)
+    p.add_argument("--xavier_init", type=int, default=1)
+    p.add_argument("--enc_act_func", default="tanh", choices=["sigmoid", "tanh"])
+    p.add_argument("--dec_act_func", default="none", choices=["sigmoid", "tanh", "none"])
+    p.add_argument("--main_dir", default="legacy")
+    p.add_argument("--loss_func", default="mean_squared",
+                   choices=["cross_entropy", "mean_squared"])
+    p.add_argument("--verbose", type=int, default=0)
+    p.add_argument("--weight_images", type=int, default=0)
+    p.add_argument("--opt", default="gradient_descent",
+                   choices=["gradient_descent", "ada_grad", "momentum", "adam"])
+    p.add_argument("--learning_rate", type=float, default=0.01)
+    p.add_argument("--momentum", type=float, default=0.5)
+    p.add_argument("--num_epochs", type=int, default=10)
+    p.add_argument("--batch_size", type=int, default=10)
+    return p
+
+
+def main(argv=None):
+    FLAGS = build_parser().parse_args(argv)
+    assert 0.0 <= FLAGS.corr_frac <= 1.0
+
+    if FLAGS.dataset == "mnist":
+        trX, vlX, teX = load_mnist_dataset(mode="unsupervised", data_dir=FLAGS.mnist_dir)
+        width, height = MNIST_SHAPE
+    else:
+        trX, teX = load_cifar10_dataset(FLAGS.cifar_dir, mode="unsupervised")
+        vlX = teX[: max(1, len(teX) // 2)]  # reference: first half of test (:66)
+        width = height = 32
+
+    dae = DenoisingAutoencoder(
+        seed=FLAGS.seed, model_name=FLAGS.model_name,
+        n_components=FLAGS.n_components, enc_act_func=FLAGS.enc_act_func,
+        dec_act_func=FLAGS.dec_act_func, xavier_init=FLAGS.xavier_init,
+        corr_type=FLAGS.corr_type, corr_frac=FLAGS.corr_frac,
+        loss_func=FLAGS.loss_func, main_dir=FLAGS.main_dir, opt=FLAGS.opt,
+        learning_rate=FLAGS.learning_rate, momentum=FLAGS.momentum,
+        verbose=FLAGS.verbose, num_epochs=FLAGS.num_epochs,
+        batch_size=FLAGS.batch_size, triplet_strategy="none")
+
+    # unsupervised: validation is the test set, like the reference (:85)
+    dae.fit(trX, teX, restore_previous_model=FLAGS.restore_previous_model)
+
+    if FLAGS.encode_train:
+        dae.transform(trX, name="train", save=True)
+    if FLAGS.encode_valid:
+        dae.transform(vlX, name="validation", save=True)
+    if FLAGS.encode_test:
+        dae.transform(teX, name="test", save=True)
+
+    if FLAGS.weight_images > 0:
+        dae.get_weights_as_images(width, height, max_images=FLAGS.weight_images)
+    return dae
+
+
+if __name__ == "__main__":
+    main()

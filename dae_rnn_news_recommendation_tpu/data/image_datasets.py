@@ -1,0 +1,147 @@
+"""Legacy image datasets: MNIST and CIFAR-10 loaders.
+
+Twin of the reference's autoencoder/datasets.py (load_mnist_dataset :18-44,
+load_cifar10_dataset :47-91) with the network dependency removed: the reference pulls
+MNIST through tensorflow.examples.tutorials input_data (which downloads); this
+environment has zero egress, so these loaders read the standard on-disk formats when
+present (IDX ubyte[.gz] for MNIST, the cPickle batch files for CIFAR-10) and fall
+back to a deterministic synthetic corpus with the same shapes/ranges otherwise —
+keeping the legacy driver (cli/run_autoencoder.py) runnable anywhere.
+
+Return conventions match the reference exactly:
+  mnist supervised   -> (trX, trY, vlX, vlY, teX, teY)
+  mnist unsupervised -> (trX, vlX, teX)
+  cifar supervised   -> (trX, trY, teX, teY)
+  cifar unsupervised -> (trX, teX)
+Images are float32 in [0, 1], flattened (784 / 3072); labels int or one-hot.
+"""
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+MNIST_SHAPE = (28, 28)
+MNIST_FEATURES = 28 * 28
+CIFAR_FEATURES = 32 * 32 * 3
+
+_MNIST_FILES = {
+    "train_images": "train-images-idx3-ubyte",
+    "train_labels": "train-labels-idx1-ubyte",
+    "test_images": "t10k-images-idx3-ubyte",
+    "test_labels": "t10k-labels-idx1-ubyte",
+}
+
+
+def _open_maybe_gz(path):
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    return open(path, "rb")
+
+
+def read_idx(path):
+    """Parse an IDX ubyte file (magic 2051 = images, 2049 = labels)."""
+    with _open_maybe_gz(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        if magic == 2049:  # labels: [n] uint8
+            (n,) = struct.unpack(">I", f.read(4))
+            return np.frombuffer(f.read(n), np.uint8).astype(np.int64)
+        if magic == 2051:  # images: [n, rows, cols] uint8
+            n, rows, cols = struct.unpack(">III", f.read(12))
+            data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+            return data.reshape(n, rows * cols).astype(np.float32) / 255.0
+        raise ValueError(f"{path}: unknown IDX magic {magic}")
+
+
+def _one_hot(y, n_classes=10):
+    out = np.zeros((len(y), n_classes), np.float32)
+    out[np.arange(len(y)), np.asarray(y, np.int64)] = 1.0
+    return out
+
+
+def synthetic_digit_images(n, n_features=MNIST_FEATURES, n_classes=10, seed=0):
+    """Deterministic class-structured images: each class is a Gaussian bump at a
+    class-specific location plus noise, clipped to [0, 1]. Learnable by a DAE and
+    linearly separable enough for sanity checks; NOT real MNIST."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, n_classes, n)
+    grid = np.linspace(0.0, 1.0, n_features, dtype=np.float32)
+    centers = (np.arange(n_classes) + 0.5) / n_classes
+    width = 0.35 / n_classes
+    base = np.exp(-0.5 * ((grid[None, :] - centers[y][:, None]) / width) ** 2)
+    imgs = 0.85 * base + 0.15 * rng.uniform(size=(n, n_features))
+    return np.clip(imgs, 0.0, 1.0).astype(np.float32), y.astype(np.int64)
+
+
+def load_mnist_dataset(mode="supervised", one_hot=True, data_dir="MNIST_data/",
+                       synthetic_sizes=(1000, 200, 200), seed=0):
+    """Load MNIST (reference datasets.py:18-44). Reads IDX[.gz] files from
+    `data_dir` when they exist; otherwise generates a synthetic stand-in with
+    `synthetic_sizes` = (train, validation, test) rows. The real split mirrors the
+    reference's tutorial reader: last 5000 training rows become validation."""
+    assert mode in ("supervised", "unsupervised")
+    paths = {k: os.path.join(data_dir, v) for k, v in _MNIST_FILES.items()}
+    have_real = all(os.path.exists(p) or os.path.exists(p + ".gz")
+                    for p in paths.values())
+    if have_real:
+        X = read_idx(paths["train_images"])
+        y = read_idx(paths["train_labels"])
+        teX = read_idx(paths["test_images"])
+        teY = read_idx(paths["test_labels"])
+        n_val = min(5000, max(1, len(X) // 10))
+        trX, trY = X[:-n_val], y[:-n_val]
+        vlX, vlY = X[-n_val:], y[-n_val:]
+    else:
+        n_tr, n_vl, n_te = synthetic_sizes
+        X, y = synthetic_digit_images(n_tr + n_vl + n_te, MNIST_FEATURES, seed=seed)
+        trX, trY = X[:n_tr], y[:n_tr]
+        vlX, vlY = X[n_tr:n_tr + n_vl], y[n_tr:n_tr + n_vl]
+        teX, teY = X[n_tr + n_vl:], y[n_tr + n_vl:]
+
+    if mode == "unsupervised":
+        return trX, vlX, teX
+    if one_hot:
+        trY, vlY, teY = _one_hot(trY), _one_hot(vlY), _one_hot(teY)
+    return trX, trY, vlX, vlY, teX, teY
+
+
+def load_cifar10_dataset(cifar_dir, mode="supervised",
+                         synthetic_sizes=(1000, 200), seed=0):
+    """Load CIFAR-10 from the python pickle batches (reference datasets.py:47-91:
+    files starting with 'data' are training batches, 'test' is the test batch).
+    Falls back to a synthetic stand-in when the directory has no batch files."""
+    assert mode in ("supervised", "unsupervised")
+    trX, trY, teX, teY = None, None, None, None
+    if cifar_dir and os.path.isdir(cifar_dir):
+        for fn in sorted(os.listdir(cifar_dir)):
+            if fn.startswith("batches") or fn.startswith("readme"):
+                continue
+            if not (fn.startswith("data") or fn.startswith("test")):
+                continue
+            with open(os.path.join(cifar_dir, fn), "rb") as f:
+                batch = pickle.load(f, encoding="bytes")
+            data = np.asarray(batch.get(b"data", batch.get("data")))
+            labels = np.asarray(batch.get(b"labels", batch.get("labels")))
+            if fn.startswith("data"):
+                trX = data if trX is None else np.concatenate([trX, data])
+                trY = labels if trY is None else np.concatenate([trY, labels])
+            else:
+                teX, teY = data, labels
+    if (trX is None) != (teX is None):
+        raise FileNotFoundError(
+            f"{cifar_dir}: found {'training' if teX is None else 'test'} batches but "
+            f"not the {'test_batch' if teX is None else 'data_batch_*'} files — "
+            "refusing to silently substitute synthetic data for a partial dataset")
+    if trX is None:
+        n_tr, n_te = synthetic_sizes
+        X, y = synthetic_digit_images(n_tr + n_te, CIFAR_FEATURES, seed=seed)
+        trX, trY = X[:n_tr] * 255.0, y[:n_tr]
+        teX, teY = X[n_tr:] * 255.0, y[n_tr:]
+
+    trX = np.asarray(trX, np.float32) / 255.0
+    teX = np.asarray(teX, np.float32) / 255.0
+    if mode == "unsupervised":
+        return trX, teX
+    return trX, np.asarray(trY, np.int64), teX, np.asarray(teY, np.int64)

@@ -60,8 +60,13 @@ class DenoisingAutoencoder:
                  # --- TPU-native extras (no reference counterpart) ---
                  compute_dtype="float32", checkpoint_every=0, val_batch_size=512,
                  n_devices=1, mesh=None, mining_scope="global", results_root="results",
-                 use_tensorboard=True):
+                 use_tensorboard=True, n_components=None):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
+
+        :param n_components: explicit code size; overrides the compress_factor
+            derivation. This is the parameter the reference's legacy driver passed
+            but its ctor no longer accepted (run_autoencoder.py:74 vs
+            autoencoder.py:20-23 — defect SURVEY §2.3.7, fixed here).
 
         :param compute_dtype: 'float32' | 'bfloat16' for the wide encode/decode matmuls
         :param checkpoint_every: also checkpoint every N epochs (0 = end of fit only)
@@ -111,6 +116,7 @@ class DenoisingAutoencoder:
         self.parameter_file = os.path.join(self.tf_summary_dir, "parameter.txt")
 
         self.sparse_input = None
+        self.n_components_override = n_components
         self.n_components = None
         self.config = None
         self.params = None
@@ -131,6 +137,7 @@ class DenoisingAutoencoder:
             "corr_frac": self.corr_frac, "verbose": self.verbose,
             "verbose_step": self.verbose_step, "seed": self.seed,
             "alpha": self.alpha, "triplet_strategy": self.triplet_strategy,
+            "n_components": self.n_components_override,
             "compute_dtype": self.compute_dtype, "n_devices": self.n_devices,
             "mining_scope": self.mining_scope,
         }
@@ -140,7 +147,12 @@ class DenoisingAutoencoder:
         return jax.random.PRNGKey(int(seed))
 
     def _make_config(self, n_features):
-        self.n_components = int(np.floor(n_features / self.compress_factor))
+        if self.n_components_override is not None:
+            assert int(self.n_components_override) > 0, (
+                f"n_components must be positive, got {self.n_components_override}")
+            self.n_components = int(self.n_components_override)
+        else:
+            self.n_components = int(np.floor(n_features / self.compress_factor))
         return DAEConfig(
             n_features=int(n_features), n_components=self.n_components,
             enc_act_func=self.enc_act_func, dec_act_func=self.dec_act_func,

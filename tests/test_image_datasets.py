@@ -1,0 +1,169 @@
+"""Legacy image datasets + driver (reference autoencoder/datasets.py and
+run_autoencoder.py — the latter broken upstream, SURVEY §2.3.7; ours must run)."""
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from dae_rnn_news_recommendation_tpu.data.image_datasets import (
+    CIFAR_FEATURES, MNIST_FEATURES, load_cifar10_dataset, load_mnist_dataset,
+    read_idx, synthetic_digit_images)
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+# ---------------------------------------------------------------- synthetic path
+
+def test_mnist_synthetic_supervised_shapes():
+    trX, trY, vlX, vlY, teX, teY = load_mnist_dataset(
+        data_dir="does_not_exist/", synthetic_sizes=(50, 10, 20))
+    assert trX.shape == (50, MNIST_FEATURES) and trY.shape == (50, 10)
+    assert vlX.shape == (10, MNIST_FEATURES) and vlY.shape == (10, 10)
+    assert teX.shape == (20, MNIST_FEATURES) and teY.shape == (20, 10)
+    assert trX.dtype == np.float32
+    assert trX.min() >= 0.0 and trX.max() <= 1.0
+    np.testing.assert_allclose(trY.sum(axis=1), 1.0)  # valid one-hot
+
+
+def test_mnist_synthetic_int_labels_and_unsupervised():
+    tr6 = load_mnist_dataset(one_hot=False, data_dir="does_not_exist/",
+                             synthetic_sizes=(30, 5, 5))
+    assert tr6[1].shape == (30,) and tr6[1].dtype == np.int64
+    trX, vlX, teX = load_mnist_dataset(mode="unsupervised",
+                                       data_dir="does_not_exist/",
+                                       synthetic_sizes=(30, 5, 5))
+    assert trX.shape == (30, MNIST_FEATURES)
+    np.testing.assert_array_equal(trX, tr6[0])  # same seed -> same data
+
+
+def test_synthetic_images_are_class_structured():
+    """Same-class images must be more similar than cross-class ones (the loaders'
+    stand-in has to be learnable for the driver's DAE to produce signal)."""
+    X, y = synthetic_digit_images(200, seed=1)
+    X = X - X.mean(axis=0)
+    same, diff = [], []
+    for c in range(10):
+        mc = X[y == c]
+        if len(mc) > 1:
+            same.append(np.corrcoef(mc[0], mc[1])[0, 1])
+        other = X[y != c]
+        diff.append(np.corrcoef(mc[0], other[0])[0, 1])
+    assert np.mean(same) > np.mean(diff) + 0.2
+
+
+# ---------------------------------------------------------------- real-format parsing
+
+def _write_idx_images(path, arr_uint8, gz=True):
+    n, rows, cols = arr_uint8.shape
+    payload = struct.pack(">IIII", 2051, n, rows, cols) + arr_uint8.tobytes()
+    opener = gzip.open if gz else open
+    with opener(path + (".gz" if gz else ""), "wb") as f:
+        f.write(payload)
+
+
+def _write_idx_labels(path, labels_uint8, gz=True):
+    payload = struct.pack(">II", 2049, len(labels_uint8)) + labels_uint8.tobytes()
+    opener = gzip.open if gz else open
+    with opener(path + (".gz" if gz else ""), "wb") as f:
+        f.write(payload)
+
+
+def test_mnist_idx_round_trip(workdir):
+    d = str(workdir / "MNIST_data")
+    os.makedirs(d)
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(40, 28, 28), dtype=np.uint8)
+    labs = rng.integers(0, 10, size=40, dtype=np.uint8)
+    te_imgs = rng.integers(0, 256, size=(10, 28, 28), dtype=np.uint8)
+    te_labs = rng.integers(0, 10, size=10, dtype=np.uint8)
+    _write_idx_images(os.path.join(d, "train-images-idx3-ubyte"), imgs)
+    _write_idx_labels(os.path.join(d, "train-labels-idx1-ubyte"), labs)
+    _write_idx_images(os.path.join(d, "t10k-images-idx3-ubyte"), te_imgs, gz=False)
+    _write_idx_labels(os.path.join(d, "t10k-labels-idx1-ubyte"), te_labs, gz=False)
+
+    trX, trY, vlX, vlY, teX, teY = load_mnist_dataset(one_hot=False, data_dir=d)
+    # n_val = min(5000, 40//10) = 4 -> 36 train / 4 validation
+    assert trX.shape == (36, 784) and vlX.shape == (4, 784)
+    assert teX.shape == (10, 784)
+    np.testing.assert_allclose(trX[0], imgs[0].reshape(-1) / 255.0)
+    np.testing.assert_array_equal(trY, labs[:36])
+    np.testing.assert_array_equal(vlY, labs[36:])
+    np.testing.assert_array_equal(teY, te_labs)
+
+
+def test_read_idx_rejects_bad_magic(workdir):
+    path = str(workdir / "bad")
+    with open(path, "wb") as f:
+        f.write(struct.pack(">II", 1234, 0))
+    with pytest.raises(ValueError, match="magic"):
+        read_idx(path)
+
+
+def test_cifar_pickle_round_trip(workdir):
+    d = str(workdir / "cifar-10-batches-py")
+    os.makedirs(d)
+    rng = np.random.default_rng(0)
+    tr1 = {b"data": rng.integers(0, 256, (20, CIFAR_FEATURES), dtype=np.uint8),
+           b"labels": list(rng.integers(0, 10, 20))}
+    tr2 = {b"data": rng.integers(0, 256, (15, CIFAR_FEATURES), dtype=np.uint8),
+           b"labels": list(rng.integers(0, 10, 15))}
+    te = {b"data": rng.integers(0, 256, (10, CIFAR_FEATURES), dtype=np.uint8),
+          b"labels": list(rng.integers(0, 10, 10))}
+    for name, batch in (("data_batch_1", tr1), ("data_batch_2", tr2),
+                        ("test_batch", te), ("readme.html", None),
+                        ("batches.meta", None)):
+        with open(os.path.join(d, name), "wb") as f:
+            if batch is not None:
+                pickle.dump(batch, f)
+
+    trX, trY, teX, teY = load_cifar10_dataset(d)
+    assert trX.shape == (35, CIFAR_FEATURES) and teX.shape == (10, CIFAR_FEATURES)
+    assert trX.max() <= 1.0
+    np.testing.assert_allclose(trX[0], tr1[b"data"][0] / 255.0, atol=1e-6)
+    np.testing.assert_array_equal(trY[:20], tr1[b"labels"])
+    np.testing.assert_array_equal(teY, te[b"labels"])
+
+    trX_u, teX_u = load_cifar10_dataset(d, mode="unsupervised")
+    np.testing.assert_array_equal(trX_u, trX)
+
+
+def test_cifar_synthetic_fallback():
+    trX, trY, teX, teY = load_cifar10_dataset("", synthetic_sizes=(25, 10))
+    assert trX.shape == (25, CIFAR_FEATURES) and teX.shape == (10, CIFAR_FEATURES)
+    assert 0.0 <= trX.min() and trX.max() <= 1.0
+
+
+# ---------------------------------------------------------------- legacy driver e2e
+
+def test_run_autoencoder_driver_mnist(workdir):
+    """The reference's legacy driver crashes on ctor kwargs (SURVEY §2.3.7);
+    ours must train, encode, and emit weight images end to end."""
+    from dae_rnn_news_recommendation_tpu.cli.run_autoencoder import main
+
+    dae = main(["--dataset", "mnist", "--mnist_dir", "none/", "--n_components", "16",
+                "--num_epochs", "2", "--batch_size", "25", "--opt", "ada_grad",
+                "--learning_rate", "0.1", "--corr_type", "masking",
+                "--corr_frac", "0.3", "--encode_train", "--weight_images", "3",
+                "--seed", "0"])
+    assert dae.n_components == 16
+    enc = np.load(os.path.join(dae.data_dir, "train.npy"))
+    assert enc.shape[1] == 16 and np.isfinite(enc).all()
+    img_dir = os.path.join(dae.data_dir, "img/")
+    assert len([f for f in os.listdir(img_dir) if f.endswith(".png")]) == 3
+
+
+def test_run_autoencoder_driver_cifar(workdir):
+    from dae_rnn_news_recommendation_tpu.cli.run_autoencoder import main
+
+    dae = main(["--dataset", "cifar10", "--n_components", "8",
+                "--num_epochs", "1", "--batch_size", "50", "--seed", "1"])
+    assert dae.n_components == 8
+    assert dae.config.n_features == CIFAR_FEATURES
