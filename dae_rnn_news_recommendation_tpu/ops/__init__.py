@@ -28,3 +28,14 @@ from .triplet import (  # noqa: F401
     batch_hard_triplet_loss,
     precomputed_triplet_loss,
 )
+_PALLAS_EXPORTS = ("batch_all_triplet_loss_pallas", "masking_noise_pallas")
+
+
+def __getattr__(name):
+    """Lazy: jax.experimental.pallas (experimental API) loads only when the Pallas
+    kernels are actually used, keeping the production XLA paths decoupled."""
+    if name in _PALLAS_EXPORTS:
+        from . import pallas_kernels
+
+        return getattr(pallas_kernels, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
