@@ -9,6 +9,9 @@ shardings, let XLA insert the collectives over ICI.
             [B, D] embeddings — cheap on ICI), 'shard' mines per shard via shard_map
   ring.py — ring-allgather blockwise pairwise similarity (the O(N^2) eval kernel,
             sharded by rows, blocks rotated over the ring with ppermute)
+  seq.py  — sequence/context parallelism: the GRU user-model recurrence pipelined
+            over a time-sharded mesh (GPipe along T; only [Bm, H] states cross
+            devices), exact-semantics and differentiable
 """
 
 from .mesh import get_mesh, get_mesh_2d  # noqa: F401
@@ -19,3 +22,4 @@ from .dp import (  # noqa: F401
     batch_shardings,
 )
 from .ring import ring_pairwise_similarity  # noqa: F401
+from .seq import pipeline_gru_apply  # noqa: F401

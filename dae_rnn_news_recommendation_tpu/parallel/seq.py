@@ -1,0 +1,97 @@
+"""Sequence (context) parallelism for the GRU user model.
+
+The GRU recurrence (models/gru_user.py — the net-new second half of the Yahoo!
+pipeline) is sequential in T, so long-context scaling can't shard T naively: state
+at chunk c needs the state out of chunk c-1. This module pipelines it, the
+time-axis analog of GPipe:
+
+  - the time axis is sharded over the mesh: device d holds chunk [d*T/P, (d+1)*T/P)
+    of every sequence (shard_map in_spec P(None, 'seq', None));
+  - the batch is split into M microbatches; at pipeline step s device d scans its
+    local chunk for microbatch m = s - d, then hands the resulting [Bm, H] state to
+    device d+1 with `ppermute` (one hop on the ICI ring) while starting microbatch
+    m+1. After M + P - 1 steps every chunk of every microbatch has been scanned
+    exactly once — work-conserving, with the usual (P-1)/(M+P-1) pipeline bubble;
+  - only [Bm, H] states cross devices (H ~ 500: KBs per hop), never the [B, T, D]
+    activations — the property that makes ring/CP formulations win for long T;
+  - per-step states stay resident where their chunk lives: the output [B, T, H] is
+    sharded over T exactly like the input, so the downstream pairwise rank loss
+    (pairwise_rank_loss) consumes it without any gather.
+
+Semantics match gru_apply exactly (same masks-carry-state rule, tested against it
+on a virtual 8-device mesh), so this is a drop-in for long histories.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..models.gru_user import gru_apply
+
+
+def pipeline_gru_apply(params, seq, mask, mesh, axis_name="seq", microbatches=None):
+    """gru_apply over a time-sharded mesh: returns (states [B, T, H] sharded over T,
+    final [B, H] replicated).
+
+    :param seq: [B, T, D]; T divisible by mesh[axis_name], B by `microbatches`
+    :param mask: [B, T] (1.0 = real step); required — pass ones for dense histories
+    :param microbatches: pipeline microbatch count (default: the mesh size, which
+        bounds the bubble at ~50%; raise it to amortize further)
+    """
+    n_dev = mesh.shape[axis_name]
+    b, t, d = seq.shape
+    h_dim = params["bz"].shape[0]
+    m_micro = n_dev if microbatches is None else int(microbatches)
+    assert m_micro >= 1, f"microbatches must be >= 1, got {microbatches}"
+    assert t % n_dev == 0, f"T={t} not divisible by mesh axis {n_dev}"
+    assert b % m_micro == 0, f"B={b} not divisible by microbatches {m_micro}"
+    bm = b // m_micro
+
+    def local_fn(params, seq_l, mask_l):
+        # seq_l [B, Tc, D], mask_l [B, Tc] — this device's time chunk
+        stage = jax.lax.axis_index(axis_name)
+        tc = seq_l.shape[1]
+        seq_m = seq_l.reshape(m_micro, bm, tc, d)
+        mask_m = mask_l.reshape(m_micro, bm, tc)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+        def body(s, carry):
+            recv, states_buf, finals = carry
+            m = s - stage
+            active = (m >= 0) & (m < m_micro)
+            mc = jnp.clip(m, 0, m_micro - 1)
+            x = jax.lax.dynamic_index_in_dim(seq_m, mc, 0, keepdims=False)
+            mk = jax.lax.dynamic_index_in_dim(mask_m, mc, 0, keepdims=False)
+            # stage 0 starts every microbatch from zeros; later stages continue
+            # from the state handed over by the previous chunk
+            h0 = jnp.where(stage == 0, jnp.zeros_like(recv), recv)
+            states_c, h_out = gru_apply(params, x, mk, h0=h0)
+
+            upd = jax.lax.dynamic_update_index_in_dim(states_buf, states_c, mc, 0)
+            states_buf = jnp.where(active, upd, states_buf)
+            upd_f = jax.lax.dynamic_update_index_in_dim(finals, h_out, mc, 0)
+            finals = jnp.where(active & (stage == n_dev - 1), upd_f, finals)
+
+            # one ICI hop; the wrapped-around value into stage 0 is never read
+            recv = jax.lax.ppermute(h_out, axis_name, perm)
+            return recv, states_buf, finals
+
+        zeros_h = jnp.zeros((bm, h_dim), seq_l.dtype)
+        states_buf = jnp.zeros((m_micro, bm, tc, h_dim), seq_l.dtype)
+        finals = jnp.zeros((m_micro, bm, h_dim), seq_l.dtype)
+        recv = jax.lax.pcast(zeros_h, (axis_name,), to="varying")
+        states_buf = jax.lax.pcast(states_buf, (axis_name,), to="varying")
+        finals = jax.lax.pcast(finals, (axis_name,), to="varying")
+        _, states_buf, finals = jax.lax.fori_loop(
+            0, m_micro + n_dev - 1, body, (recv, states_buf, finals))
+
+        # finals live on the last stage only — psum replicates them everywhere
+        finals = jax.lax.psum(finals, axis_name)
+        return states_buf.reshape(b, tc, h_dim), finals.reshape(b, h_dim)
+
+    fn = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(), P(None, axis_name, None), P(None, axis_name)),
+        out_specs=(P(None, axis_name, None), P()),
+    )
+    return fn(params, seq, mask)

@@ -15,9 +15,11 @@ from dae_rnn_news_recommendation_tpu.ops.pallas_kernels import (
     batch_all_triplet_loss_pallas, masking_noise_pallas)
 
 ON_TPU = jax.default_backend() == "tpu"
+# compiled Mosaic requires tk % 128 == 0; the interpreter takes any tile
+DEFAULT_TILES = (8, 128, 128) if ON_TPU else (8, 16, 16)
 
 
-def _compare(labels, enc, pos_only, row_valid, tiles=(8, 16, 16)):
+def _compare(labels, enc, pos_only, row_valid, tiles=DEFAULT_TILES):
     ref = triplet.batch_all_triplet_loss(labels, enc, pos_triplets_only=pos_only,
                                          row_valid=row_valid)
     got = batch_all_triplet_loss_pallas(labels, enc, pos_triplets_only=pos_only,
@@ -55,9 +57,11 @@ def test_batch_all_tile_shapes(rng):
     b = 30
     labels = jnp.asarray(rng.integers(0, 3, b))
     enc = jnp.asarray(rng.normal(size=(b, 4)).astype(np.float32))
+    tile_sets = ([(8, 128, 128), (16, 128, 128), (8, 256, 256)] if ON_TPU
+                 else [(8, 8, 8), (8, 16, 16), (16, 16, 16)])
     results = [
         batch_all_triplet_loss_pallas(labels, enc, tiles=t, interpret=not ON_TPU)
-        for t in [(8, 8, 8), (8, 16, 16), (16, 16, 16)]
+        for t in tile_sets
     ]
     for r in results[1:]:
         np.testing.assert_allclose(float(results[0][0]), float(r[0]), rtol=1e-6)
