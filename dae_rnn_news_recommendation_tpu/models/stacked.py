@@ -7,6 +7,7 @@ inputs embed to zero at every depth. After pretraining, `encode` composes the to
 `fit_finetune` optionally fine-tunes the whole stack end-to-end on reconstruction.
 """
 
+import functools
 import time
 
 import jax
@@ -14,9 +15,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.batcher import PaddedBatcher, densify_rows
+from ..ops.losses import weighted_loss
 from ..train.optimizers import make_optimizer
 from ..train.step import make_train_step
-from .dae_core import DAEConfig, encode as dae_encode, init_params
+from .dae_core import (DAEConfig, decode as dae_decode, encode as dae_encode,
+                       init_params)
 
 
 class StackedDenoisingAutoencoder:
@@ -104,3 +107,52 @@ class StackedDenoisingAutoencoder:
     def stack_params(self):
         """The full stack as one pytree (for checkpointing / fine-tuning)."""
         return {"layers": self.params}
+
+    def _stack_forward(self, layer_params, x):
+        """Encode through every tower, then decode back down the (tied) stack:
+        x -> h_1 -> ... -> h_L -> y_{L-1} -> ... -> y_0."""
+        h = x
+        for p, c in zip(layer_params, self.configs):
+            h = dae_encode(p, h, c)
+        rep = h
+        for p, c in zip(reversed(layer_params), reversed(self.configs)):
+            h = dae_decode(p, h, c)
+        return rep, h
+
+    def fit_finetune(self, X, num_epochs=None, learning_rate=None):
+        """End-to-end fine-tune of the whole pretrained stack on reconstruction
+        (the paper's second phase after greedy pretraining; no reference
+        counterpart — the reference has no deep variant at all).
+
+        Gradients flow through every tower jointly; the per-layer params are
+        updated in place so `encode` reflects the fine-tuned stack.
+        """
+        assert self.params, "call fit() before fit_finetune()"
+        epochs = self.num_epochs if num_epochs is None else num_epochs
+        lr = self.learning_rate if learning_rate is None else learning_rate
+        optimizer = make_optimizer(self.opt, lr, self.momentum)
+        layer_params = list(self.params)
+        opt_state = optimizer.init(layer_params)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(layer_params, opt_state, batch):
+            def loss_fn(lp):
+                _, y = self._stack_forward(lp, batch["x"])
+                return weighted_loss(batch["x"], y, self.loss_func,
+                                     row_valid=batch.get("row_valid"))
+
+            loss, grads = jax.value_and_grad(loss_fn)(layer_params)
+            updates, opt_state2 = optimizer.update(grads, opt_state, layer_params)
+            new_params = jax.tree_util.tree_map(lambda p, u: p + u,
+                                                layer_params, updates)
+            return new_params, opt_state2, loss
+
+        batcher = PaddedBatcher(self.batch_size, seed=self.seed + 1000)
+        last = None
+        for epoch in range(epochs):
+            for batch in batcher.epoch(X):
+                layer_params, opt_state, last = step(layer_params, opt_state, batch)
+            if self.verbose and last is not None:
+                print(f"finetune epoch {epoch+1}: loss={float(last):.4f}")
+        self.params = list(layer_params)
+        return self

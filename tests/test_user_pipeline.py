@@ -1,0 +1,83 @@
+"""End-to-end user-embedding pipeline driver (cli/main_user_model.py — the paper's
+second half, net-new vs the reference) + stacked-DAE fine-tuning."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dae_rnn_news_recommendation_tpu.cli.main_user_model import simulate_sessions
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def test_simulate_sessions_structure(rng):
+    categories = rng.integers(0, 4, 200)
+    s = simulate_sessions(categories, n_users=30, seq_len=6, rng=rng,
+                          p_interest=1.0)
+    assert s["browse"].shape == (30, 6) and s["pos"].shape == (30, 6)
+    # p_interest=1: every browsed and clicked article is in the interest category;
+    # every negative is outside it
+    for u in range(30):
+        c = s["interest"][u]
+        assert (categories[s["browse"][u]] == c).all()
+        assert (categories[s["pos"][u]] == c).all()
+        assert (categories[s["neg"][u]] != c).all()
+
+
+def test_user_model_pipeline_end_to_end(workdir):
+    from dae_rnn_news_recommendation_tpu.cli.main_user_model import main
+
+    gru, metrics = main([
+        "--model_name", "t", "--n_articles", "500", "--max_features", "400",
+        "--n_components", "32", "--dae_epochs", "2", "--n_users", "100",
+        "--seq_len", "8", "--gru_epochs", "15", "--seq_devices", "4",
+        "--seed", "0",
+    ])
+    # ranking the clicked article above the non-clicked one must beat chance
+    assert metrics["rank_accuracy"] > 0.55
+    # 8 categories -> chance 0.125; tiny config, so assert above-chance with margin
+    assert metrics["category_top1_accuracy"] >= 0.15
+    # artifacts
+    d = "results/gru_user/t/"
+    assert os.path.isfile(d + "models/gru_user_params.npz")
+    assert os.path.isfile(d + "data/article_embeddings.npy")
+    with open(d + "logs/user_model_metrics.json") as f:
+        assert json.load(f)["rank_accuracy"] == metrics["rank_accuracy"]
+
+
+def test_stacked_finetune_improves_reconstruction(rng):
+    import jax.numpy as jnp
+
+    from dae_rnn_news_recommendation_tpu.models.stacked import (
+        StackedDenoisingAutoencoder)
+
+    X = (rng.uniform(size=(128, 30)) < 0.15).astype(np.float32)
+    sdae = StackedDenoisingAutoencoder([16, 8], num_epochs=3, batch_size=32,
+                                       learning_rate=0.3, seed=0)
+    sdae.fit(X)
+
+    def recon_mse(model):
+        _, y = model._stack_forward(model.params, jnp.asarray(X))
+        return float(np.mean((np.asarray(y) - X) ** 2))
+
+    before = recon_mse(sdae)
+    sdae.fit_finetune(X, num_epochs=15, learning_rate=0.05)
+    after = recon_mse(sdae)
+    assert after < before
+    # the stack still encodes (params stayed structurally intact)
+    codes = sdae.encode(X)
+    assert codes.shape == (128, 8) and np.isfinite(codes).all()
+
+
+def test_stacked_finetune_requires_fit(rng):
+    from dae_rnn_news_recommendation_tpu.models.stacked import (
+        StackedDenoisingAutoencoder)
+
+    with pytest.raises(AssertionError, match="fit"):
+        StackedDenoisingAutoencoder([8]).fit_finetune(np.ones((4, 6), np.float32))
