@@ -107,12 +107,15 @@ def main(argv=None):
         batch_size=256, seed=FLAGS.seed, triplet_strategy="none",
         verbose=FLAGS.verbose)
     dae.fit(X)
-    emb = dae.transform(X, name="article_embeddings", save=True)
+    emb = dae.transform(X, name="article_embeddings", save=False)
     # center before normalizing: bag-of-words corpora share a dominant common
     # component (frequent words in every article) that pushes all codes nearly
     # collinear; removing it is what makes cosine geometry discriminative
     emb = emb - emb.mean(axis=0, keepdims=True)
     emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
+    # persist the embeddings the GRU is actually trained/scored against, so the
+    # saved artifacts (embeddings + gru params) share one geometry
+    np.save(os.path.join(dae.data_dir, "article_embeddings.npy"), emb)
 
     # ---- stage 3: browse sessions
     sessions = simulate_sessions(categories, FLAGS.n_users, FLAGS.seq_len, rng,
@@ -125,6 +128,9 @@ def main(argv=None):
     te = slice(FLAGS.n_users - n_hold, FLAGS.n_users)
 
     # ---- stage 4: GRU user model
+    assert FLAGS.gru_hidden in (0, emb.shape[1]), (
+        f"--gru_hidden must be 0 or equal n_components ({emb.shape[1]}): the "
+        "relevance score <state, embed> needs matching dimensions")
     gru = GRUUserModel(
         d_embed=emb.shape[1], d_hidden=FLAGS.gru_hidden or None,
         opt="adam", learning_rate=FLAGS.gru_learning_rate,
@@ -161,7 +167,7 @@ def main(argv=None):
         mesh = Mesh(np.array(jax.devices()[:n_dev]).reshape(n_dev), ("seq",))
         t_len = seq_e.shape[1]
         assert t_len % n_dev == 0, (
-            f"--seq_len {t_len} must divide --seq_devices {n_dev}")
+            f"--seq_devices {n_dev} must divide --seq_len {t_len}")
         _, finals_sp = pipeline_gru_apply(
             gru.params, jnp.asarray(seq_e[te]),
             jnp.ones(seq_e[te].shape[:2], jnp.float32), mesh, microbatches=1)

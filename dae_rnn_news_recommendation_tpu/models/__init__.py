@@ -6,7 +6,23 @@ from .dae_core import (  # noqa: F401
     forward,
     resolve_activation,
 )
-from .estimator import DenoisingAutoencoder  # noqa: F401
-from .estimator_triplet import DenoisingAutoencoderTriplet  # noqa: F401
-from .stacked import StackedDenoisingAutoencoder  # noqa: F401
 from .gru_user import GRUUserModel, gru_init_params, gru_apply  # noqa: F401
+
+# The estimators (and the stacked model) import train/, and train/step imports
+# models.dae_core — eager imports here would close that cycle when models/ is
+# reached through train/ (e.g. `import ...parallel` -> dp -> train.step).
+# Resolving them lazily keeps both entry orders working.
+_LAZY = {
+    "DenoisingAutoencoder": "estimator",
+    "DenoisingAutoencoderTriplet": "estimator_triplet",
+    "StackedDenoisingAutoencoder": "stacked",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -14,7 +14,7 @@ shardings, let XLA insert the collectives over ICI.
             devices), exact-semantics and differentiable
 """
 
-from .mesh import get_mesh, get_mesh_2d  # noqa: F401
+from .mesh import get_mesh, get_mesh_2d, initialize_multihost  # noqa: F401
 from .dp import (  # noqa: F401
     make_parallel_train_step,
     make_parallel_eval_step,

@@ -11,6 +11,42 @@ import jax
 import numpy as np
 
 
+def initialize_multihost(coordinator_address=None, num_processes=None,
+                         process_id=None, **kwargs):
+    """Bring up the multi-host runtime (jax.distributed) so jax.devices() spans
+    every host of a multi-slice/multi-host deployment; the mesh constructors below
+    then scale unchanged from one chip to a pod (collectives ride ICI inside a
+    slice, DCN across slices).
+
+    This is the TPU-native replacement for the distributed backend the reference
+    never had (SURVEY §5.8: no NCCL/MPI/tf.distribute — its only transport was the
+    in-process feed_dict copy). All arguments default to JAX's environment
+    auto-detection (TPU pods populate them via the metadata server); pass them
+    explicitly for manual CPU/GPU clusters.
+
+    Safe to call unconditionally from drivers: no-ops when already initialized,
+    and degrades to single-process when nothing was passed and the environment
+    carries no cluster metadata (auto-detection raises there). Explicit arguments
+    always surface their errors.
+    """
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None or bool(kwargs))
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id, **kwargs)
+    except RuntimeError as e:
+        # tolerate "already initialized" always; a bare call may also hit
+        # "must be called before backend init" on a warm single process
+        if explicit and "already" not in str(e).lower():
+            raise
+    except Exception:
+        if explicit:
+            raise
+        # bare call on a single host: no coordinator to find — run single-process
+    return jax.process_index(), jax.process_count()
+
+
 def get_mesh(n_devices=None, axis_name="data", devices=None):
     """1-D data-parallel mesh over the first n_devices."""
     devices = list(devices if devices is not None else jax.devices())

@@ -156,3 +156,26 @@ def test_estimator_with_mesh(tmp_path, monkeypatch):
     enc = m.transform(X)
     assert enc.shape == (64, 4)
     assert np.isfinite(enc).all()
+
+
+def test_parallel_first_import_order():
+    """`import ...parallel` before anything else must not hit the
+    models<->train import cycle (regression: estimator imports are lazy)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from dae_rnn_news_recommendation_tpu.parallel import initialize_multihost\n"
+        "idx, n = initialize_multihost()\n"
+        "assert (idx, n) == (0, 1), (idx, n)\n"
+        "idx2, n2 = initialize_multihost()\n"  # idempotent
+        "assert (idx2, n2) == (0, 1)\n"
+        "from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder\n"
+        "print('ok')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env={**__import__('os').environ,
+                                          "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "ok" in out.stdout
