@@ -46,6 +46,13 @@ def build_parser():
     p.add_argument("--n_components", type=int, default=64)
     p.add_argument("--dae_epochs", type=int, default=5)
     p.add_argument("--dae_learning_rate", type=float, default=0.1)
+    p.add_argument("--stacked_layers", default="",
+                   help="comma-separated hidden sizes (e.g. '128,64') — use a "
+                        "greedy-pretrained stacked DAE (the paper's deep variant) "
+                        "instead of the single-layer DAE; the last size becomes "
+                        "the embedding dim")
+    p.add_argument("--finetune_epochs", type=int, default=0,
+                   help="joint fine-tune epochs after stacked pretraining")
     # sessions
     p.add_argument("--n_users", type=int, default=200)
     p.add_argument("--seq_len", type=int, default=12)
@@ -98,16 +105,35 @@ def main(argv=None):
         max_features=FLAGS.max_features, binary=True)
     categories = corpus.category_publish_name.factorize()[0]
 
-    dae = DenoisingAutoencoder(
-        algo_name="gru_user", model_name=FLAGS.model_name,
-        main_dir=FLAGS.model_name, n_components=FLAGS.n_components,
-        enc_act_func="tanh", dec_act_func="none", loss_func="mean_squared",
-        corr_type="masking", corr_frac=0.3, opt="ada_grad",
-        learning_rate=FLAGS.dae_learning_rate, num_epochs=FLAGS.dae_epochs,
-        batch_size=256, seed=FLAGS.seed, triplet_strategy="none",
-        verbose=FLAGS.verbose)
-    dae.fit(X)
-    emb = dae.transform(X, name="article_embeddings", save=False)
+    # shared DAE hyperparameters for both the shallow and stacked paths
+    dae_hp = dict(enc_act_func="tanh", dec_act_func="none",
+                  loss_func="mean_squared", corr_type="masking", corr_frac=0.3,
+                  opt="ada_grad", learning_rate=FLAGS.dae_learning_rate,
+                  num_epochs=FLAGS.dae_epochs, batch_size=256, seed=FLAGS.seed,
+                  verbose=FLAGS.verbose)
+    models_dir, data_dir, logs_dir, _, _ = create_run_directories(
+        "gru_user", FLAGS.model_name)
+    if FLAGS.stacked_layers:
+        from ..models import StackedDenoisingAutoencoder
+
+        layers = [int(s) for s in FLAGS.stacked_layers.split(",") if s.strip()]
+        assert layers and all(l > 0 for l in layers), (
+            f"--stacked_layers must be positive hidden sizes, got "
+            f"{FLAGS.stacked_layers!r}")
+        sdae = StackedDenoisingAutoencoder(layers, **dae_hp)
+        sdae.fit(X)
+        if FLAGS.finetune_epochs > 0:
+            sdae.fit_finetune(X, num_epochs=FLAGS.finetune_epochs)
+        # pretraining already computed the deepest codes; fine-tuning stales them
+        emb = (sdae.fit_representation_ if sdae.fit_representation_ is not None
+               else sdae.encode(X))
+    else:
+        dae = DenoisingAutoencoder(
+            algo_name="gru_user", model_name=FLAGS.model_name,
+            main_dir=FLAGS.model_name, n_components=FLAGS.n_components,
+            triplet_strategy="none", **dae_hp)
+        dae.fit(X)
+        emb = dae.transform(X, name="article_embeddings", save=False)
     # center before normalizing: bag-of-words corpora share a dominant common
     # component (frequent words in every article) that pushes all codes nearly
     # collinear; removing it is what makes cosine geometry discriminative
@@ -115,7 +141,7 @@ def main(argv=None):
     emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
     # persist the embeddings the GRU is actually trained/scored against, so the
     # saved artifacts (embeddings + gru params) share one geometry
-    np.save(os.path.join(dae.data_dir, "article_embeddings.npy"), emb)
+    np.save(os.path.join(data_dir, "article_embeddings.npy"), emb)
 
     # ---- stage 3: browse sessions
     sessions = simulate_sessions(categories, FLAGS.n_users, FLAGS.seq_len, rng,
@@ -180,10 +206,10 @@ def main(argv=None):
                "d_embed": int(emb.shape[1])}
     print(json.dumps(metrics))
 
-    gru_dir = dae.models_dir
+    gru_dir = models_dir
     leaves = {k: np.asarray(v) for k, v in gru.params.items()}
     np.savez(os.path.join(gru_dir, "gru_user_params.npz"), **leaves)
-    with open(os.path.join(dae.tf_summary_dir, "user_model_metrics.json"), "w") as f:
+    with open(os.path.join(logs_dir, "user_model_metrics.json"), "w") as f:
         json.dump(metrics, f)
     print(__file__ + ": End")
     return gru, metrics

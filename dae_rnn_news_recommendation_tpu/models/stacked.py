@@ -45,6 +45,7 @@ class StackedDenoisingAutoencoder:
         self.compute_dtype = compute_dtype
         self.configs = []
         self.params = []
+        self.fit_representation_ = None
 
     def _layer_config(self, n_in, n_out, first):
         return DAEConfig(
@@ -83,6 +84,9 @@ class StackedDenoisingAutoencoder:
             self.params.append(params)
             rep = self._encode_layer(li, rep)
             n_in = n_out
+        # the deepest codes of the training set, free at the end of pretraining
+        # (sklearn-style trailing underscore; invalidated by fit_finetune)
+        self.fit_representation_ = rep
         return self
 
     def _encode_layer(self, li, x, batch_size=8192):
@@ -155,4 +159,5 @@ class StackedDenoisingAutoencoder:
             if self.verbose and last is not None:
                 print(f"finetune epoch {epoch+1}: loss={float(last):.4f}")
         self.params = list(layer_params)
+        self.fit_representation_ = None  # stale: weights changed
         return self

@@ -51,6 +51,24 @@ def test_user_model_pipeline_end_to_end(workdir):
         assert json.load(f)["rank_accuracy"] == metrics["rank_accuracy"]
 
 
+def test_user_model_pipeline_stacked_embeddings(workdir):
+    """--stacked_layers swaps the single-layer DAE for the greedy-pretrained
+    (+fine-tuned) stack; the last layer size becomes the embedding dim."""
+    from dae_rnn_news_recommendation_tpu.cli.main_user_model import main
+
+    gru, metrics = main([
+        "--model_name", "st", "--n_articles", "300", "--max_features", "400",
+        "--dae_epochs", "2", "--n_users", "60", "--seq_len", "6",
+        "--gru_epochs", "8", "--stacked_layers", "64,16",
+        "--finetune_epochs", "1", "--seed", "0",
+    ])
+    assert metrics["d_embed"] == 16
+    assert 0.0 <= metrics["rank_accuracy"] <= 1.0
+    assert os.path.isfile("results/gru_user/st/data/article_embeddings.npy")
+    emb = np.load("results/gru_user/st/data/article_embeddings.npy")
+    assert emb.shape == (300, 16)
+
+
 def test_stacked_finetune_improves_reconstruction(rng):
     import jax.numpy as jnp
 
