@@ -138,7 +138,8 @@ def main(argv=None):
         num_epochs=FLAGS.num_epochs, batch_size=FLAGS.batch_size,
         alpha=FLAGS.alpha, triplet_strategy=FLAGS.triplet_strategy,
         n_devices=FLAGS.n_devices, mining_scope=FLAGS.mining_scope,
-        compute_dtype=FLAGS.compute_dtype, checkpoint_every=FLAGS.checkpoint_every)
+        compute_dtype=FLAGS.compute_dtype, checkpoint_every=FLAGS.checkpoint_every,
+        profile=FLAGS.profile)
 
     (article_contents, X, X_validate, X_tfidf, X_tfidf_validate,
      labels) = prepare_or_restore_data(model, FLAGS)

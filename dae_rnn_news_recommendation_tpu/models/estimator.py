@@ -60,7 +60,7 @@ class DenoisingAutoencoder:
                  # --- TPU-native extras (no reference counterpart) ---
                  compute_dtype="float32", checkpoint_every=0, val_batch_size=512,
                  n_devices=1, mesh=None, mining_scope="global", results_root="results",
-                 use_tensorboard=True, n_components=None):
+                 use_tensorboard=True, n_components=None, profile=False):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
 
         :param n_components: explicit code size; overrides the compress_factor
@@ -104,6 +104,9 @@ class DenoisingAutoencoder:
         self.mesh = mesh
         self.mining_scope = mining_scope
         self.use_tensorboard = use_tensorboard
+        # device-level tracing (XProf/TensorBoard), the op-level profiling the
+        # reference lacks entirely (SURVEY §5.1: wall-clock prints only)
+        self.profile = profile
 
         assert isinstance(self.verbose_step, int)
         assert self.verbose >= 0
@@ -256,8 +259,32 @@ class DenoisingAutoencoder:
         self._save(self._epoch0 + self.num_epochs)
         return self
 
+    def _log_param_histograms(self, train_writer, gstep):
+        """Parameter histograms in the scalars' global-batch-step domain
+        (reference tf.summary.histogram for W and biases, autoencoder.py:391-393,
+        :413-415)."""
+        for tag, leaf in (("enc_w", self.params["W"]),
+                          ("hidden_bias", self.params["bh"]),
+                          ("visible_bias", self.params["bv"])):
+            train_writer.histogram(tag, np.asarray(leaf), gstep)
+
     def _train_loop(self, train_set, train_set_label, validation_set,
                     validation_set_label, batcher, extremes, train_writer, val_writer):
+        # shared by the triplet subclass's fit too — profiling lives here so
+        # profile=True works for every estimator
+        if self.profile:
+            jax.profiler.start_trace(os.path.join(self.tf_summary_dir, "profile"))
+        try:
+            self._train_loop_inner(train_set, train_set_label, validation_set,
+                                   validation_set_label, batcher, extremes,
+                                   train_writer, val_writer)
+        finally:
+            if self.profile:
+                jax.profiler.stop_trace()
+
+    def _train_loop_inner(self, train_set, train_set_label, validation_set,
+                          validation_set_label, batcher, extremes, train_writer,
+                          val_writer):
         labels = train_set_label if self._needs_labels else None
         from ..data.batcher import resolve_batch_size
         n_rows = train_set["org"].shape[0] if isinstance(train_set, dict) else train_set.shape[0]
@@ -303,6 +330,7 @@ class DenoisingAutoencoder:
 
             if epoch % self.verbose_step == 0:
                 self._run_validation(epoch, validation_set, validation_set_label, val_writer)
+                self._log_param_histograms(train_writer, epoch * n_batches)
                 ran_validation = True
             else:
                 ran_validation = False
@@ -311,8 +339,10 @@ class DenoisingAutoencoder:
 
         # reference quirk kept: one final validation if the last epoch missed the cadence
         if self.num_epochs != 0 and not ran_validation:
-            self._run_validation(self._epoch0 + self.num_epochs, validation_set,
+            last_epoch = self._epoch0 + self.num_epochs
+            self._run_validation(last_epoch, validation_set,
                                  validation_set_label, val_writer)
+            self._log_param_histograms(train_writer, last_epoch * n_batches)
 
     def _validation_batches(self, validation_set, validation_set_label):
         n = (validation_set["org"] if isinstance(validation_set, dict) else validation_set).shape[0]

@@ -14,7 +14,8 @@ import os
 from pathlib import Path
 
 _BOOL_FLAGS = ("verbose", "encode_full", "validation", "save_tsv",
-               "restore_previous_data", "restore_previous_model", "synthetic")
+               "restore_previous_data", "restore_previous_model", "synthetic",
+               "profile")
 
 
 def load_dotenv(path=".env"):
@@ -90,6 +91,9 @@ def build_parser(triplet_mode=False):
     p.add_argument("--compute_dtype", default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--checkpoint_every", type=int, default=0)
+    p.add_argument("--profile", action="store_true", default=False,
+                   help="capture an XProf/TensorBoard device trace of fit() "
+                        "under logs/profile/")
     return p
 
 

@@ -206,3 +206,49 @@ def test_gru_user_model_learns():
     assert states.shape == (N, 8)
     scores = m2.score(seq, rng.normal(size=(7, 8)).astype(np.float32), mask)
     assert scores.shape == (N, 7)
+
+
+def test_profile_and_histograms(tmp_path, monkeypatch, rng):
+    """profile=True captures an XProf trace under logs/profile/; parameter
+    histograms land in the train metrics stream at the summary cadence
+    (reference tf.summary.histogram parity, autoencoder.py:391-393)."""
+    import json
+    import os
+
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+
+    monkeypatch.chdir(tmp_path)
+    X = (rng.uniform(size=(60, 40)) < 0.2).astype(np.float32)
+    model = DenoisingAutoencoder(
+        model_name="prof", main_dir="prof", compress_factor=10, num_epochs=2,
+        batch_size=20, verbose=False, verbose_step=1, triplet_strategy="none",
+        loss_func="mean_squared", dec_act_func="none", enc_act_func="tanh",
+        profile=True, use_tensorboard=False, seed=0)
+    model.fit(X)
+
+    prof_dir = os.path.join(model.tf_summary_dir, "profile")
+    assert os.path.isdir(prof_dir)
+    assert any(files for _, _, files in os.walk(prof_dir)), "empty profile trace"
+
+    with open(os.path.join(model.tf_summary_dir, "train/metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    hist_tags = {r["tag"] for r in records if "hist" in r}
+    assert {"enc_w", "hidden_bias", "visible_bias"} <= hist_tags
+    w_hists = [r for r in records if r["tag"] == "enc_w"]
+    assert len(w_hists) == 2  # verbose_step=1, two epochs
+    assert w_hists[0]["hist"]["n"] == 40 * 4
+    # histogram steps share the scalars' global-batch-step domain (3 batches/epoch)
+    assert [r["step"] for r in w_hists] == [3, 6]
+    scalar_steps = {r["step"] for r in records if "hist" not in r}
+    assert set([3, 6]) <= scalar_steps
+
+    # short run below the cadence: the catch-up validation still emits histograms
+    model2 = DenoisingAutoencoder(
+        model_name="prof2", main_dir="prof2", compress_factor=10, num_epochs=2,
+        batch_size=20, verbose=False, verbose_step=5, triplet_strategy="none",
+        loss_func="mean_squared", dec_act_func="none", enc_act_func="tanh",
+        use_tensorboard=False, seed=0)
+    model2.fit(X)
+    with open(os.path.join(model2.tf_summary_dir, "train/metrics.jsonl")) as f:
+        records2 = [json.loads(line) for line in f]
+    assert sum(1 for r in records2 if r["tag"] == "enc_w") == 1
