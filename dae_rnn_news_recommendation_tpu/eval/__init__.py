@@ -1,2 +1,3 @@
 from .similarity import pairwise_similarity, nearest_neighbor_report  # noqa: F401
 from .plots import visualize_pairwise_similarity, visualize_scatter, related_unrelated_auroc  # noqa: F401
+from .streaming_auroc import streaming_auroc, auroc_from_histograms  # noqa: F401

@@ -1,0 +1,155 @@
+"""Streaming related-vs-unrelated AUROC: the O(N^2) eval without the N^2 matrix.
+
+The reference's eval materializes the full pairwise-similarity matrix and hands
+every lower-triangle score to sklearn's roc_curve (helpers.py:45, :79-101) — 4 TB
+of float32 at N=1M, the scaling wall SURVEY §5.7 names. Here similarity blocks are
+computed on device (MXU matmuls over l2-normalized rows), every score is binned
+into fixed-width histograms of the related / unrelated populations, and only two
+[bins] count vectors ever leave the device. AUROC is then the exact rank statistic
+of the binned scores:
+
+    AUROC = P(s_rel > s_unrel) + 0.5 * P(s_rel == s_unrel)
+          = sum_k U_k * (R_{>k} + 0.5 * R_k) / (R * U)
+
+so the only approximation is the bin quantization (1e-3-ish at 8k bins over
+[-1, 1]; tested against sklearn on dense data).
+
+Counting is exact: histograms accumulate on device in int32 and are flushed to
+float64 host totals before the int32 pair budget (2^31) could overflow, so there
+is no float32 saturation at any N; the flush cadence also bounds host<->device
+syncs at one per ~2^31 pairs instead of one per block pair. Scores falling
+outside `value_range` are detected and raised on — silent edge-bin clipping
+would quietly bias the statistic.
+
+Pair semantics match eval/plots.py:_related_unrelated exactly: strictly-lower-
+triangle pairs, rows with label < 0 excluded, related iff labels equal.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_FLUSH_PAIRS = 2**31 - 2**26  # flush device int32 accumulators before overflow
+
+
+@functools.partial(jax.jit, static_argnames=("bins", "diag"), donate_argnums=(0, 1, 2))
+def _block_hists(acc_rel, acc_unrel, acc_oob, xi, xj, li, lj, lo, hi, bins, diag):
+    """Accumulate one block pair's related/unrelated score histograms (int32,
+    threaded through so nothing syncs per call) plus an out-of-range counter."""
+    s = jnp.matmul(xi, xj.T, precision=jax.lax.Precision.HIGHEST)
+    valid = (li[:, None] >= 0) & (lj[None, :] >= 0)
+    if diag:  # same block: keep strictly-lower-triangle pairs only
+        valid &= jnp.tril(jnp.ones(s.shape, bool), -1)
+    eq = li[:, None] == lj[None, :]
+
+    idx = jnp.clip(((s - lo) / (hi - lo) * bins).astype(jnp.int32), 0, bins - 1)
+    idx = idx.ravel()
+    rel = (valid & eq).ravel().astype(jnp.int32)
+    unrel = (valid & ~eq).ravel().astype(jnp.int32)
+    acc_rel = acc_rel.at[idx].add(rel)
+    acc_unrel = acc_unrel.at[idx].add(unrel)
+    oob = valid & ((s < lo) | (s >= hi))
+    acc_oob = acc_oob + jnp.sum(oob.astype(jnp.int32))
+    return acc_rel, acc_unrel, acc_oob
+
+
+def auroc_from_histograms(hist_rel, hist_unrel):
+    """Exact AUROC of binned scores (ties within a bin count half)."""
+    r = np.asarray(hist_rel, np.float64)
+    u = np.asarray(hist_unrel, np.float64)
+    r_total, u_total = r.sum(), u.sum()
+    if r_total == 0 or u_total == 0:
+        return float("nan")
+    # related counts strictly above each bin
+    r_above = r_total - np.cumsum(r)
+    return float(np.sum(u * (r_above + 0.5 * r)) / (r_total * u_total))
+
+
+def streaming_auroc(embeddings, labels, metric="cosine", block=2048, bins=8192,
+                    value_range=None, return_histograms=False):
+    """Related-vs-unrelated AUROC over all O(N^2) pairs in O(N^2 / block^2) device
+    calls and O(bins) memory.
+
+    :param embeddings: [N, D] float array
+    :param labels: [N] ints; < 0 = missing (row excluded, reference helpers.py:91-97).
+        Values are remapped to contiguous int32 internally, so 64-bit hash labels
+        are safe.
+    :param metric: 'cosine' (rows l2-normalized; scores in [-1, 1]) or
+        'linear kernel' (raw dot products; pass value_range)
+    :param value_range: (lo, hi) score range for binning; required for
+        'linear kernel', defaults to (-1, 1) for cosine. Raises if any valid
+        pair's score falls outside it.
+    :return: auroc, or (auroc, hist_related, hist_unrelated, bin_edges)
+    """
+    assert metric in ("cosine", "linear kernel")
+    if value_range is None:
+        if metric != "cosine":
+            raise ValueError("value_range is required for metric='linear kernel' "
+                             "(dot products are unbounded)")
+        value_range = (-1.0, 1.0)
+    lo, hi = float(value_range[0]), float(value_range[1])
+    # widen a hair so binning of exact endpoints is clip-free
+    span = hi - lo
+    lo, hi = lo - 1e-5 * span, hi + 1e-5 * span
+
+    x = np.asarray(embeddings, np.float32)
+    labels = np.asarray(labels)
+    n = x.shape[0]
+    # remap to contiguous int32: equality-only semantics, immune to 64-bit labels
+    nonneg = labels >= 0
+    remapped = np.full(n, -1, np.int32)
+    if nonneg.any():
+        remapped[nonneg] = np.unique(labels[nonneg], return_inverse=True)[1]
+    labels = remapped
+    if metric == "cosine":
+        denom = np.sqrt((x * x).sum(axis=1, keepdims=True))
+        x = x / np.where(denom == 0, 1.0, denom)
+
+    # pad to a block multiple with excluded rows so every device call has one shape
+    n_pad = int(-(-n // block) * block)
+    if n_pad != n:
+        x = np.concatenate([x, np.zeros((n_pad - n, x.shape[1]), np.float32)])
+        labels = np.concatenate([labels, np.full(n_pad - n, -1, np.int32)])
+
+    xd = jnp.asarray(x)
+    ld = jnp.asarray(labels)
+    hist_rel = np.zeros(bins, np.float64)
+    hist_unrel = np.zeros(bins, np.float64)
+    oob_total = 0
+
+    def fresh():
+        return (jnp.zeros(bins, jnp.int32), jnp.zeros(bins, jnp.int32),
+                jnp.zeros((), jnp.int32))
+
+    acc = fresh()
+    pairs_in_acc = 0
+    for bi in range(0, n_pad, block):
+        xi, li = xd[bi : bi + block], ld[bi : bi + block]
+        for bj in range(0, bi + block, block):
+            if pairs_in_acc + block * block > _FLUSH_PAIRS:
+                hist_rel += np.asarray(acc[0], np.float64)
+                hist_unrel += np.asarray(acc[1], np.float64)
+                oob_total += int(acc[2])
+                acc = fresh()
+                pairs_in_acc = 0
+            acc = _block_hists(*acc, xi, xd[bj : bj + block], li,
+                               ld[bj : bj + block], lo, hi, bins,
+                               diag=(bi == bj))
+            pairs_in_acc += block * block
+    hist_rel += np.asarray(acc[0], np.float64)
+    hist_unrel += np.asarray(acc[1], np.float64)
+    oob_total += int(acc[2])
+
+    if oob_total:
+        raise ValueError(
+            f"{oob_total} pair scores fell outside value_range=({lo:.6g}, {hi:.6g})"
+            " — widen it; silently clipping them into the edge bins would bias "
+            "the AUROC")
+
+    auroc = auroc_from_histograms(hist_rel, hist_unrel)
+    if return_histograms:
+        edges = np.linspace(lo, hi, bins + 1)
+        return auroc, hist_rel, hist_unrel, edges
+    return auroc
