@@ -181,3 +181,58 @@ class TripletPaddedBatcher(PaddedBatcher):
                     x[n_real:] = 0.0
                 batch[key] = x
             yield batch
+
+
+def prefetch(iterator, depth=2):
+    """Run `iterator` on a background thread, keeping up to `depth` items ready.
+
+    Host batch prep (shuffle bookkeeping, csr densification — the per-step host
+    work the reference did inline between Session.run calls) overlaps the
+    device's async dispatch. depth<=0 returns the iterator unchanged.
+    """
+    if depth <= 0:
+        return iterator
+
+    import queue
+    import threading
+
+    def gen():
+        q = queue.Queue(maxsize=depth)
+        end = object()
+        err = []
+        stop = threading.Event()  # consumer gone: unblock + retire the worker
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for item in iterator:
+                    if not put(item):
+                        return
+            except BaseException as e:  # surfaced on the consumer thread
+                err.append(e)
+            finally:
+                put(end)
+
+        threading.Thread(target=worker, daemon=True).start()
+        try:
+            while True:
+                item = q.get()
+                if item is end:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # early exit (consumer break / exception / GeneratorExit): release the
+            # worker blocked on the full queue so it exits instead of leaking
+            stop.set()
+
+    return gen()

@@ -30,11 +30,11 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
-from ..data.batcher import PaddedBatcher, densify_rows
+from ..data.batcher import PaddedBatcher, densify_rows, prefetch
 from ..train.optimizers import make_optimizer
 from ..train.step import loss_and_metrics, make_encode_fn, make_eval_step, make_train_step
 from ..utils.checkpoint import (latest_checkpoint, load_checkpoint, load_params,
-                                save_checkpoint)
+                                prune_checkpoints, save_checkpoint)
 from ..utils.dirs import create_run_directories
 from ..utils.metrics import MetricsWriter
 from ..utils.provenance import write_parameter_file
@@ -60,7 +60,8 @@ class DenoisingAutoencoder:
                  # --- TPU-native extras (no reference counterpart) ---
                  compute_dtype="float32", checkpoint_every=0, val_batch_size=512,
                  n_devices=1, mesh=None, mining_scope="global", results_root="results",
-                 use_tensorboard=True, n_components=None, profile=False):
+                 use_tensorboard=True, n_components=None, profile=False,
+                 prefetch_depth=2, keep_checkpoint_max=0):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
 
         :param n_components: explicit code size; overrides the compress_factor
@@ -107,6 +108,10 @@ class DenoisingAutoencoder:
         # device-level tracing (XProf/TensorBoard), the op-level profiling the
         # reference lacks entirely (SURVEY §5.1: wall-clock prints only)
         self.profile = profile
+        # host batch prep overlapped with device compute; checkpoint retention
+        # for checkpoint_every runs (0 = keep all)
+        self.prefetch_depth = prefetch_depth
+        self.keep_checkpoint_max = keep_checkpoint_max
 
         assert isinstance(self.verbose_step, int)
         assert self.verbose >= 0
@@ -304,7 +309,8 @@ class DenoisingAutoencoder:
             # host-device sync each batch and stall the async dispatch pipeline
             step_in_epoch = 0
             device_metrics = []
-            for batch in batcher.epoch(train_set, labels):
+            for batch in prefetch(batcher.epoch(train_set, labels),
+                                  self.prefetch_depth):
                 batch.update(extremes)
                 self._key, sub = jax.random.split(self._key)
                 self.params, self.opt_state, metrics = self._train_step(
@@ -394,6 +400,8 @@ class DenoisingAutoencoder:
         state = {"params": self.params, "opt_state": self.opt_state,
                  "epoch": np.asarray(epoch)}
         save_checkpoint(self.model_path, state, epoch)
+        if self.keep_checkpoint_max:
+            prune_checkpoints(self.model_path, self.keep_checkpoint_max)
 
     def transform(self, data, name="train", save=False, batch_size=4096,
                   from_checkpoint=True):

@@ -98,3 +98,21 @@ def load_checkpoint(ckpt_path, like):
                     f"({n_saved} state leaves vs {len(leaves)} expected); restore with "
                     "the same `opt`, or load weights only via load_params")
     return out
+
+
+def prune_checkpoints(ckpt_dir, keep):
+    """Delete all but the newest `keep` step_* checkpoints. keep<=0 keeps all."""
+    import shutil
+
+    if keep <= 0 or not os.path.isdir(ckpt_dir):
+        return []
+    steps = sorted(
+        (int(m.group(1)), name)
+        for name in os.listdir(ckpt_dir)
+        if (m := _STEP_RE.match(name))
+    )
+    removed = []
+    for _, name in steps[:-keep]:
+        shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+        removed.append(name)
+    return removed

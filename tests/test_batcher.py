@@ -121,3 +121,61 @@ def test_densify_rows_types():
         out = B.densify_rows(data, np.array([2, 0]))
         assert out.dtype == np.float32
         np.testing.assert_array_equal(out, x[[2, 0]])
+
+
+def test_prefetch_preserves_order_and_content(rng):
+    from dae_rnn_news_recommendation_tpu.data.batcher import PaddedBatcher, prefetch
+
+    X = rng.uniform(size=(50, 6)).astype(np.float32)
+    b1 = PaddedBatcher(16, shuffle=True, seed=3)
+    b2 = PaddedBatcher(16, shuffle=True, seed=3)
+    direct = list(b1.epoch(X))
+    threaded = list(prefetch(b2.epoch(X), depth=2))
+    assert len(direct) == len(threaded)
+    for d, t in zip(direct, threaded):
+        np.testing.assert_array_equal(d["x"], t["x"])
+        np.testing.assert_array_equal(d["row_valid"], t["row_valid"])
+
+
+def test_prefetch_propagates_errors_and_depth_zero():
+    from dae_rnn_news_recommendation_tpu.data.batcher import prefetch
+
+    def boom():
+        yield 1
+        raise RuntimeError("producer failed")
+
+    it = prefetch(boom(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="producer failed"):
+        list(it)
+
+    plain = iter([1, 2])
+    assert prefetch(plain, depth=0) is plain
+
+
+def test_prefetch_abandoned_consumer_releases_worker():
+    """Breaking out of a prefetch loop must retire the worker thread rather than
+    leaving it blocked on the full queue."""
+    import gc
+    import threading
+    import time
+
+    from dae_rnn_news_recommendation_tpu.data.batcher import prefetch
+
+    produced = []
+
+    def source():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    it = prefetch(source(), depth=2)
+    assert next(it) == 0
+    it.close()  # abandon
+    gc.collect()
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "prefetch worker thread leaked"
+    assert len(produced) < 1000  # producer stopped early, didn't drain the source

@@ -252,3 +252,32 @@ def test_profile_and_histograms(tmp_path, monkeypatch, rng):
     with open(os.path.join(model2.tf_summary_dir, "train/metrics.jsonl")) as f:
         records2 = [json.loads(line) for line in f]
     assert sum(1 for r in records2 if r["tag"] == "enc_w") == 1
+
+
+def test_checkpoint_retention(tmp_path, monkeypatch, rng):
+    """keep_checkpoint_max trims old step_* dirs; the newest survive and restore."""
+    import os
+
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+    from dae_rnn_news_recommendation_tpu.utils.checkpoint import prune_checkpoints
+
+    monkeypatch.chdir(tmp_path)
+    X = (rng.uniform(size=(40, 30)) < 0.2).astype(np.float32)
+    model = DenoisingAutoencoder(
+        model_name="keep", main_dir="keep", compress_factor=10, num_epochs=6,
+        batch_size=20, verbose=False, triplet_strategy="none",
+        loss_func="mean_squared", dec_act_func="none", enc_act_func="tanh",
+        checkpoint_every=1, keep_checkpoint_max=2, seed=0)
+    model.fit(X)
+    steps = sorted(os.listdir(model.model_path))
+    assert steps == ["step_5", "step_6"]
+    # restore still works from the retained tail
+    model2 = DenoisingAutoencoder(
+        model_name="keep", main_dir="keep", compress_factor=10, num_epochs=1,
+        batch_size=20, verbose=False, triplet_strategy="none",
+        loss_func="mean_squared", dec_act_func="none", enc_act_func="tanh", seed=0)
+    model2.fit(X, restore_previous_model=True)
+    assert model2._epoch0 == 6
+
+    assert prune_checkpoints(str(tmp_path / "nonexistent"), 3) == []
+    assert prune_checkpoints(model2.model_path, 0) == []
