@@ -195,6 +195,33 @@ def main(argv=None):
         hio.save_file(X_encoded, model.tsv_dir + "article_encoded.tsv")
         hio.save_file(X_encoded_validate, model.tsv_dir + "article_encoded_validate.tsv")
 
+    if FLAGS.streaming_eval:
+        # blockwise streaming AUROCs: no N x N matrices, no plots
+        # (tfidf rows are l2-normalized, so cosine == the reference's linear kernel)
+        from ..eval import streaming_auroc
+
+        reps = {"tfidf": (X_tfidf, X_tfidf_validate),
+                "binary_count": (X, X_validate),
+                "encoded": (X_encoded, X_encoded_validate)}
+        label_kinds = (("label_category_publish_name", "(Category)"),
+                       ("label_story", "(Story)"))
+        aurocs = {}
+        for kind, (tr_rep, vl_rep) in reps.items():
+            for split, rep in (("train", tr_rep), ("validate", vl_rep)):
+                # both label kinds share one pair sweep (similarity blocks are
+                # label-independent)
+                lab_mat = np.stack([np.asarray(data_dict[lab][split])
+                                    for lab, _ in label_kinds])
+                vals = streaming_auroc(rep, lab_mat)
+                for (lab, suffix), v in zip(label_kinds, vals):
+                    key = (f"similarity_boxplot_{kind}"
+                           f"{'_validate' if split == 'validate' else ''}{suffix}")
+                    aurocs[key] = v
+        for k, v in sorted(aurocs.items()):
+            print(f"AUROC {k}: {v:.4f}")
+        print(__file__ + ": End")
+        return model, aurocs
+
     print("calculate similarity")
     sims = {
         "binary_count": pairwise_similarity(X, metric="cosine"),

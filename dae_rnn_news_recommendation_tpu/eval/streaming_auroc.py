@@ -30,6 +30,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import scipy.sparse as sp
 
 _FLUSH_PAIRS = 2**31 - 2**26  # flush device int32 accumulators before overflow
 
@@ -37,21 +38,27 @@ _FLUSH_PAIRS = 2**31 - 2**26  # flush device int32 accumulators before overflow
 @functools.partial(jax.jit, static_argnames=("bins", "diag"), donate_argnums=(0, 1, 2))
 def _block_hists(acc_rel, acc_unrel, acc_oob, xi, xj, li, lj, lo, hi, bins, diag):
     """Accumulate one block pair's related/unrelated score histograms (int32,
-    threaded through so nothing syncs per call) plus an out-of-range counter."""
+    threaded through so nothing syncs per call) plus an out-of-range counter.
+
+    li/lj are [L, block]: the similarity block is label-independent, so all L
+    label sets share one MXU matmul sweep (histograms are [L, bins])."""
     s = jnp.matmul(xi, xj.T, precision=jax.lax.Precision.HIGHEST)
-    valid = (li[:, None] >= 0) & (lj[None, :] >= 0)
+    base = jnp.ones(s.shape, bool)
     if diag:  # same block: keep strictly-lower-triangle pairs only
-        valid &= jnp.tril(jnp.ones(s.shape, bool), -1)
-    eq = li[:, None] == lj[None, :]
+        base = jnp.tril(base, -1)
 
     idx = jnp.clip(((s - lo) / (hi - lo) * bins).astype(jnp.int32), 0, bins - 1)
     idx = idx.ravel()
-    rel = (valid & eq).ravel().astype(jnp.int32)
-    unrel = (valid & ~eq).ravel().astype(jnp.int32)
-    acc_rel = acc_rel.at[idx].add(rel)
-    acc_unrel = acc_unrel.at[idx].add(unrel)
-    oob = valid & ((s < lo) | (s >= hi))
-    acc_oob = acc_oob + jnp.sum(oob.astype(jnp.int32))
+    n_labels = li.shape[0]
+    for l in range(n_labels):  # static unroll; L is small (label kinds)
+        valid = base & (li[l][:, None] >= 0) & (lj[l][None, :] >= 0)
+        eq = li[l][:, None] == lj[l][None, :]
+        rel = (valid & eq).ravel().astype(jnp.int32)
+        unrel = (valid & ~eq).ravel().astype(jnp.int32)
+        acc_rel = acc_rel.at[l, idx].add(rel)
+        acc_unrel = acc_unrel.at[l, idx].add(unrel)
+        oob = valid & ((s < lo) | (s >= hi))
+        acc_oob = acc_oob.at[l].add(jnp.sum(oob.astype(jnp.int32)))
     return acc_rel, acc_unrel, acc_oob
 
 
@@ -72,16 +79,22 @@ def streaming_auroc(embeddings, labels, metric="cosine", block=2048, bins=8192,
     """Related-vs-unrelated AUROC over all O(N^2) pairs in O(N^2 / block^2) device
     calls and O(bins) memory.
 
-    :param embeddings: [N, D] float array
-    :param labels: [N] ints; < 0 = missing (row excluded, reference helpers.py:91-97).
-        Values are remapped to contiguous int32 internally, so 64-bit hash labels
-        are safe.
+    :param embeddings: [N, D] float array or scipy sparse matrix — sparse rows are
+        densified one block at a time, so wide bag-of-words inputs never
+        materialize as a dense [N, F] host array either
+    :param labels: [N] ints, or a sequence of L such vectors ([L, N]) to score
+        several label kinds in ONE pair sweep (the similarity blocks are
+        label-independent, so extra label sets are nearly free); < 0 = missing
+        (row excluded, reference helpers.py:91-97). Values are remapped to
+        contiguous int32 internally, so 64-bit hash labels are safe.
     :param metric: 'cosine' (rows l2-normalized; scores in [-1, 1]) or
         'linear kernel' (raw dot products; pass value_range)
     :param value_range: (lo, hi) score range for binning; required for
         'linear kernel', defaults to (-1, 1) for cosine. Raises if any valid
         pair's score falls outside it.
-    :return: auroc, or (auroc, hist_related, hist_unrelated, bin_edges)
+    :return: auroc float (list of L floats for multiple label sets), or with
+        return_histograms: (auroc, hist_related, hist_unrelated, bin_edges) where
+        the histograms are [bins] (or [L, bins])
     """
     assert metric in ("cosine", "linear kernel")
     if value_range is None:
@@ -94,62 +107,100 @@ def streaming_auroc(embeddings, labels, metric="cosine", block=2048, bins=8192,
     span = hi - lo
     lo, hi = lo - 1e-5 * span, hi + 1e-5 * span
 
-    x = np.asarray(embeddings, np.float32)
-    labels = np.asarray(labels)
+    sparse_in = sp.issparse(embeddings)
+    x = embeddings.tocsr() if sparse_in else np.asarray(embeddings, np.float32)
     n = x.shape[0]
-    # remap to contiguous int32: equality-only semantics, immune to 64-bit labels
-    nonneg = labels >= 0
-    remapped = np.full(n, -1, np.int32)
-    if nonneg.any():
-        remapped[nonneg] = np.unique(labels[nonneg], return_inverse=True)[1]
-    labels = remapped
+
+    label_mat = np.atleast_2d(np.asarray(labels))
+    single = np.asarray(labels).ndim == 1
+    assert label_mat.shape[1] == n, (label_mat.shape, n)
+    # remap each set to contiguous int32: equality-only semantics, immune to
+    # 64-bit labels
+    remapped = np.full(label_mat.shape, -1, np.int32)
+    for l in range(label_mat.shape[0]):
+        nonneg = label_mat[l] >= 0
+        if nonneg.any():
+            remapped[l, nonneg] = np.unique(label_mat[l, nonneg],
+                                            return_inverse=True)[1]
+    label_mat = remapped
+    n_labels = label_mat.shape[0]
+
     if metric == "cosine":
-        denom = np.sqrt((x * x).sum(axis=1, keepdims=True))
-        x = x / np.where(denom == 0, 1.0, denom)
+        if sparse_in:
+            inv = np.sqrt(np.asarray(x.multiply(x).sum(axis=1)).ravel())
+            inv = 1.0 / np.where(inv == 0, 1.0, inv)
+        else:
+            denom = np.sqrt((x * x).sum(axis=1, keepdims=True))
+            x = x / np.where(denom == 0, 1.0, denom)
 
     # pad to a block multiple with excluded rows so every device call has one shape
     n_pad = int(-(-n // block) * block)
-    if n_pad != n:
-        x = np.concatenate([x, np.zeros((n_pad - n, x.shape[1]), np.float32)])
-        labels = np.concatenate([labels, np.full(n_pad - n, -1, np.int32)])
+    label_mat = np.concatenate(
+        [label_mat, np.full((n_labels, n_pad - n), -1, np.int32)], axis=1)
 
-    xd = jnp.asarray(x)
-    ld = jnp.asarray(labels)
-    hist_rel = np.zeros(bins, np.float64)
-    hist_unrel = np.zeros(bins, np.float64)
-    oob_total = 0
+    def rows(start):
+        """One [block, D] dense float32 row block from sparse input (normalized,
+        padded past n with zeros)."""
+        assert sparse_in
+        stop = min(start + block, n)
+        out = np.asarray(x[start:stop].todense(), np.float32)
+        if metric == "cosine":
+            out *= inv[start:stop, None]
+        if stop - start < block:
+            out = np.concatenate(
+                [out, np.zeros((block - (stop - start), x.shape[1]), np.float32)])
+        return jnp.asarray(out)
+
+    ld = jnp.asarray(label_mat)
+    hist_rel = np.zeros((n_labels, bins), np.float64)
+    hist_unrel = np.zeros((n_labels, bins), np.float64)
+    oob_total = np.zeros(n_labels, np.int64)
 
     def fresh():
-        return (jnp.zeros(bins, jnp.int32), jnp.zeros(bins, jnp.int32),
-                jnp.zeros((), jnp.int32))
+        return (jnp.zeros((n_labels, bins), jnp.int32),
+                jnp.zeros((n_labels, bins), jnp.int32),
+                jnp.zeros(n_labels, jnp.int32))
+
+    # dense inputs go to the device once; sparse inputs densify per row block
+    # (column blocks re-densify per pass — memory stays O(block * D) on host)
+    xd = None if sparse_in else jnp.asarray(
+        np.concatenate([x, np.zeros((n_pad - n, x.shape[1]), np.float32)])
+        if n_pad != n else x)
+
+    def block_of(start):
+        return rows(start) if sparse_in else xd[start : start + block]
 
     acc = fresh()
     pairs_in_acc = 0
     for bi in range(0, n_pad, block):
-        xi, li = xd[bi : bi + block], ld[bi : bi + block]
+        xi, li = block_of(bi), ld[:, bi : bi + block]
         for bj in range(0, bi + block, block):
             if pairs_in_acc + block * block > _FLUSH_PAIRS:
                 hist_rel += np.asarray(acc[0], np.float64)
                 hist_unrel += np.asarray(acc[1], np.float64)
-                oob_total += int(acc[2])
+                oob_total += np.asarray(acc[2], np.int64)
                 acc = fresh()
                 pairs_in_acc = 0
-            acc = _block_hists(*acc, xi, xd[bj : bj + block], li,
-                               ld[bj : bj + block], lo, hi, bins,
+            acc = _block_hists(*acc, xi, block_of(bj), li,
+                               ld[:, bj : bj + block], lo, hi, bins,
                                diag=(bi == bj))
             pairs_in_acc += block * block
     hist_rel += np.asarray(acc[0], np.float64)
     hist_unrel += np.asarray(acc[1], np.float64)
-    oob_total += int(acc[2])
+    oob_total += np.asarray(acc[2], np.int64)
 
-    if oob_total:
+    if oob_total.any():
         raise ValueError(
-            f"{oob_total} pair scores fell outside value_range=({lo:.6g}, {hi:.6g})"
-            " — widen it; silently clipping them into the edge bins would bias "
-            "the AUROC")
+            f"{int(oob_total.max())} pair scores fell outside "
+            f"value_range=({lo:.6g}, {hi:.6g}) — widen it; silently clipping them "
+            "into the edge bins would bias the AUROC")
 
-    auroc = auroc_from_histograms(hist_rel, hist_unrel)
+    aurocs = [auroc_from_histograms(hist_rel[l], hist_unrel[l])
+              for l in range(n_labels)]
+    auroc = aurocs[0] if single else aurocs
     if return_histograms:
         edges = np.linspace(lo, hi, bins + 1)
+        if single:
+            return auroc, hist_rel[0], hist_unrel[0], edges
         return auroc, hist_rel, hist_unrel, edges
     return auroc

@@ -15,7 +15,7 @@ from pathlib import Path
 
 _BOOL_FLAGS = ("verbose", "encode_full", "validation", "save_tsv",
                "restore_previous_data", "restore_previous_model", "synthetic",
-               "profile")
+               "profile", "streaming_eval")
 
 
 def load_dotenv(path=".env"):
@@ -94,6 +94,11 @@ def build_parser(triplet_mode=False):
     p.add_argument("--profile", action="store_true", default=False,
                    help="capture an XProf/TensorBoard device trace of fit() "
                         "under logs/profile/")
+    p.add_argument("--streaming_eval", action="store_true", default=False,
+                   help="compute the AUROC eval tail with the streaming blockwise "
+                        "path (eval/streaming_auroc) — no N x N similarity "
+                        "matrices, no plots; for train/validate sizes where the "
+                        "full matrices don't fit")
     return p
 
 

@@ -80,3 +80,23 @@ def test_main_starspace_end_to_end(workdir):
         assert os.path.isfile(d + f), f
     emb = np.loadtxt(d + "uci_train_starspace_embed.txt")
     assert emb.shape == (150, 16)
+
+
+def test_main_autoencoder_streaming_eval(workdir):
+    """--streaming_eval computes the 12 AUROCs blockwise with no plots; values
+    agree with the full-matrix path on the same run."""
+    from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import main
+
+    args = ["--model_name", "se", "--synthetic", "--validation", "--num_epochs", "2",
+            "--train_row", "120", "--validate_row", "40", "--max_features", "300",
+            "--batch_size", "0.25", "--opt", "ada_grad", "--seed", "0"]
+    model_s, stream = main(args + ["--streaming_eval"])
+    assert len(stream) == 12
+    assert len(os.listdir(model_s.plot_dir)) == 0  # no plots in streaming mode
+    model_f, full = main(["--model_name", "sf"] + args[2:])
+    assert set(stream) == set(full)
+    for k in full:
+        if np.isfinite(full[k]):
+            assert abs(full[k] - stream[k]) < 5e-3, k
+        else:
+            assert not np.isfinite(stream[k]), k

@@ -112,3 +112,32 @@ def test_perfect_separation():
                         e1 + rng.normal(size=(4, d)).astype(np.float32) * 0.01])
     labels = np.array([0] * 4 + [1] * 4)
     assert streaming_auroc(x, labels, block=4) > 0.99
+
+
+def test_sparse_input_matches_dense(rng):
+    """scipy sparse rows densify blockwise; result identical to the dense path."""
+    import scipy.sparse as sp
+
+    x, labels = _clustered_embeddings(rng, n=150)
+    x[x < 0.5] = 0.0  # sparsify
+    xs = sp.csr_matrix(x)
+    ref = streaming_auroc(x, labels, block=64)
+    got = streaming_auroc(xs, labels, block=64)
+    assert abs(ref - got) < 1e-6  # reciprocal-multiply vs divide rounding
+    # ragged final block exercises the per-block padding path
+    got2 = streaming_auroc(xs, labels, block=47)
+    assert abs(ref - got2) < 1e-6
+
+
+def test_multi_label_single_sweep_matches_separate_calls(rng):
+    """[L, N] labels score L label kinds in one sweep, matching L single calls."""
+    x, labels_a = _clustered_embeddings(rng, n=150)
+    labels_b = rng.integers(0, 3, 150).astype(np.int64)
+    both = streaming_auroc(x, np.stack([labels_a, labels_b]), block=64)
+    assert isinstance(both, list) and len(both) == 2
+    assert abs(both[0] - streaming_auroc(x, labels_a, block=64)) < 1e-12
+    assert abs(both[1] - streaming_auroc(x, labels_b, block=64)) < 1e-12
+    # histograms come back stacked
+    _, hr, hu, edges = streaming_auroc(x, np.stack([labels_a, labels_b]),
+                                       block=64, return_histograms=True)
+    assert hr.shape[0] == 2 and hu.shape[0] == 2
