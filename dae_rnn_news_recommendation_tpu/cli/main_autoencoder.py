@@ -172,12 +172,14 @@ def main(argv=None):
             print(f"{k}={getattr(FLAGS, k)}", file=f)
     print("fit done")
 
-    # encode with expected-value scaling of the masking corruption (reference :289-290)
+    # encode with expected-value scaling of the masking corruption (reference
+    # :289-290). The sparse matrix goes to transform() as-is: it densifies per
+    # batch internally, so the full [N, F] array never materializes on host.
     X_encoded = model.transform(
-        np.asarray(decay_noise(data_dict[FLAGS.input_format]["train"], FLAGS.corr_frac).todense()),
+        decay_noise(data_dict[FLAGS.input_format]["train"], FLAGS.corr_frac),
         name="article_encoded", save=FLAGS.encode_full)
     X_encoded_validate = model.transform(
-        np.asarray(decay_noise(data_dict[FLAGS.input_format]["validate"], FLAGS.corr_frac).todense()),
+        decay_noise(data_dict[FLAGS.input_format]["validate"], FLAGS.corr_frac),
         name="article_encoded_validate", save=FLAGS.encode_full)
 
     if FLAGS.save_tsv:

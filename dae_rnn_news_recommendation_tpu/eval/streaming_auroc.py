@@ -181,7 +181,8 @@ def streaming_auroc(embeddings, labels, metric="cosine", block=2048, bins=8192,
                 oob_total += np.asarray(acc[2], np.int64)
                 acc = fresh()
                 pairs_in_acc = 0
-            acc = _block_hists(*acc, xi, block_of(bj), li,
+            xj = xi if bj == bi else block_of(bj)  # diagonal block already held
+            acc = _block_hists(*acc, xi, xj, li,
                                ld[:, bj : bj + block], lo, hi, bins,
                                diag=(bi == bj))
             pairs_in_acc += block * block

@@ -12,6 +12,12 @@ shardings, let XLA insert the collectives over ICI.
   seq.py  — sequence/context parallelism: the GRU user-model recurrence pipelined
             over a time-sharded mesh (GPipe along T; only [Bm, H] states cross
             devices), exact-semantics and differentiable
+  pp.py   — pipeline parallelism: the stacked DAE's equal-width hidden tower,
+            one layer per 'stage' device, GPipe microbatch schedule,
+            differentiable
+
+(Expert parallelism has no counterpart here: this model family has no MoE layers —
+every parallelism axis the DAE/GRU architecture admits is covered.)
 """
 
 from .mesh import get_mesh, get_mesh_2d, initialize_multihost  # noqa: F401
@@ -23,3 +29,4 @@ from .dp import (  # noqa: F401
 )
 from .ring import ring_pairwise_similarity  # noqa: F401
 from .seq import pipeline_gru_apply  # noqa: F401
+from .pp import pipeline_stack_encode, stack_tower_params  # noqa: F401

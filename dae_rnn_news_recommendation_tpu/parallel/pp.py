@@ -1,0 +1,111 @@
+"""Pipeline parallelism (pp) for the stacked DAE's hidden tower.
+
+Completes the parallelism set (dp/tp in dp.py+mesh.py, sp in seq.py): the deep
+variant's equal-width hidden layers (models/stacked.py; the paper's deep stack) are
+placed one-per-device along a 'stage' mesh axis and microbatches flow through the
+classic GPipe schedule — at step s, device d runs layer d on microbatch s-d, then
+hands the [Bm, D] activations one ICI hop to device d+1 with `ppermute`.
+
+Scope and shape rules, honestly stated:
+  - stages must be equal-width (D -> D): JAX shards a stacked [L, D, D] parameter
+    pytree over the mesh, which requires homogeneous layer shapes. The F -> D
+    input layer is different-shaped by nature, so (as with embedding layers in
+    classic PP) it runs replicated BEFORE the pipelined tower — use
+    `stack_tower_params` to split a trained StackedDenoisingAutoencoder
+    accordingly.
+  - forward is differentiable end to end (static trip count -> scan -> AD through
+    ppermute), so a reconstruction/triplet loss on the deepest codes trains the
+    tower through the pipeline.
+
+Each layer applies the paper's modified encoder H = act(H W + bh) - act(bh)
+(reference autoencoder.py:389 at every depth, like models/stacked.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..models.dae_core import resolve_activation
+
+
+def stack_tower_params(sdae):
+    """Split a fitted StackedDenoisingAutoencoder into (input_layer_params,
+    stacked tower params {"W": [L, D, D], "bh": [L, D]}, enc_act_func). Requires
+    >= 2 layers, all hidden layers after the first sharing one width. Thread the
+    returned activation into pipeline_stack_encode — the codes are silently wrong
+    under a different activation."""
+    assert sdae.params, "fit the stack first"
+    assert len(sdae.params) >= 2, (
+        "a pipeline tower needs at least 2 layers (input layer + >=1 stage); "
+        f"got {len(sdae.params)}")
+    widths = {p["W"].shape[1] for p in sdae.params[1:]}
+    assert len({p["W"].shape[0] for p in sdae.params[1:]} | widths) <= 1, (
+        "pipeline stages must be equal-width (D -> D); got layer shapes "
+        f"{[tuple(p['W'].shape) for p in sdae.params]}")
+    tower = {
+        "W": jnp.stack([p["W"] for p in sdae.params[1:]]),
+        "bh": jnp.stack([p["bh"] for p in sdae.params[1:]]),
+    }
+    return sdae.params[0], tower, sdae.enc_act_func
+
+
+def pipeline_stack_encode(tower, x, mesh, act, axis_name="stage",
+                          microbatches=None):
+    """Encode [B, D] inputs through L equal-width layers, layer l on mesh device l.
+
+    :param tower: {"W": [L, D, D], "bh": [L, D]} — L must equal mesh[axis_name]
+    :param x: [B, D] activations out of the (replicated) input layer
+    :param act: the stack's enc_act_func (required — stack_tower_params returns it)
+    :return: [B, D] deepest codes, replicated
+    """
+    n_dev = mesh.shape[axis_name]
+    l, d, d2 = tower["W"].shape
+    assert d == d2, "pipeline stages must be square (D -> D)"
+    assert l == n_dev, f"{l} layers need a {l}-device '{axis_name}' axis, got {n_dev}"
+    b = x.shape[0]
+    m_micro = n_dev if microbatches is None else int(microbatches)
+    assert m_micro >= 1 and b % m_micro == 0, (b, m_micro)
+    bm = b // m_micro
+    act_fn = resolve_activation(act)
+
+    def local_fn(tower_l, x_all):
+        # tower_l: {"W": [1, D, D], "bh": [1, D]} — this device's layer
+        stage = jax.lax.axis_index(axis_name)
+        w, bh = tower_l["W"][0], tower_l["bh"][0]
+        x_m = x_all.reshape(m_micro, bm, d)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+        def layer(h):
+            return act_fn(h @ w + bh) - act_fn(bh)
+
+        def body(s, carry):
+            recv, out = carry
+            m = s - stage
+            active = (m >= 0) & (m < m_micro)
+            mc = jnp.clip(m, 0, m_micro - 1)
+            # stage 0 consumes the input microbatch; later stages consume the
+            # activations handed over by the previous stage
+            h_in = jnp.where(stage == 0,
+                             jax.lax.dynamic_index_in_dim(x_m, mc, 0, False),
+                             recv)
+            h_out = layer(h_in)
+            upd = jax.lax.dynamic_update_index_in_dim(out, h_out, mc, 0)
+            out = jnp.where(active & (stage == n_dev - 1), upd, out)
+            recv = jax.lax.ppermute(h_out, axis_name, perm)
+            return recv, out
+
+        recv = jax.lax.pcast(jnp.zeros((bm, d), x_all.dtype), (axis_name,),
+                             to="varying")
+        out = jax.lax.pcast(jnp.zeros((m_micro, bm, d), x_all.dtype), (axis_name,),
+                            to="varying")
+        _, out = jax.lax.fori_loop(0, m_micro + n_dev - 1, body, (recv, out))
+        # codes exist on the last stage only; psum replicates them
+        return jax.lax.psum(out, axis_name).reshape(b, d)
+
+    fn = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=({"W": P(axis_name, None, None), "bh": P(axis_name, None)}, P()),
+        out_specs=P(),
+    )
+    return fn(tower, x)

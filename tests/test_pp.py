@@ -1,0 +1,80 @@
+"""Pipeline-parallel stacked-DAE tower (parallel/pp.py) vs the single-device
+layer composition, on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dae_rnn_news_recommendation_tpu.models.dae_core import encode as dae_encode
+from dae_rnn_news_recommendation_tpu.models.stacked import StackedDenoisingAutoencoder
+from dae_rnn_news_recommendation_tpu.parallel import (
+    pipeline_stack_encode, stack_tower_params)
+
+
+@pytest.fixture
+def fitted(rng):
+    X = (rng.uniform(size=(48, 30)) < 0.2).astype(np.float32)
+    sdae = StackedDenoisingAutoencoder([10, 10, 10, 10, 10], num_epochs=1,
+                                       batch_size=24, seed=0)
+    sdae.fit(X)
+    inp, tower, act = stack_tower_params(sdae)
+    x0 = jnp.asarray(dae_encode(inp, jnp.asarray(X), sdae.configs[0]))
+    return sdae, X, x0, tower, act
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]).reshape(n), ("stage",))
+
+
+@pytest.mark.parametrize("microbatches", [1, 2, 8])
+def test_pp_matches_layer_composition(fitted, microbatches):
+    sdae, X, x0, tower, act = fitted
+    ref = sdae.encode(X)
+    got = pipeline_stack_encode(tower, x0, _mesh(4), act=act,
+                                microbatches=microbatches)
+    np.testing.assert_allclose(ref, np.asarray(got), atol=1e-5)
+
+
+def test_pp_is_differentiable(fitted):
+    """The tower trains through the pipeline: grads match the serial composition."""
+    sdae, X, x0, tower, act = fitted
+    mesh = _mesh(4)
+
+    def loss_pp(tw):
+        return jnp.mean(pipeline_stack_encode(tw, x0, mesh,
+                                              act=act,
+                                              microbatches=2) ** 2)
+
+    def loss_serial(tw):
+        h = x0
+        for l in range(tw["W"].shape[0]):
+            h = jnp.tanh(h @ tw["W"][l] + tw["bh"][l]) - jnp.tanh(tw["bh"][l])
+        return jnp.mean(h ** 2)
+
+    np.testing.assert_allclose(float(loss_pp(tower)), float(loss_serial(tower)),
+                               rtol=1e-6)
+    g_pp = jax.grad(loss_pp)(tower)
+    g_s = jax.grad(loss_serial)(tower)
+    for k in g_s:
+        np.testing.assert_allclose(np.asarray(g_pp[k]), np.asarray(g_s[k]),
+                                   atol=1e-5, err_msg=k)
+
+
+def test_pp_shape_validation(fitted, rng):
+    sdae, X, x0, tower, act = fitted
+    with pytest.raises(AssertionError):  # 4 layers on an 8-device axis
+        pipeline_stack_encode(tower, x0, _mesh(8), act=act)
+    uneven = StackedDenoisingAutoencoder([12, 8], num_epochs=0, batch_size=24)
+    uneven.fit((rng.uniform(size=(24, 30)) < 0.2).astype(np.float32))
+    with pytest.raises(AssertionError, match="equal-width"):
+        stack_tower_params(uneven)
+
+
+def test_single_layer_stack_rejected(rng):
+    single = StackedDenoisingAutoencoder([10], num_epochs=0, batch_size=24)
+    single.fit((rng.uniform(size=(24, 30)) < 0.2).astype(np.float32))
+    with pytest.raises(AssertionError, match="at least 2 layers"):
+        stack_tower_params(single)
