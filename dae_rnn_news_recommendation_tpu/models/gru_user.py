@@ -73,14 +73,9 @@ def gru_apply(params, seq, mask=None, h0=None):
     return jnp.swapaxes(states, 0, 1), final
 
 
-def pairwise_rank_loss(params, seq, pos, neg, mask=None):
-    """softplus margin loss over per-step states: score clicked above non-clicked.
-
-    :param seq: [B, T, D] browsed-article embeddings
-    :param pos: [B, T, D] clicked article at each step (the paper uses the next click)
-    :param neg: [B, T, D] sampled non-clicked article
-    """
-    states, _ = gru_apply(params, seq, mask)
+def rank_loss_from_states(states, pos, neg, mask=None):
+    """softplus margin loss given the per-step states (shared by the local and
+    sequence-parallel paths)."""
     s_pos = jnp.sum(states * pos, axis=-1)
     s_neg = jnp.sum(states * neg, axis=-1)
     per_step = jax.nn.softplus(-(s_pos - s_neg))
@@ -90,12 +85,31 @@ def pairwise_rank_loss(params, seq, pos, neg, mask=None):
     return jnp.sum(per_step * m) / (jnp.sum(m) + 1e-16)
 
 
+def pairwise_rank_loss(params, seq, pos, neg, mask=None):
+    """softplus margin loss over per-step states: score clicked above non-clicked.
+
+    :param seq: [B, T, D] browsed-article embeddings
+    :param pos: [B, T, D] clicked article at each step (the paper uses the next click)
+    :param neg: [B, T, D] sampled non-clicked article
+    """
+    states, _ = gru_apply(params, seq, mask)
+    return rank_loss_from_states(states, pos, neg, mask)
+
+
 class GRUUserModel:
     """Thin trainer around the functional GRU: fit on (seq, pos, neg) batches,
     produce user states with `user_state`."""
 
     def __init__(self, d_embed, d_hidden=None, opt="adam", learning_rate=1e-3,
-                 momentum=0.5, num_epochs=5, batch_size=64, seed=0, verbose=False):
+                 momentum=0.5, num_epochs=5, batch_size=64, seed=0, verbose=False,
+                 mesh=None, seq_microbatches=None):
+        """:param mesh: optional Mesh with a 'seq' axis — training (and inference,
+        when shapes allow) then runs the recurrence through the sequence-parallel
+        pipeline (parallel/seq.py): T sharded over the axis, exact semantics,
+        gradients flow through the ppermute handoffs. Constraints: the mesh axis
+        size must divide T, and `seq_microbatches` (default: the axis size) must
+        divide the batch size — fit() validates both up front; inference falls
+        back to the local scan for incompatible shapes."""
         self.d_embed = d_embed
         self.d_hidden = d_hidden or d_embed
         self.opt = opt
@@ -105,7 +119,30 @@ class GRUUserModel:
         self.batch_size = batch_size
         self.seed = seed
         self.verbose = verbose
+        self.mesh = mesh
+        self.seq_microbatches = seq_microbatches
         self.params = None
+
+    def _mesh_compatible(self, b, t):
+        if self.mesh is None:
+            return False
+        n_dev = self.mesh.shape["seq"]
+        m = self.seq_microbatches or n_dev
+        return t % n_dev == 0 and b % m == 0
+
+    def _apply(self, params, seq, mask=None, allow_fallback=False):
+        """gru_apply, routed through the sequence-parallel pipeline when a mesh
+        was given. With allow_fallback (inference), incompatible shapes use the
+        local scan instead of failing — identical results either way."""
+        if self.mesh is None or (
+                allow_fallback and not self._mesh_compatible(*seq.shape[:2])):
+            return gru_apply(params, seq, mask)
+        from ..parallel.seq import pipeline_gru_apply
+
+        if mask is None:
+            mask = jnp.ones(seq.shape[:2], seq.dtype)
+        return pipeline_gru_apply(params, seq, mask, self.mesh,
+                                  microbatches=self.seq_microbatches)
 
     def fit(self, seq, pos, neg, mask=None):
         """:param seq/pos/neg: [N, T, D] float arrays; mask [N, T]."""
@@ -117,14 +154,25 @@ class GRUUserModel:
 
         @jax.jit
         def step(params, opt_state, batch):
-            loss, grads = jax.value_and_grad(pairwise_rank_loss)(
-                params, batch["seq"], batch["pos"], batch["neg"], batch.get("mask"))
+            def loss_fn(p):
+                states, _ = self._apply(p, batch["seq"], batch.get("mask"))
+                return rank_loss_from_states(states, batch["pos"], batch["neg"],
+                                             batch.get("mask"))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
             return params, opt_state, loss
 
         n = seq.shape[0]
         bs = min(self.batch_size, n)
+        if self.mesh is not None and not self._mesh_compatible(bs, seq.shape[1]):
+            n_dev = self.mesh.shape["seq"]
+            m = self.seq_microbatches or n_dev
+            raise ValueError(
+                f"sequence-parallel fit needs the mesh axis ({n_dev}) to divide "
+                f"T={seq.shape[1]} and seq_microbatches ({m}) to divide the "
+                f"effective batch size ({bs}); adjust batch_size/seq_microbatches")
         rng = np.random.default_rng(self.seed)
         last = None
         for epoch in range(self.num_epochs):
@@ -144,8 +192,9 @@ class GRUUserModel:
 
     def user_state(self, seq, mask=None):
         """Final user state for each sequence: [N, H]."""
-        _, final = gru_apply(self.params, jnp.asarray(seq),
-                             None if mask is None else jnp.asarray(mask))
+        _, final = self._apply(self.params, jnp.asarray(seq),
+                               None if mask is None else jnp.asarray(mask),
+                               allow_fallback=True)
         return np.asarray(final)
 
     def score(self, seq, candidates, mask=None):
