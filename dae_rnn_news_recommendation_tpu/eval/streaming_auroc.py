@@ -102,10 +102,10 @@ def streaming_auroc(embeddings, labels, metric="cosine", block=2048, bins=8192,
             raise ValueError("value_range is required for metric='linear kernel' "
                              "(dot products are unbounded)")
         value_range = (-1.0, 1.0)
-    lo, hi = float(value_range[0]), float(value_range[1])
+    lo_req, hi_req = float(value_range[0]), float(value_range[1])
     # widen a hair so binning of exact endpoints is clip-free
-    span = hi - lo
-    lo, hi = lo - 1e-5 * span, hi + 1e-5 * span
+    span = hi_req - lo_req
+    lo, hi = lo_req - 1e-5 * span, hi_req + 1e-5 * span
 
     sparse_in = sp.issparse(embeddings)
     x = embeddings.tocsr() if sparse_in else np.asarray(embeddings, np.float32)
@@ -193,8 +193,8 @@ def streaming_auroc(embeddings, labels, metric="cosine", block=2048, bins=8192,
     if oob_total.any():
         raise ValueError(
             f"{int(oob_total.max())} pair scores fell outside "
-            f"value_range=({lo:.6g}, {hi:.6g}) — widen it; silently clipping them "
-            "into the edge bins would bias the AUROC")
+            f"value_range=({lo_req:.6g}, {hi_req:.6g}) — widen it; silently "
+            "clipping them into the edge bins would bias the AUROC")
 
     aurocs = [auroc_from_histograms(hist_rel[l], hist_unrel[l])
               for l in range(n_labels)]

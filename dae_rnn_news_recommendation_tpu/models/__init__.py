@@ -18,6 +18,14 @@ _LAZY = {
     "StackedDenoisingAutoencoder": "stacked",
 }
 
+# __all__ lists only the eager names: a star-import must not trigger __getattr__,
+# which would eagerly import estimator/stacked and close the train/ cycle the lazy
+# scheme exists to avoid. __dir__ still advertises the lazy names for completion.
+__all__ = [
+    "DAEConfig", "init_params", "encode", "decode", "forward",
+    "resolve_activation", "GRUUserModel", "gru_init_params", "gru_apply",
+]
+
 
 def __getattr__(name):
     if name in _LAZY:
@@ -26,3 +34,7 @@ def __getattr__(name):
         mod = importlib.import_module(f".{_LAZY[name]}", __name__)
         return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -151,8 +151,9 @@ class DenoisingAutoencoder:
         }
 
     def _root_key(self):
-        seed = self.seed if self.seed is not None and self.seed >= 0 else np.random.SeedSequence().entropy % (2**31)
-        return jax.random.PRNGKey(int(seed))
+        from ..utils.seeding import resolve_seed
+
+        return jax.random.PRNGKey(resolve_seed(self.seed))
 
     def _make_config(self, n_features):
         if self.n_components_override is not None:

@@ -145,8 +145,15 @@ class GRUUserModel:
                                   microbatches=self.seq_microbatches)
 
     def fit(self, seq, pos, neg, mask=None):
-        """:param seq/pos/neg: [N, T, D] float arrays; mask [N, T]."""
-        key = jax.random.PRNGKey(self.seed)
+        """:param seq/pos/neg: [N, T, D] float arrays; mask [N, T].
+
+        A ragged tail batch is wrapped with rows from the permutation head to keep
+        shapes static, but the wrapped rows are masked out of the loss so no row
+        gets two gradient contributions per epoch."""
+        from ..utils.seeding import resolve_seed
+
+        seed = resolve_seed(self.seed)  # seed<0 means unseeded: draw fresh
+        key = jax.random.PRNGKey(seed)
         key, init_key = jax.random.split(key)
         self.params = gru_init_params(init_key, self.d_embed, self.d_hidden)
         optimizer = make_optimizer(self.opt, self.learning_rate, self.momentum)
@@ -173,18 +180,23 @@ class GRUUserModel:
                 f"sequence-parallel fit needs the mesh axis ({n_dev}) to divide "
                 f"T={seq.shape[1]} and seq_microbatches ({m}) to divide the "
                 f"effective batch size ({bs}); adjust batch_size/seq_microbatches")
-        rng = np.random.default_rng(self.seed)
+        rng = np.random.default_rng(seed)
+        ones_mask = np.ones((bs, seq.shape[1]), np.float32) if mask is None else None
         last = None
         for epoch in range(self.num_epochs):
             order = rng.permutation(n)
             for start in range(0, n, bs):
                 idx = order[start:start + bs]
-                if len(idx) < bs:  # wrap the tail so every row trains, shapes stay static
-                    idx = np.concatenate([idx, order[: bs - len(idx)]])
+                n_real = len(idx)
+                if n_real < bs:  # wrap the tail so shapes stay static...
+                    idx = np.concatenate([idx, order[: bs - n_real]])
                 batch = {"seq": jnp.asarray(seq[idx]), "pos": jnp.asarray(pos[idx]),
                          "neg": jnp.asarray(neg[idx])}
-                if mask is not None:
-                    batch["mask"] = jnp.asarray(mask[idx])
+                m = ones_mask if mask is None else np.asarray(mask[idx], np.float32)
+                if n_real < bs:  # ...but mask the wrapped rows out of the loss so
+                    m = m.copy()  # no row gets two gradient contributions per epoch
+                    m[n_real:] = 0.0
+                batch["mask"] = jnp.asarray(m)
                 self.params, opt_state, last = step(self.params, opt_state, batch)
             if self.verbose and last is not None:
                 print(f"epoch {epoch+1}: loss={float(last):.4f}")

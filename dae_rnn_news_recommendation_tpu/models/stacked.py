@@ -60,7 +60,10 @@ class StackedDenoisingAutoencoder:
 
     def fit(self, X):
         """Greedy layerwise pretraining."""
-        key = jax.random.PRNGKey(self.seed)
+        from ..utils.seeding import resolve_seed
+
+        seed = resolve_seed(self.seed)  # seed<0 means unseeded: draw fresh
+        key = jax.random.PRNGKey(seed)
         rep = X
         self.configs, self.params = [], []
         n_in = X.shape[1]
@@ -71,7 +74,7 @@ class StackedDenoisingAutoencoder:
             optimizer = make_optimizer(self.opt, self.learning_rate, self.momentum)
             opt_state = optimizer.init(params)
             step = make_train_step(cfg, optimizer)
-            batcher = PaddedBatcher(self.batch_size, seed=self.seed + li)
+            batcher = PaddedBatcher(self.batch_size, seed=seed + li)
             t0 = time.time()
             for epoch in range(self.num_epochs):
                 for batch in batcher.epoch(rep):
@@ -151,7 +154,9 @@ class StackedDenoisingAutoencoder:
                                                 layer_params, updates)
             return new_params, opt_state2, loss
 
-        batcher = PaddedBatcher(self.batch_size, seed=self.seed + 1000)
+        from ..utils.seeding import resolve_seed
+
+        batcher = PaddedBatcher(self.batch_size, seed=resolve_seed(self.seed) + 1000)
         last = None
         for epoch in range(epochs):
             for batch in batcher.epoch(X):

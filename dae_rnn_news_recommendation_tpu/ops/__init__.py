@@ -30,6 +30,18 @@ from .triplet import (  # noqa: F401
 )
 _PALLAS_EXPORTS = ("batch_all_triplet_loss_pallas", "masking_noise_pallas")
 
+# __all__ lists only the eager names: a star-import must not trigger __getattr__,
+# which would eagerly pull in jax.experimental.pallas. __dir__ still advertises
+# the Pallas names for completion.
+__all__ = [
+    "xavier_init", "masking_noise", "salt_and_pepper_noise", "decay_noise",
+    "corrupt", "masking_noise_sparse_host", "reconstruction_loss_per_row",
+    "weighted_loss", "LOSS_FUNCS", "pad_csr_batch", "sparse_encode_matmul",
+    "densify_on_device", "sparse_encode", "anchor_positive_mask",
+    "anchor_negative_mask", "triplet_mask", "batch_all_triplet_loss",
+    "batch_hard_triplet_loss", "precomputed_triplet_loss",
+]
+
 
 def __getattr__(name):
     """Lazy: jax.experimental.pallas (experimental API) loads only when the Pallas
@@ -39,3 +51,7 @@ def __getattr__(name):
 
         return getattr(pallas_kernels, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_PALLAS_EXPORTS))

@@ -196,10 +196,12 @@ def batch_all_triplet_loss_pallas(labels, encode, pos_triplets_only=False,
 # ------------------------------------------------------------------ masking noise
 
 def _masking_kernel(seed_ref, x_ref, out_ref, *, v):
-    # decorrelate blocks AND seeds: stride the stream by the block count so
-    # (seed, block) pairs never collide — seed+program_id alone would make
-    # consecutive seeds produce block-shifted copies of the same mask
-    pltpu.prng_seed(seed_ref[0] * pl.num_programs(0) + pl.program_id(0))
+    # decorrelate blocks AND seeds: mix with odd-constant multiplies + XOR.
+    # Within one call blocks stay distinct (odd multiply is a bijection mod 2^32);
+    # across seeds collisions become unstructured ~2^-32 events rather than the
+    # systematic block-shifted-mask aliasing of seed+program_id, or the int32
+    # wraparound of seed*num_programs+program_id for large seeds/row counts.
+    pltpu.prng_seed(seed_ref[0] * 668265295 ^ pl.program_id(0) * 374761393)
     # logical (not arithmetic) shift: raw bits come back signed and Mosaic can't
     # cast uint32->f32, so keep int32 and shift the sign bit out of the way.
     # top 24 bits -> uniform [0, 1): exact float32 arithmetic

@@ -1,4 +1,5 @@
 from .dirs import create_run_directories  # noqa: F401
+from .seeding import resolve_seed  # noqa: F401
 from .provenance import write_parameter_file  # noqa: F401
 from .metrics import MetricsWriter  # noqa: F401
 from .checkpoint import save_checkpoint, load_checkpoint, latest_checkpoint  # noqa: F401

@@ -1,0 +1,14 @@
+"""One seed convention for every model: seed >= 0 is exact, seed < 0 (or None)
+draws a fresh random seed — matching the reference's seed>=0 gate
+(run_autoencoder.py:52-55: only non-negative seeds pin the RNGs; the default -1
+leaves runs randomized)."""
+
+import numpy as np
+
+
+def resolve_seed(seed):
+    """Return a concrete non-negative int seed. Negative/None means 'unseeded':
+    draw one from OS entropy (callers may log it for reproducibility)."""
+    if seed is not None and seed >= 0:
+        return int(seed)
+    return int(np.random.SeedSequence().entropy % (2**31))
