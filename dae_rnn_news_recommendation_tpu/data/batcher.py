@@ -17,7 +17,7 @@ import numpy as np
 import scipy.sparse as sp
 
 try:  # optional native fast path (native/fastbatch)
-    from ..native_bindings.fastbatch import densify_csr_rows as _native_densify
+    from ..native.fastbatch import densify_csr_rows as _native_densify
 except Exception:  # pragma: no cover - absence of the .so is a supported config
     _native_densify = None
 

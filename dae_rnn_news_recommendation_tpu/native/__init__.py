@@ -59,6 +59,10 @@ def _bind(lib):
                        ctypes.c_int64, idxp, f32p, ctypes.c_int]
         fn.restype = None
 
+    lib.densify_csr.argtypes = [i64p, i32p, f32p, ctypes.c_int64,
+                                ctypes.c_int64, f32p, ctypes.c_int]
+    lib.densify_csr.restype = None
+
     lib.starspace_train.argtypes = [
         i64p, i32p, ctypes.c_int64, i32p,            # train docs + labels
         ctypes.c_int, ctypes.c_int, ctypes.c_int,    # vocab, n_labels, dim

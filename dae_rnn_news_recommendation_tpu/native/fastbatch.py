@@ -1,0 +1,53 @@
+"""csr row block -> dense float32, via the native packer (src/packer.cc).
+
+This is the dense-batch feed's hot loop (data/batcher.py densify_rows): the
+reference densifies with scipy `.todense()` per batch on one thread
+(reference autoencoder/utils.py:55-63 feeds dense slices); the native path
+scatters csr rows into a preallocated tile across threads.
+
+Importing this module raises ImportError when the native library is
+unavailable (no compiler / build failure), so callers can guard with a plain
+try/except at import time and trust a non-None binding at call time.
+"""
+
+import ctypes
+import os
+
+import numpy as np
+import scipy.sparse as sp
+
+from . import as_ptr, load
+
+_lib = load()
+if _lib is None or not hasattr(_lib, "densify_csr"):
+    raise ImportError("native library unavailable (densify_csr missing)")
+
+_THREADS = min(os.cpu_count() or 1, 8)
+
+
+def densify_csr_rows(rows, out=None, threads=None):
+    """Dense float32 [n, F] copy of a scipy csr block.
+
+    `out` is reused when its shape matches (the batcher passes a persistent
+    tile). Rows with duplicate column entries take the last value (vectorizer
+    output never has duplicates; scipy would sum them).
+    """
+    assert sp.issparse(rows)
+    if not sp.isspmatrix_csr(rows):
+        rows = rows.tocsr()
+    n, f = rows.shape
+    if out is None or out.shape != (n, f) or out.dtype != np.float32 \
+            or not out.flags.c_contiguous:
+        out = np.empty((n, f), np.float32)
+    indptr = np.ascontiguousarray(rows.indptr, np.int64)
+    indices = np.ascontiguousarray(rows.indices, np.int32)
+    data = np.ascontiguousarray(rows.data, np.float32)
+    if threads is None:
+        # threading pays only on big tiles; small batches stay single-pass
+        threads = _THREADS if n * f >= 1 << 22 else 1
+    _lib.densify_csr(
+        as_ptr(indptr, ctypes.c_int64), as_ptr(indices, ctypes.c_int32),
+        as_ptr(data, ctypes.c_float), n, f, as_ptr(out, ctypes.c_float),
+        int(threads),
+    )
+    return out

@@ -67,9 +67,47 @@ void pack_csr_impl(const int64_t* indptr, const int32_t* indices,
   for (auto& th : pool) th.join();
 }
 
+void densify_rows_range(const int64_t* indptr, const int32_t* indices,
+                        const float* data, int64_t row_lo, int64_t row_hi,
+                        int64_t n_cols, float* out) {
+  for (int64_t i = row_lo; i < row_hi; ++i) {
+    float* row = out + i * n_cols;
+    std::memset(row, 0, sizeof(float) * static_cast<size_t>(n_cols));
+    const int64_t lo = indptr[i], hi = indptr[i + 1];
+    if (data != nullptr)
+      for (int64_t j = lo; j < hi; ++j) row[indices[j]] = data[j];
+    else
+      for (int64_t j = lo; j < hi; ++j) row[indices[j]] = 1.0f;
+  }
+}
+
 }  // namespace
 
 extern "C" {
+
+// csr row block -> dense [n_rows, n_cols] float32 (the dense-batch feed's
+// densify loop, data/batcher.py densify_rows). data == nullptr means binary
+// csr (stored values all 1.0). Duplicate column entries take last-writer value
+// (scipy .todense() sums them; feeds here are vectorizer output with unique
+// columns per row, so the difference never materializes).
+void densify_csr(const int64_t* indptr, const int32_t* indices,
+                 const float* data, int64_t n_rows, int64_t n_cols, float* out,
+                 int threads) {
+  if (threads <= 1 || n_rows < 256) {
+    densify_rows_range(indptr, indices, data, 0, n_rows, n_cols, out);
+    return;
+  }
+  std::vector<std::thread> pool;
+  const int64_t per = (n_rows + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    const int64_t lo = t * per;
+    const int64_t hi = std::min<int64_t>(lo + per, n_rows);
+    if (lo >= hi) break;
+    pool.emplace_back(
+        [=] { densify_rows_range(indptr, indices, data, lo, hi, n_cols, out); });
+  }
+  for (auto& th : pool) th.join();
+}
 
 // data == nullptr means "stored values are all 1.0" (binary csr).
 // out_values == nullptr means binary mode (values not materialized).
