@@ -197,16 +197,30 @@ def main(argv=None):
         hio.save_file(X_encoded, model.tsv_dir + "article_encoded.tsv")
         hio.save_file(X_encoded_validate, model.tsv_dir + "article_encoded_validate.tsv")
 
-    if FLAGS.streaming_eval:
-        # blockwise streaming AUROCs: no N x N matrices, no plots
+    # the default eval tail holds six full [N, N] float32 matrices on host; above
+    # the threshold that's the memory wall, so the streaming path takes over
+    n_eval_max = max(X.shape[0], X_validate.shape[0])
+    if FLAGS.streaming_eval or n_eval_max > FLAGS.streaming_eval_threshold:
+        if not FLAGS.streaming_eval:
+            print(f"eval: {n_eval_max} rows > streaming_eval_threshold="
+                  f"{FLAGS.streaming_eval_threshold}, using streaming path")
+        # blockwise streaming AUROCs: no N x N matrices; the reference's
+        # ROC/boxplot figures are derived from the score histograms
         # (tfidf rows are l2-normalized, so cosine == the reference's linear kernel)
-        from ..eval import streaming_auroc
+        from ..eval import (
+            nearest_neighbor_report_from_top1,
+            streaming_auroc,
+            streaming_top1,
+            visualize_similarity_from_histograms,
+        )
 
         reps = {"tfidf": (X_tfidf, X_tfidf_validate),
                 "binary_count": (X, X_validate),
                 "encoded": (X_encoded, X_encoded_validate)}
         label_kinds = (("label_category_publish_name", "(Category)"),
                        ("label_story", "(Story)"))
+        names = {"tfidf": "TFIDF Vectorized",
+                 "binary_count": "Binary Count Vectorized", "encoded": "Encoded"}
         aurocs = {}
         for kind, (tr_rep, vl_rep) in reps.items():
             for split, rep in (("train", tr_rep), ("validate", vl_rep)):
@@ -214,13 +228,31 @@ def main(argv=None):
                 # label-independent)
                 lab_mat = np.stack([np.asarray(data_dict[lab][split])
                                     for lab, _ in label_kinds])
-                vals = streaming_auroc(rep, lab_mat)
-                for (lab, suffix), v in zip(label_kinds, vals):
+                _, h_rel, h_unrel, edges = streaming_auroc(
+                    rep, lab_mat, return_histograms=True)
+                for l, (lab, suffix) in enumerate(label_kinds):
                     key = (f"similarity_boxplot_{kind}"
                            f"{'_validate' if split == 'validate' else ''}{suffix}")
-                    aurocs[key] = v
+                    aurocs[key] = visualize_similarity_from_histograms(
+                        h_rel[l], h_unrel[l], edges,
+                        title=(f"Cosine Similarity ({names[kind]}) "
+                               f"({split.title()} Data){suffix}"),
+                        save_path=model.plot_dir + key + ".png")
         for k, v in sorted(aurocs.items()):
             print(f"AUROC {k}: {v:.4f}")
+
+        n_train = len(labels[("category_publish_name", "train")])
+        for row in nearest_neighbor_report_from_top1(
+                article_contents.iloc[:n_train],
+                streaming_top1(X_encoded, metric="cosine"),
+                streaming_top1(X, metric="cosine")):
+            print(row["article"])
+            print("most similar article using count vectorizer")
+            print(row["most_similar_by_count"])
+            print("most similar article using DAE")
+            print(row["most_similar_by_embedding"])
+            print(f"score: {row['score']}")
+            print()
         print(__file__ + ": End")
         return model, aurocs
 

@@ -99,16 +99,32 @@ def main(argv=None):
               restore_previous_model=FLAGS.restore_previous_model)
     print("fit done")
 
+    # sparse stays sparse: transform() densifies per batch internally, so the
+    # full [N, F] array never materializes on host (the main driver's fix)
     X_encoded = model.transform(
-        np.asarray(decay_noise(trX, FLAGS.corr_frac).todense()),
+        decay_noise(trX, FLAGS.corr_frac),
         name="article_encoded", save=FLAGS.encode_full)
+
+    labels = valid["label_" + FLAGS.label][:train_row]
+    aurocs = {}
+    if FLAGS.streaming_eval or trX.shape[0] > FLAGS.streaming_eval_threshold:
+        from ..eval import streaming_auroc, visualize_similarity_from_histograms
+
+        for kind, rep in (("count", trX), ("encoded", X_encoded)):
+            _, h_rel, h_unrel, edges = streaming_auroc(
+                rep, np.asarray(labels), return_histograms=True)
+            aurocs[kind] = visualize_similarity_from_histograms(
+                h_rel, h_unrel, edges,
+                title=f"Cosine Similarity ({kind}) (Triplet)",
+                save_path=model.plot_dir + f"similarity_boxplot_{kind}_triplet.png")
+            print(f"AUROC {kind}: {aurocs[kind]:.4f}")
+        print(__file__ + ": End")
+        return model, aurocs
 
     sims = {
         "count": pairwise_similarity(trX, metric="cosine"),
         "encoded": pairwise_similarity(X_encoded, metric="cosine"),
     }
-    labels = valid["label_" + FLAGS.label][:train_row]
-    aurocs = {}
     for kind, sim in sims.items():
         aurocs[kind] = visualize_pairwise_similarity(
             np.asarray(labels), sim, plot="boxplot",

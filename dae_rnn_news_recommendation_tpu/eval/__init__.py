@@ -1,3 +1,14 @@
-from .similarity import pairwise_similarity, nearest_neighbor_report  # noqa: F401
-from .plots import visualize_pairwise_similarity, visualize_scatter, related_unrelated_auroc  # noqa: F401
+from .similarity import (  # noqa: F401
+    pairwise_similarity,
+    nearest_neighbor_report,
+    nearest_neighbor_report_from_top1,
+    streaming_top1,
+)
+from .plots import (  # noqa: F401
+    visualize_pairwise_similarity,
+    visualize_scatter,
+    visualize_similarity_from_histograms,
+    roc_points_from_histograms,
+    related_unrelated_auroc,
+)
 from .streaming_auroc import streaming_auroc, auroc_from_histograms  # noqa: F401

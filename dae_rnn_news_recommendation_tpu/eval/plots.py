@@ -101,6 +101,84 @@ def visualize_pairwise_similarity(labels, pairwise_similarity_metrics, plot="box
     return auroc
 
 
+def _box_stats_from_hist(hist, edges, label):
+    """matplotlib bxp() stats dict from a binned score population: weighted
+    quantiles at bin centers, 1.5-IQR whiskers capped to occupied bins."""
+    h = np.asarray(hist, np.float64)
+    centers = (np.asarray(edges[:-1]) + np.asarray(edges[1:])) / 2.0
+    total = h.sum()
+    cum = np.cumsum(h)
+
+    def quantile(q):
+        return float(centers[np.searchsorted(cum, q * total)])
+
+    q1, med, q3 = quantile(0.25), quantile(0.5), quantile(0.75)
+    iqr = q3 - q1
+    occupied = centers[h > 0]
+    lo = float(occupied[occupied >= q1 - 1.5 * iqr].min())
+    hi = float(occupied[occupied <= q3 + 1.5 * iqr].max())
+    return {"label": label, "med": med, "q1": q1, "q3": q3,
+            "whislo": lo, "whishi": hi,
+            "mean": float((h * centers).sum() / total), "fliers": []}
+
+
+def roc_points_from_histograms(hist_rel, hist_unrel):
+    """(fpr, tpr) curve points from binned related/unrelated score histograms:
+    sweeping the threshold down through the bins, tpr/fpr are suffix sums of the
+    related/unrelated mass — the exact ROC of the quantized scores."""
+    r = np.asarray(hist_rel, np.float64)
+    u = np.asarray(hist_unrel, np.float64)
+    # counts >= each bin's lower edge, descending threshold order
+    r_ge = np.cumsum(r[::-1])[::-1]
+    u_ge = np.cumsum(u[::-1])[::-1]
+    tpr = np.concatenate([[0.0], r_ge[::-1] / max(r.sum(), 1.0)])
+    fpr = np.concatenate([[0.0], u_ge[::-1] / max(u.sum(), 1.0)])
+    return fpr, tpr
+
+
+def visualize_similarity_from_histograms(hist_rel, hist_unrel, edges,
+                                         title=None, figsize=(16, 9),
+                                         save_path=None):
+    """The reference's two-panel ROC+boxplot figure (helpers.py:79-135) rendered
+    from streaming_auroc's histograms instead of raw pair scores — the figure the
+    scaling-safe eval path produces when the full pair populations never exist.
+    Returns the AUROC (exact rank statistic of the binned scores)."""
+    from .streaming_auroc import auroc_from_histograms
+
+    r_total = float(np.sum(hist_rel))
+    u_total = float(np.sum(hist_unrel))
+    if r_total == 0 or u_total == 0:
+        return float("nan")
+    auroc = auroc_from_histograms(hist_rel, hist_unrel)
+    fpr, tpr = roc_points_from_histograms(hist_rel, hist_unrel)
+
+    plt = _plt()
+    plt.figure(figsize=figsize)
+    plt.subplot(121)
+    plt.plot(fpr, tpr, color="darkorange", lw=2,
+             label=f"ROC curve (area = {auroc:0.2f})")
+    plt.plot([0, 1], [0, 1], color="navy", lw=2, linestyle="--")
+    plt.xlim([0.0, 1.0])
+    plt.ylim([0.0, 1.05])
+    plt.xlabel("False Positive Rate")
+    plt.ylabel("True Positive Rate")
+    plt.legend(loc="lower right")
+    if title is not None:
+        plt.title("ROC - " + title)
+
+    ax = plt.subplot(122)
+    ax.bxp([_box_stats_from_hist(hist_rel, edges, "Related"),
+            _box_stats_from_hist(hist_unrel, edges, "Unrelated")],
+           showfliers=False)
+    if title is not None:
+        plt.title(title)
+
+    if save_path is not None:
+        plt.savefig(save_path)
+    plt.close()
+    return auroc
+
+
 def visualize_scatter(data_2d, label, title, figsize=(20, 20), save_path=None):
     """2-D scatter colored by label (reference helpers.py:53-76)."""
     plt = _plt()

@@ -69,21 +69,83 @@ def pairwise_similarity(in_df, norm="", metric="cosine", set_diagonal_zero=True,
     return out
 
 
+def streaming_top1(data, metric="cosine", n_rows=5, block_size=2048):
+    """Most-similar item (self excluded) for the first `n_rows` rows, without the
+    [N, N] matrix: the query block stays on device while the corpus streams
+    through in blocks. Returns (argmax [n_rows] int, score [n_rows] float32).
+
+    Sparse inputs densify one block at a time, so host memory stays O(block * F).
+    """
+    assert metric in ("cosine", "linear kernel")
+    sparse_in = sp.issparse(data)
+    x = data.tocsr() if sparse_in else np.asarray(data, np.float32)
+    n = x.shape[0]
+    n_rows = min(n_rows, n)
+
+    if metric == "cosine":
+        if sparse_in:
+            inv = np.sqrt(np.asarray(x.multiply(x).sum(axis=1)).ravel())
+            inv = 1.0 / np.where(inv == 0, 1.0, inv)
+        else:
+            x = _normalize_host(x, "l2")
+
+    def rows(start, stop):
+        out = np.asarray(x[start:stop].todense(), np.float32) if sparse_in \
+            else x[start:stop]
+        if sparse_in and metric == "cosine":
+            out = out * inv[start:stop, None]
+        return jnp.asarray(out)
+
+    q = rows(0, n_rows)
+
+    @jax.jit
+    def block_scores(corpus):
+        return jnp.matmul(q, corpus.T, precision=jax.lax.Precision.HIGHEST)
+
+    best_idx = np.zeros(n_rows, np.int64)
+    best_val = np.full(n_rows, -np.inf, np.float32)
+    for start in range(0, n, block_size):
+        stop = min(start + block_size, n)
+        s = np.array(block_scores(rows(start, stop)))  # writable host copy
+        # zero the self slot, exactly like the full-matrix path's zeroed
+        # diagonal (so a row whose best off-diagonal score is negative picks
+        # itself at 0.0 on both paths — reference helpers.py:47 semantics)
+        for i in range(n_rows):
+            j = i - start
+            if 0 <= j < s.shape[1]:
+                s[i, j] = 0.0
+        arg = s.argmax(axis=1)
+        val = s[np.arange(n_rows), arg]
+        upd = val > best_val
+        best_idx[upd] = arg[upd] + start
+        best_val[upd] = val[upd]
+    return best_idx, best_val
+
+
+def nearest_neighbor_report_from_top1(article_df, embed_top1, count_top1, top=5):
+    """Report rows from precomputed (argmax, score) pairs — the streaming path's
+    equivalent of nearest_neighbor_report."""
+    embed_idx, embed_score = embed_top1
+    count_idx, _ = count_top1
+    rows = []
+    for i in range(min(top, len(embed_idx))):
+        rows.append({
+            "article": article_df[["category_publish_name", "title"]].iloc[i].to_dict(),
+            "most_similar_by_count": article_df[["category_publish_name", "title"]]
+                .iloc[int(count_idx[i])].to_dict(),
+            "most_similar_by_embedding": article_df[["category_publish_name", "title"]]
+                .iloc[int(embed_idx[i])].to_dict(),
+            "score": float(embed_score[i]),
+        })
+    return rows
+
+
 def nearest_neighbor_report(article_df, sim_embed, sim_count, top=5):
     """Top-similar-article printout rows (reference main_autoencoder.py:352-360):
     for the first `top` articles, the most similar article under the count-vector
     metric and under the learned embedding."""
     count_argmax = np.nanargmax(sim_count, 1)
     embed_argmax = np.nanargmax(sim_embed, 1)
-    rows = []
-    for i in range(min(top, len(embed_argmax))):
-        v = embed_argmax[i]
-        rows.append({
-            "article": article_df[["category_publish_name", "title"]].iloc[i].to_dict(),
-            "most_similar_by_count": article_df[["category_publish_name", "title"]]
-                .iloc[count_argmax[i]].to_dict(),
-            "most_similar_by_embedding": article_df[["category_publish_name", "title"]]
-                .iloc[v].to_dict(),
-            "score": float(sim_embed[i, v]),
-        })
-    return rows
+    embed_score = sim_embed[np.arange(len(embed_argmax)), embed_argmax]
+    return nearest_neighbor_report_from_top1(
+        article_df, (embed_argmax, embed_score), (count_argmax, None), top=top)

@@ -28,9 +28,13 @@ _THREADS = min(os.cpu_count() or 1, 8)
 def densify_csr_rows(rows, out=None, threads=None):
     """Dense float32 [n, F] copy of a scipy csr block.
 
-    `out` is reused when its shape matches (the batcher passes a persistent
-    tile). Rows with duplicate column entries take the last value (vectorizer
-    output never has duplicates; scipy would sum them).
+    `out` is written in place when its shape/dtype match. The batcher/estimator
+    feeds deliberately do NOT pass one: the tile they yield is handed to an
+    async device transfer (and, under data.prefetch, produced ahead of the
+    consumer), so reusing a persistent tile would mutate a buffer still in
+    flight. Pass `out` only when the caller fully consumes the result before
+    the next call. Rows with duplicate column entries take the last value
+    (vectorizer output never has duplicates; scipy would sum them).
     """
     assert sp.issparse(rows)
     if not sp.isspmatrix_csr(rows):

@@ -95,10 +95,16 @@ def build_parser(triplet_mode=False):
                    help="capture an XProf/TensorBoard device trace of fit() "
                         "under logs/profile/")
     p.add_argument("--streaming_eval", action="store_true", default=False,
-                   help="compute the AUROC eval tail with the streaming blockwise "
+                   help="force the AUROC eval tail onto the streaming blockwise "
                         "path (eval/streaming_auroc) — no N x N similarity "
-                        "matrices, no plots; for train/validate sizes where the "
-                        "full matrices don't fit")
+                        "matrices; ROC/boxplot figures come from the score "
+                        "histograms. Auto-selected above --streaming_eval_threshold "
+                        "rows regardless of this flag.")
+    p.add_argument("--streaming_eval_threshold", type=int, default=20000,
+                   help="row count above which the eval tail switches to the "
+                        "streaming path automatically (a full [N, N] float32 "
+                        "similarity matrix at this default is ~1.6 GB; six of "
+                        "them is the host-memory wall)")
     return p
 
 

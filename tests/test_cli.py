@@ -83,8 +83,9 @@ def test_main_starspace_end_to_end(workdir):
 
 
 def test_main_autoencoder_streaming_eval(workdir):
-    """--streaming_eval computes the 12 AUROCs blockwise with no plots; values
-    agree with the full-matrix path on the same run."""
+    """--streaming_eval computes the 12 AUROCs blockwise, with the ROC/boxplot
+    figures derived from the score histograms; values agree with the
+    full-matrix path on the same run."""
     from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import main
 
     args = ["--model_name", "se", "--synthetic", "--validation", "--num_epochs", "2",
@@ -92,7 +93,12 @@ def test_main_autoencoder_streaming_eval(workdir):
             "--batch_size", "0.25", "--opt", "ada_grad", "--seed", "0"]
     model_s, stream = main(args + ["--streaming_eval"])
     assert len(stream) == 12
-    assert len(os.listdir(model_s.plot_dir)) == 0  # no plots in streaming mode
+    # one histogram-derived figure per finite AUROC (degenerate label splits
+    # skip the figure, exactly like the full-matrix path)
+    n_finite = sum(np.isfinite(v) for v in stream.values())
+    plots = os.listdir(model_s.plot_dir)
+    assert len(plots) == n_finite > 0
+    assert all(p.endswith(".png") for p in plots)
     model_f, full = main(["--model_name", "sf"] + args[2:])
     assert set(stream) == set(full)
     for k in full:
@@ -100,3 +106,17 @@ def test_main_autoencoder_streaming_eval(workdir):
             assert abs(full[k] - stream[k]) < 5e-3, k
         else:
             assert not np.isfinite(stream[k]), k
+
+
+def test_main_autoencoder_auto_streaming(workdir):
+    """Above --streaming_eval_threshold rows the eval tail auto-selects the
+    streaming path (figures still produced, full [N, N] matrices never built)."""
+    from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import main
+
+    model, aurocs = main(
+        ["--model_name", "au", "--synthetic", "--validation", "--num_epochs", "1",
+         "--train_row", "120", "--validate_row", "40", "--max_features", "300",
+         "--batch_size", "0.25", "--seed", "0", "--streaming_eval_threshold", "60"])
+    assert len(aurocs) == 12
+    n_finite = sum(np.isfinite(v) for v in aurocs.values())
+    assert len(os.listdir(model.plot_dir)) == n_finite > 0

@@ -82,3 +82,91 @@ def test_nearest_neighbor_report():
     assert len(rows) == 2
     assert rows[0]["most_similar_by_embedding"]["title"] == "t1"
     assert rows[0]["score"] == pytest.approx(0.9)
+
+
+def test_histogram_figure_matches_exact_auroc(tmp_path):
+    """The streaming path's figure must report (nearly) the same AUROC as the
+    exact pair-population path, and the ROC points must be a valid curve."""
+    from dae_rnn_news_recommendation_tpu.eval import (
+        roc_points_from_histograms, streaming_auroc,
+        visualize_similarity_from_histograms)
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(60, 8)).astype(np.float32)
+    x[:30] += 0.8  # related pairs inside the shifted cluster score higher
+    labels = np.array([0] * 30 + [1] * 30)
+
+    sim = pairwise_similarity(x, metric="cosine")
+    exact = related_unrelated_auroc(labels, sim)
+
+    _, h_rel, h_unrel, edges = streaming_auroc(x, labels, return_histograms=True)
+    out = tmp_path / "hist_fig.png"
+    got = visualize_similarity_from_histograms(h_rel, h_unrel, edges,
+                                               title="t", save_path=str(out))
+    assert out.exists() and out.stat().st_size > 0
+    assert got == pytest.approx(exact, abs=2e-3)  # bin-quantization tolerance
+
+    fpr, tpr = roc_points_from_histograms(h_rel, h_unrel)
+    assert fpr[0] == 0.0 and tpr[0] == 0.0
+    assert fpr[-1] == 1.0 and tpr[-1] == 1.0
+    assert (np.diff(fpr) >= 0).all() and (np.diff(tpr) >= 0).all()
+
+
+def test_histogram_figure_degenerate_returns_nan(tmp_path):
+    from dae_rnn_news_recommendation_tpu.eval import (
+        visualize_similarity_from_histograms)
+
+    h = np.zeros(16)
+    edges = np.linspace(-1, 1, 17)
+    assert np.isnan(visualize_similarity_from_histograms(h, h, edges))
+
+
+@pytest.mark.parametrize("kind", ["dense", "sparse"])
+def test_streaming_top1_matches_full_matrix(kind):
+    from dae_rnn_news_recommendation_tpu.eval import streaming_top1
+
+    rng = np.random.default_rng(5)
+    dense = rng.uniform(size=(40, 12)).astype(np.float32)
+    dense[dense < 0.7] = 0.0
+    data = sp.csr_matrix(dense) if kind == "sparse" else dense
+
+    sim = pairwise_similarity(dense, metric="cosine")  # diagonal zeroed
+    want_idx = np.argmax(sim, axis=1)[:5]
+
+    idx, score = streaming_top1(data, metric="cosine", n_rows=5, block_size=16)
+    np.testing.assert_array_equal(idx, want_idx)
+    np.testing.assert_allclose(score, sim[np.arange(5), want_idx],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_streaming_top1_all_negative_neighbors_matches_zero_diagonal():
+    """A row whose every off-diagonal cosine is negative picks itself at 0.0 on
+    the full-matrix path (zeroed diagonal); the streaming path must agree."""
+    from dae_rnn_news_recommendation_tpu.eval import streaming_top1
+
+    x = np.array([[1.0, 0.0], [-1.0, 0.1], [-1.0, -0.1]], np.float32)
+    sim = pairwise_similarity(x, metric="cosine")
+    want_idx = np.argmax(sim, axis=1)
+    idx, score = streaming_top1(x, metric="cosine", n_rows=3, block_size=2)
+    np.testing.assert_array_equal(idx, want_idx)
+    assert idx[0] == 0 and score[0] == 0.0  # row 0: self at zero
+
+
+def test_streaming_report_matches_matrix_report():
+    import pandas as pd
+
+    from dae_rnn_news_recommendation_tpu.eval import (
+        nearest_neighbor_report_from_top1, streaming_top1)
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(20, 6)).astype(np.float32)
+    df = pd.DataFrame({"category_publish_name": ["c"] * 20,
+                       "title": [f"t{i}" for i in range(20)]})
+    sim = pairwise_similarity(x, metric="cosine")
+    want = nearest_neighbor_report(df, sim, sim, top=5)
+    got = nearest_neighbor_report_from_top1(
+        df, streaming_top1(x, n_rows=5), streaming_top1(x, n_rows=5), top=5)
+    for w, g in zip(want, got):
+        assert w["most_similar_by_embedding"] == g["most_similar_by_embedding"]
+        assert w["most_similar_by_count"] == g["most_similar_by_count"]
+        assert w["score"] == pytest.approx(g["score"], abs=1e-5)
