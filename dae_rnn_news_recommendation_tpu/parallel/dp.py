@@ -135,17 +135,51 @@ def _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate):
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
+def _clean_feed(batch):
+    """Validation feeds the clean set as the 'corrupted' input (reference
+    autoencoder.py:300-304)."""
+    batch = dict(batch)
+    if "org" in batch:
+        for n in ("org", "pos", "neg"):
+            batch[f"{n}_corr"] = batch[n]
+    else:
+        batch["x_corr"] = batch["x"]
+    return batch
+
+
 def make_parallel_eval_step(config, mesh, mining_scope="global",
                             loss_fn=loss_and_metrics, data_axis="data",
                             model_axis=None):
+    """Validation step matching the TRAIN mining scope: under 'shard' the
+    objective runs per shard inside shard_map (validation mines the same local
+    populations training optimizes); under 'global' mining sees the full batch.
+    A scope mismatch here would make validation triplet metrics measure a
+    different objective than the one being trained."""
+    if mining_scope == "shard":
+        def local_metrics(params, batch):
+            _, metrics = loss_fn(params, batch, jax.random.PRNGKey(0), config)
+            return {k: jax.lax.pmean(v, data_axis) for k, v in metrics.items()}
+
+        @jax.jit
+        def shard_eval(params, batch):
+            batch = _clean_feed(batch)
+            specs = {
+                k: (P(data_axis, None) if k in _ROW_MATRICES else
+                    (P(data_axis) if k in _ROW_VECTORS else P()))
+                for k in batch
+            }
+            return jax.shard_map(
+                local_metrics, mesh=mesh, in_specs=(P(), specs), out_specs=P(),
+            )(params, batch)
+
+        return shard_eval
+
+    if mining_scope != "global":
+        raise ValueError(f"unknown mining_scope: {mining_scope!r}")
+
     def eval_step(params, batch):
-        batch = dict(batch)
-        if "org" in batch:
-            for n in ("org", "pos", "neg"):
-                batch[f"{n}_corr"] = batch[n]
-        else:
-            batch["x_corr"] = batch["x"]
-        _, metrics = loss_fn(params, batch, jax.random.PRNGKey(0), config)
+        _, metrics = loss_fn(params, _clean_feed(batch), jax.random.PRNGKey(0),
+                             config)
         return metrics
 
     p_sh = param_shardings(mesh, model_axis)

@@ -1,0 +1,94 @@
+"""Two-process jax.distributed smoke test: initialize_multihost must assemble a
+global runtime (jax.devices() spanning both processes) and XLA collectives must
+work over the combined mesh — the CPU stand-in for the multi-host TPU story
+(SURVEY §5.8; the reference has no distributed backend at all).
+
+Each worker is a real OS process with its own JAX runtime (2 virtual CPU
+devices), a gloo collectives backend, and a gRPC coordinator on localhost.
+Skipped when the sandbox forbids sockets or the gloo backend is absent.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    pid, port, repo = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from dae_rnn_news_recommendation_tpu.parallel import (
+        get_mesh, initialize_multihost)
+
+    i, n = initialize_multihost(coordinator_address=f"127.0.0.1:{port}",
+                                num_processes=2, process_id=pid)
+    assert (i, n) == (pid, 2), (i, n)
+    assert len(jax.devices()) == 4, jax.devices()
+    assert len(jax.local_devices()) == 2
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # global mesh over all 4 devices; each process contributes its local rows,
+    # then a jitted global sum forces a cross-process psum
+    mesh = get_mesh(4)
+    sharding = NamedSharding(mesh, P("data"))
+    local = np.full((2, 3), float(pid + 1), np.float32)  # 2 rows per process
+    garr = jax.make_array_from_process_local_data(sharding, local, (4, 3))
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(garr)
+    assert float(total) == 2 * 3 * 1.0 + 2 * 3 * 2.0, float(total)
+    print("MULTIHOST_OK", pid, flush=True)
+""")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_psum(tmp_path):
+    try:
+        port = _free_port()
+    except OSError:
+        pytest.skip("sandbox forbids sockets")
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen([sys.executable, str(worker), str(pid), str(port), repo],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost workers timed out; partial output: "
+                    + " | ".join(outs))
+
+    joined = "\n".join(outs)
+    if any(p.returncode != 0 for p in procs) and (
+            "gloo" in joined.lower() and "unavailable" in joined.lower()):
+        pytest.skip("gloo collectives backend unavailable")
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    assert "MULTIHOST_OK 0" in joined and "MULTIHOST_OK 1" in joined

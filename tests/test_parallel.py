@@ -121,6 +121,30 @@ def test_parallel_eval_step():
     np.testing.assert_allclose(float(m["cost"]), float(m1["cost"]), rtol=1e-5)
 
 
+def test_shard_eval_matches_shard_train_objective():
+    """Under mining_scope='shard', validation must measure the objective being
+    trained: per-shard mining, not global. Eval cost == the train step's
+    pre-update cost on an identical clean batch, and != the global-scope eval."""
+    cfg, params, optimizer, opt_state, batch = _setup("batch_all")
+    mesh = get_mesh(8)
+    tr = make_parallel_train_step(cfg, optimizer, mesh, mining_scope="shard",
+                                  donate=False)
+    _, _, m_train = tr(params, opt_state, jax.random.PRNGKey(0), batch)
+
+    ev = make_parallel_eval_step(cfg, mesh, mining_scope="shard")
+    m_eval = ev(params, batch)
+    np.testing.assert_allclose(float(m_eval["cost"]), float(m_train["cost"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(m_eval["num_triplet"]),
+                               float(m_train["num_triplet"]))
+
+    m_global = make_parallel_eval_step(cfg, mesh, mining_scope="global")(
+        params, batch)
+    # global mining sees B-row triplet populations; 8 local shards of B/8 rows
+    # cannot form the same count on this label distribution
+    assert float(m_eval["num_triplet"]) != float(m_global["num_triplet"])
+
+
 def test_ring_pairwise_similarity_matches_numpy():
     rng = np.random.default_rng(1)
     emb = rng.normal(size=(64, 16)).astype(np.float32)
