@@ -3,8 +3,9 @@
 Twin of the reference's TensorBoard summaries (autoencoder.py:391-393, :431-442,
 :172-173: scalar losses per train step, histograms of W/biases/embeddings, separate
 train/validation writers). Primary sink is newline-delimited JSON under
-logs/{train,validation}/metrics.jsonl — dependency-free and machine-readable; a
-TensorBoard event sink is attached automatically when `tensorboard` is importable.
+logs/{train,validation}/metrics.jsonl — dependency-free and machine-readable; the
+TensorBoard event sink (utils/tb_writer.py, stdlib+numpy only) is always on by
+default, so observability parity never hinges on another framework.
 """
 
 import json
@@ -13,10 +14,7 @@ import time
 
 import numpy as np
 
-try:  # optional TensorBoard sink
-    from torch.utils.tensorboard import SummaryWriter as _TBWriter
-except Exception:  # pragma: no cover
-    _TBWriter = None
+from .tb_writer import EventFileWriter as _TBWriter
 
 
 class MetricsWriter:
@@ -25,10 +23,10 @@ class MetricsWriter:
         self._path = os.path.join(logdir, "metrics.jsonl")
         self._f = open(self._path, "a", buffering=1)
         self._tb = None
-        if use_tensorboard and _TBWriter is not None:
+        if use_tensorboard:
             try:
-                self._tb = _TBWriter(log_dir=logdir)
-            except Exception:
+                self._tb = _TBWriter(logdir)
+            except Exception:  # pragma: no cover - unwritable dir etc.
                 self._tb = None
 
     def scalar(self, tag, value, step):

@@ -1,0 +1,158 @@
+"""Minimal TensorBoard event-file writer — stdlib + numpy only.
+
+The reference logs TB summaries through TF1's built-in writers
+(autoencoder.py:391-393, :431-442); this repo's primary sink is JSONL
+(utils/metrics.py), but TB parity should not hinge on an unrelated framework
+(torch) being importable. The wire format is small enough to emit directly:
+
+  * event files are TFRecords: each record is
+      [uint64 length][uint32 masked_crc32c(length)][payload][uint32 masked_crc32c(payload)]
+    with crc32c (Castagnoli, reflected poly 0x82F63B78) and TF's mask
+    rot15 + 0xa282ead8.
+  * payloads are `tensorflow.Event` protobufs; only three shapes are needed:
+    file_version, scalar summary (Summary.Value.simple_value), histogram
+    summary (Summary.Value.histo = HistogramProto).
+
+TensorBoard reads these files natively; no tensorflow/torch import anywhere.
+"""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+# ------------------------------------------------------------------ crc32c
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def crc32c(data):
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data):
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------------ protobuf
+
+def _varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1  # two's complement for negatives
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _double(field, v):
+    return _key(field, 1) + struct.pack("<d", float(v))
+
+
+def _float(field, v):
+    return _key(field, 5) + struct.pack("<f", float(v))
+
+
+def _int64(field, v):
+    return _key(field, 0) + _varint(int(v))
+
+
+def _bytes(field, b):
+    if isinstance(b, str):
+        b = b.encode("utf-8")
+    return _key(field, 2) + _varint(len(b)) + b
+
+
+def _packed_doubles(field, vals):
+    payload = b"".join(struct.pack("<d", float(v)) for v in vals)
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def _scalar_value(tag, value):
+    # Summary.Value: tag=1 (string), simple_value=2 (float)
+    return _bytes(1, tag) + _float(2, value)
+
+
+def _histogram_proto(values, bins=30):
+    """HistogramProto: min=1 max=2 num=3 sum=4 sum_squares=5 (doubles),
+    bucket_limit=6 bucket=7 (packed doubles)."""
+    v = np.asarray(values, np.float64).ravel()
+    counts, edges = np.histogram(v, bins=bins)
+    return (
+        _double(1, v.min()) + _double(2, v.max()) + _double(3, v.size)
+        + _double(4, v.sum()) + _double(5, np.square(v).sum())
+        + _packed_doubles(6, edges[1:]) + _packed_doubles(7, counts)
+    )
+
+
+def _event(step=None, summary_value=None, file_version=None):
+    # Event: wall_time=1 (double), step=2 (int64), file_version=3 (string),
+    # summary=5 (Summary); Summary: repeated value=1
+    out = _double(1, time.time())
+    if step is not None:
+        out += _int64(2, step)
+    if file_version is not None:
+        out += _bytes(3, file_version)
+    if summary_value is not None:
+        out += _bytes(5, _bytes(1, summary_value))
+    return out
+
+
+class EventFileWriter:
+    """Append-only `events.out.tfevents.*` writer (one per directory)."""
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        host = socket.gethostname() or "localhost"
+        self._path = os.path.join(
+            logdir, f"events.out.tfevents.{int(time.time())}.{host}")
+        self._f = open(self._path, "ab")
+        self._lock = threading.Lock()
+        self._write(_event(file_version="brain.Event:2"))
+
+    def _write(self, payload):
+        header = struct.pack("<Q", len(payload))
+        rec = (header + struct.pack("<I", masked_crc32c(header)) + payload
+               + struct.pack("<I", masked_crc32c(payload)))
+        with self._lock:
+            self._f.write(rec)
+            self._f.flush()
+
+    def add_scalar(self, tag, value, step):
+        self._write(_event(step=step, summary_value=_scalar_value(tag, value)))
+
+    def add_histogram(self, tag, values, step, bins=30):
+        histo = _bytes(5, _histogram_proto(values, bins))  # Value.histo = 5
+        self._write(_event(step=step, summary_value=_bytes(1, tag) + histo))
+
+    def close(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()

@@ -1,0 +1,81 @@
+"""TB event writer: crc vectors, record framing, and round-trip through the real
+TensorBoard event loader (gold reader) when the tensorboard package is present."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from dae_rnn_news_recommendation_tpu.utils.tb_writer import (
+    EventFileWriter, crc32c, masked_crc32c)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / Castagnoli reference vectors
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0x0
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_record_framing_is_valid(tmp_path):
+    w = EventFileWriter(str(tmp_path))
+    w.add_scalar("loss", 0.5, 1)
+    w.add_histogram("weights", np.arange(100.0), 1)
+    w.close()
+    [path] = tmp_path.iterdir()
+    blob = path.read_bytes()
+    n_records = 0
+    off = 0
+    while off < len(blob):
+        header = blob[off : off + 8]
+        (length,) = struct.unpack("<Q", header)
+        (len_crc,) = struct.unpack("<I", blob[off + 8 : off + 12])
+        assert len_crc == masked_crc32c(header)
+        payload = blob[off + 12 : off + 12 + length]
+        (data_crc,) = struct.unpack("<I", blob[off + 12 + length : off + 16 + length])
+        assert data_crc == masked_crc32c(payload)
+        off += 16 + length
+        n_records += 1
+    assert off == len(blob)
+    assert n_records == 3  # file_version + scalar + histogram
+
+
+def test_roundtrip_through_tensorboard_reader(tmp_path):
+    loader_mod = pytest.importorskip(
+        "tensorboard.backend.event_processing.event_file_loader")
+
+    w = EventFileWriter(str(tmp_path))
+    w.add_scalar("train/cost", 1.25, 7)
+    w.add_scalar("train/cost", 0.75, 8)
+    vals = np.concatenate([np.zeros(10), np.ones(30)])
+    w.add_histogram("params/W", vals, 8)
+    w.close()
+
+    [path] = tmp_path.iterdir()
+    events = list(loader_mod.LegacyEventFileLoader(str(path)).Load())
+    assert events[0].file_version == "brain.Event:2"
+
+    scalars = [(e.step, v.tag, v.simple_value)
+               for e in events for v in e.summary.value
+               if v.HasField("simple_value")]
+    assert scalars == [(7, "train/cost", 1.25), (8, "train/cost", 0.75)]
+
+    histos = [(e.step, v.tag, v.histo) for e in events for v in e.summary.value
+              if v.HasField("histo")]
+    assert len(histos) == 1
+    step, tag, h = histos[0]
+    assert (step, tag) == (8, "params/W")
+    assert h.min == 0.0 and h.max == 1.0 and h.num == 40
+    assert h.sum == 30.0 and h.sum_squares == 30.0
+    assert sum(h.bucket) == 40
+
+
+def test_metrics_writer_emits_tb_events(tmp_path):
+    from dae_rnn_news_recommendation_tpu.utils import MetricsWriter
+
+    with MetricsWriter(str(tmp_path)) as mw:
+        mw.scalar("cost", 2.0, 0)
+        mw.histogram("W", np.ones(5), 0)
+    files = [p.name for p in tmp_path.iterdir()]
+    assert "metrics.jsonl" in files
+    assert any(f.startswith("events.out.tfevents.") for f in files)
