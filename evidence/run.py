@@ -1,0 +1,199 @@
+"""Quality-evidence harness: run the full drivers on the seeded synthetic corpus
+and commit the numbers (evidence/results.json + evidence/RESULTS.md).
+
+The reference ships its evidence in-repo (starspace/train.log:115-121 — early
+stopping loss 0.018963 @ epoch 16 — and the uci_*_embed.txt dumps, plus the
+AUROC comparison in prepare_starspace_formatted_data.ipynb cells 9-13). This
+repo's mount has no real UCI parquet (/root/reference/.MISSING_LARGE_BLOBS), so
+the committed record is the seeded synthetic-corpus equivalent: the full
+online-mining driver (12 AUROCs), the precomputed-triplet driver, and the
+native StarSpace baseline, with the quality claims asserted, not just printed:
+
+  * encoded embeddings must beat BOTH chance and the tf-idf representation on
+    the mined Category label, train and validate splits (the reference's
+    headline comparison);
+  * the StarSpace baseline must converge to a finite early-stopping loss.
+
+Reproduce:  JAX_PLATFORMS= python evidence/run.py
+(runs the drivers in a scratch dir; rewrites evidence/{results.json,RESULTS.md})
+"""
+
+import datetime
+import json
+import os
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+SEED = 0
+MAIN_ARGS = [
+    "--model_name", "evidence", "--synthetic", "--validation",
+    "--num_epochs", "25", "--train_row", "1500", "--validate_row", "400",
+    "--max_features", "2000", "--batch_size", "0.1",
+    "--opt", "ada_grad", "--learning_rate", "0.5",
+    "--triplet_strategy", "batch_all", "--alpha", "1.0",
+    "--corr_type", "masking", "--corr_frac", "0.3", "--seed", str(SEED),
+]
+TRIPLET_ARGS = [
+    "--model_name", "evidence_triplet", "--synthetic",
+    "--num_epochs", "15", "--train_row", "800", "--validate_row", "0",
+    "--max_features", "2000", "--batch_size", "0.1",
+    "--opt", "ada_grad", "--learning_rate", "0.5",
+    "--corr_type", "masking", "--corr_frac", "0.3", "--seed", str(SEED),
+]
+STARSPACE_ARGS = [
+    "--model_name", "evidence_ss", "--synthetic",
+    "--train_row", "800", "--validate_row", "300",
+    "--max_features", "2000", "--dim", "50", "--epochs", "30",
+    "--threads", "4", "--seed", str(SEED),
+]
+
+
+def main():
+    t0 = time.time()
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"evidence run on platform={platform}")
+
+    from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import (
+        main as main_autoencoder)
+    from dae_rnn_news_recommendation_tpu.cli.main_autoencoder_triplet import (
+        main as main_triplet)
+    from dae_rnn_news_recommendation_tpu.cli.main_starspace import (
+        main as main_starspace)
+
+    scratch = tempfile.mkdtemp(prefix="evidence_")
+    cwd = os.getcwd()
+    os.chdir(scratch)
+    try:
+        print("== online-mining driver ==")
+        _, aurocs = main_autoencoder(MAIN_ARGS)
+        print("== precomputed-triplet driver ==")
+        _, tri_aurocs = main_triplet(TRIPLET_ARGS)
+        print("== native StarSpace baseline ==")
+        ss_result, ss_aurocs = main_starspace(STARSPACE_ARGS)
+    finally:
+        os.chdir(cwd)
+
+    # ------------------------------------------------------------ assertions
+    checks = {}
+
+    def check(name, ok, detail):
+        checks[name] = {"pass": bool(ok), "detail": detail}
+        print(f"  [{'PASS' if ok else 'FAIL'}] {name}: {detail}")
+
+    enc_tr = aurocs["similarity_boxplot_encoded(Category)"]
+    enc_vl = aurocs["similarity_boxplot_encoded_validate(Category)"]
+    tfidf_tr = aurocs["similarity_boxplot_tfidf(Category)"]
+    tfidf_vl = aurocs["similarity_boxplot_tfidf_validate(Category)"]
+    check("encoded_beats_chance_train", enc_tr > 0.65,
+          f"encoded(Category) train AUROC {enc_tr:.4f} > 0.65")
+    check("encoded_beats_chance_validate", enc_vl > 0.65,
+          f"encoded(Category) validate AUROC {enc_vl:.4f} > 0.65")
+    check("encoded_beats_tfidf_train", enc_tr > tfidf_tr,
+          f"encoded {enc_tr:.4f} > tfidf {tfidf_tr:.4f} (Category, train)")
+    check("encoded_beats_tfidf_validate", enc_vl > tfidf_vl,
+          f"encoded {enc_vl:.4f} > tfidf {tfidf_vl:.4f} (Category, validate)")
+    check("triplet_encoded_above_chance", tri_aurocs["encoded"] > 0.5,
+          f"triplet encoded AUROC {tri_aurocs['encoded']:.4f} > 0.5")
+    import numpy as np
+
+    ss_loss = float(ss_result["best_val_error"])
+    ss_epoch = int(np.argmin(ss_result["epoch_errors"]))
+    check("starspace_converged", np.isfinite(ss_loss),
+          f"early stopping loss {ss_loss:.6f} @ epoch {ss_epoch}")
+
+    payload = {
+        "generated": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "platform": platform,
+        "seed": SEED,
+        "wall_seconds": round(time.time() - t0, 1),
+        "commands": {
+            "main_autoencoder": MAIN_ARGS,
+            "main_autoencoder_triplet": TRIPLET_ARGS,
+            "main_starspace": STARSPACE_ARGS,
+        },
+        "aurocs_online_mining": {k: float(v) for k, v in sorted(aurocs.items())},
+        "aurocs_triplet": {k: float(v) for k, v in sorted(tri_aurocs.items())},
+        "aurocs_starspace": {k: float(v) for k, v in sorted(ss_aurocs.items())},
+        "starspace": {"best_loss": ss_loss, "best_epoch": ss_epoch},
+        "checks": checks,
+    }
+    with open(os.path.join(HERE, "results.json"), "w") as f:
+        json.dump(payload, f, indent=2)
+
+    _write_md(payload)
+    n_fail = sum(not c["pass"] for c in checks.values())
+    print(f"evidence: {len(checks) - n_fail}/{len(checks)} checks passed; "
+          f"artifacts in evidence/ ({payload['wall_seconds']}s)")
+    return 1 if n_fail else 0
+
+
+def _write_md(p):
+    lines = [
+        "# Quality evidence (seeded synthetic corpus)",
+        "",
+        f"Generated {p['generated']} on platform `{p['platform']}`, "
+        f"seed {p['seed']}, {p['wall_seconds']}s wall.",
+        "",
+        "Reproduce: `JAX_PLATFORMS= python evidence/run.py` "
+        "(exact driver flags recorded in results.json).",
+        "",
+        "The real UCI parquet is stripped from this environment "
+        "(`/root/reference/.MISSING_LARGE_BLOBS`), so this is the seeded "
+        "synthetic-corpus record — the same shape of evidence the reference "
+        "commits in `starspace/train.log` and its AUROC-comparison notebook.",
+        "",
+        "## Online-mining driver: 12 AUROCs",
+        "",
+        "| representation | split | Category | Story |",
+        "|---|---|---|---|",
+    ]
+    a = p["aurocs_online_mining"]
+    for rep in ("tfidf", "binary_count", "encoded"):
+        for split, sfx in (("train", ""), ("validate", "_validate")):
+            cat = a[f"similarity_boxplot_{rep}{sfx}(Category)"]
+            sto = a[f"similarity_boxplot_{rep}{sfx}(Story)"]
+            lines.append(f"| {rep} | {split} | {cat:.4f} | {sto:.4f} |")
+    lines += [
+        "",
+        "The DAE is trained with `batch_all` online mining on the Category "
+        "label; the claim under test (reference notebook cells 9-13) is that "
+        "the learned 100-dim embedding beats the 2000-dim tf-idf "
+        "representation on that label's related-vs-unrelated AUROC.",
+        "",
+        "## Precomputed-triplet driver",
+        "",
+        "| representation | AUROC |",
+        "|---|---|",
+    ]
+    for k, v in p["aurocs_triplet"].items():
+        lines.append(f"| {k} | {v:.4f} |")
+    lines += [
+        "",
+        "## Native StarSpace baseline",
+        "",
+        f"Early-stopping loss **{p['starspace']['best_loss']:.6f}** at epoch "
+        f"{p['starspace']['best_epoch']} "
+        "(reference format: starspace/train.log:115-121).",
+        "",
+        "| comparison | AUROC |",
+        "|---|---|",
+    ]
+    for k, v in p["aurocs_starspace"].items():
+        lines.append(f"| {k} | {v:.4f} |")
+    lines += ["", "## Checks", ""]
+    for name, c in p["checks"].items():
+        lines.append(f"- **{'PASS' if c['pass'] else 'FAIL'}** {name}: {c['detail']}")
+    lines.append("")
+    with open(os.path.join(HERE, "RESULTS.md"), "w") as f:
+        f.write("\n".join(lines))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
