@@ -65,23 +65,30 @@ def _bench_encode(jax, params, config):
     enc_fn = jax.jit(lambda p, i: sparse_encode(p, i, None, config, chunk=512))
 
     rng = np.random.default_rng(0)
-    pool = _make_pool(2 * BATCH, rng)
-    # host-side prep (padding) happens once per pool slice; the timed loop measures
-    # the steady-state stream: async H2D of uint16 indices + on-device encode.
+    # EVERY timed dispatch gets distinct input contents: the TPU tunnel in this
+    # environment memoizes (executable, inputs) pairs, so repeating a pool slice
+    # would measure the cache, not the stream. 3 passes x N_BATCHES distinct
+    # batches, padded up front (host prep is not part of the timed stream).
+    n_distinct = 3 * N_BATCHES
+    pool = _make_pool(n_distinct * BATCH, rng)
     # binary mode: values are implicit 1.0, so only indices cross the wire
-    padded = [
-        pad_csr_batch(pool[i * BATCH : (i + 1) * BATCH], binary=True)
-        for i in range(2)
+    host_feeds = [
+        pad_csr_batch(pool[i * BATCH : (i + 1) * BATCH], binary=True)["indices"]
+        for i in range(n_distinct)
     ]
-    host_feeds = [p["indices"] for p in padded]
-
-    def put(i):
-        return jax.device_put(host_feeds[i % len(host_feeds)])
+    warmup_feeds = [
+        pad_csr_batch(_make_pool(BATCH, np.random.default_rng(100 + i)),
+                      binary=True)["indices"]
+        for i in range(WARMUP)
+    ]
 
     for i in range(WARMUP):
-        enc_fn(params, put(i)).block_until_ready()
+        enc_fn(params, jax.device_put(warmup_feeds[i])).block_until_ready()
 
-    def one_pass():
+    def one_pass(feeds):
+        def put(i):
+            return jax.device_put(feeds[i])
+
         t0 = time.perf_counter()
         inflight = [put(i) for i in range(PREFETCH)]
         out = None
@@ -93,9 +100,11 @@ def _bench_encode(jax, params, config):
         out.block_until_ready()
         return time.perf_counter() - t0
 
-    # best of three passes: single-chip-over-tunnel timing jitters run to run,
-    # and peak sustained throughput is the figure of merit for the stream design
-    dt = min(one_pass() for _ in range(3))
+    # best of three passes (each on its own distinct batches): single-chip-over-
+    # tunnel timing jitters run to run, and peak sustained throughput is the
+    # figure of merit for the stream design
+    dt = min(one_pass(host_feeds[p * N_BATCHES : (p + 1) * N_BATCHES])
+             for p in range(3))
     return N_BATCHES * BATCH / dt
 
 

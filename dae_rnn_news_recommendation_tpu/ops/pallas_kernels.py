@@ -22,6 +22,16 @@ kept as validated, hardware-tested alternatives and as the repo's Pallas
 infrastructure (grid accumulation, Mosaic layout constraints, hardware PRNG are all
 exercised and unit-tested against the XLA oracles).
 
+Round-2 re-measurement attempt (tile sweep (8..128, 128..512, 128..512) plus a
+fused-mask variant): ABANDONED as unmeasurable — the TPU tunnel now memoizes
+(executable, inputs) dispatches (identical repeats return in ~0.05 ms regardless
+of volume) and charges a ~200 ms first-execution cost per program, so kernel
+microbenchmarks neither scale with cube volume nor reproduce run to run in either
+direction. The round-1 hardware numbers above remain the best available data and
+the XLA default stands. Any future re-tune must feed DISTINCT input contents per
+dispatch (see bench.py) and should re-verify volume scaling before trusting a
+number.
+
 Mosaic layout rules discovered on hardware (encoded in the kernels/asserts below):
 3D reductions need keepdims (or drop axis 0 only); [n,1,1]->(n,1) reshape lowers but
 singleton-squeeze doesn't; dynamic-slice offsets need 8-alignment on the sublane
