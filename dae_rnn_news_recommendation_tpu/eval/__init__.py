@@ -11,4 +11,8 @@ from .plots import (  # noqa: F401
     roc_points_from_histograms,
     related_unrelated_auroc,
 )
-from .streaming_auroc import streaming_auroc, auroc_from_histograms  # noqa: F401
+from .streaming_auroc import (  # noqa: F401
+    auroc_from_histograms,
+    ring_streaming_auroc,
+    streaming_auroc,
+)

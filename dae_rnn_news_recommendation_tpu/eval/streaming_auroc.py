@@ -62,6 +62,55 @@ def _block_hists(acc_rel, acc_unrel, acc_oob, xi, xj, li, lj, lo, hi, bins, diag
     return acc_rel, acc_unrel, acc_oob
 
 
+def _resolve_value_range(metric, value_range):
+    """(lo_req, hi_req, lo, hi): the caller's requested range plus the slightly
+    widened binning range so exact endpoints never clip."""
+    assert metric in ("cosine", "linear kernel")
+    if value_range is None:
+        if metric != "cosine":
+            raise ValueError("value_range is required for metric='linear kernel' "
+                             "(dot products are unbounded)")
+        value_range = (-1.0, 1.0)
+    lo_req, hi_req = float(value_range[0]), float(value_range[1])
+    span = hi_req - lo_req
+    return lo_req, hi_req, lo_req - 1e-5 * span, hi_req + 1e-5 * span
+
+
+def _finalize_histograms(hist_rel, hist_unrel, oob_total, lo_req, hi_req, lo, hi,
+                         bins, single, return_histograms):
+    """Shared epilogue: OOB guard, per-label AUROCs, optional histogram return."""
+    if oob_total.any():
+        raise ValueError(
+            f"{int(oob_total.max())} pair scores fell outside "
+            f"value_range=({lo_req:.6g}, {hi_req:.6g}) — widen it; silently "
+            "clipping them into the edge bins would bias the AUROC")
+    aurocs = [auroc_from_histograms(hist_rel[l], hist_unrel[l])
+              for l in range(hist_rel.shape[0])]
+    auroc = aurocs[0] if single else aurocs
+    if return_histograms:
+        edges = np.linspace(lo, hi, bins + 1)
+        if single:
+            return auroc, hist_rel[0], hist_unrel[0], edges
+        return auroc, hist_rel, hist_unrel, edges
+    return auroc
+
+
+def _remap_label_matrix(labels, n):
+    """[L, N] int32 label matrix with each set remapped to contiguous codes
+    (equality-only semantics, immune to 64-bit hash labels); negatives stay -1.
+    Returns (label_mat, single) where single marks a 1-D `labels` input."""
+    label_mat = np.atleast_2d(np.asarray(labels))
+    single = np.asarray(labels).ndim == 1
+    assert label_mat.shape[1] == n, (label_mat.shape, n)
+    remapped = np.full(label_mat.shape, -1, np.int32)
+    for l in range(label_mat.shape[0]):
+        nonneg = label_mat[l] >= 0
+        if nonneg.any():
+            remapped[l, nonneg] = np.unique(label_mat[l, nonneg],
+                                            return_inverse=True)[1]
+    return remapped, single
+
+
 def auroc_from_histograms(hist_rel, hist_unrel):
     """Exact AUROC of binned scores (ties within a bin count half)."""
     r = np.asarray(hist_rel, np.float64)
@@ -96,33 +145,13 @@ def streaming_auroc(embeddings, labels, metric="cosine", block=2048, bins=8192,
         return_histograms: (auroc, hist_related, hist_unrelated, bin_edges) where
         the histograms are [bins] (or [L, bins])
     """
-    assert metric in ("cosine", "linear kernel")
-    if value_range is None:
-        if metric != "cosine":
-            raise ValueError("value_range is required for metric='linear kernel' "
-                             "(dot products are unbounded)")
-        value_range = (-1.0, 1.0)
-    lo_req, hi_req = float(value_range[0]), float(value_range[1])
-    # widen a hair so binning of exact endpoints is clip-free
-    span = hi_req - lo_req
-    lo, hi = lo_req - 1e-5 * span, hi_req + 1e-5 * span
+    lo_req, hi_req, lo, hi = _resolve_value_range(metric, value_range)
 
     sparse_in = sp.issparse(embeddings)
     x = embeddings.tocsr() if sparse_in else np.asarray(embeddings, np.float32)
     n = x.shape[0]
 
-    label_mat = np.atleast_2d(np.asarray(labels))
-    single = np.asarray(labels).ndim == 1
-    assert label_mat.shape[1] == n, (label_mat.shape, n)
-    # remap each set to contiguous int32: equality-only semantics, immune to
-    # 64-bit labels
-    remapped = np.full(label_mat.shape, -1, np.int32)
-    for l in range(label_mat.shape[0]):
-        nonneg = label_mat[l] >= 0
-        if nonneg.any():
-            remapped[l, nonneg] = np.unique(label_mat[l, nonneg],
-                                            return_inverse=True)[1]
-    label_mat = remapped
+    label_mat, single = _remap_label_matrix(labels, n)
     n_labels = label_mat.shape[0]
 
     if metric == "cosine":
@@ -190,18 +219,137 @@ def streaming_auroc(embeddings, labels, metric="cosine", block=2048, bins=8192,
     hist_unrel += np.asarray(acc[1], np.float64)
     oob_total += np.asarray(acc[2], np.int64)
 
-    if oob_total.any():
-        raise ValueError(
-            f"{int(oob_total.max())} pair scores fell outside "
-            f"value_range=({lo_req:.6g}, {hi_req:.6g}) — widen it; silently "
-            "clipping them into the edge bins would bias the AUROC")
+    return _finalize_histograms(hist_rel, hist_unrel, oob_total, lo_req, hi_req,
+                                lo, hi, bins, single, return_histograms)
 
-    aurocs = [auroc_from_histograms(hist_rel[l], hist_unrel[l])
-              for l in range(n_labels)]
-    auroc = aurocs[0] if single else aurocs
-    if return_histograms:
-        edges = np.linspace(lo, hi, bins + 1)
-        if single:
-            return auroc, hist_rel[0], hist_unrel[0], edges
-        return auroc, hist_rel, hist_unrel, edges
-    return auroc
+
+_LO_BITS = 20  # ring accumulators: counts split into (hi << 20) + lo int32 pairs
+
+
+def ring_streaming_auroc(embeddings, labels, mesh, metric="cosine", bins=8192,
+                         value_range=None, axis_name="data",
+                         return_histograms=False):
+    """streaming_auroc distributed over a device mesh with the ppermute ring.
+
+    Row blocks shard over `axis_name` and rotate with ppermute — the causal
+    ring-attention schedule: only floor(p/2)+1 hops run (not p), because an
+    unordered block pair {i, j} is processed exactly once, by whichever device
+    holds it first, with the tile transposed when the travelling block is the
+    lower-triangle side. Each step every device does one [n_loc, n_loc] MXU
+    matmul + histogram scatter; only [n_loc, D] tiles ride the ring and only
+    the [L, bins] histograms are psum'd at the end. Pair semantics, binning,
+    and the exact rank statistic match streaming_auroc bit-for-bit (tested);
+    counting stays exact at any N via split int32 accumulators (lo 20 bits +
+    spill each step, for histograms AND the out-of-range guard), good to 2^51
+    pairs per bin.
+
+    :param embeddings: [N, D] dense array (encode first; the mesh path is for
+        the post-encode eval, embeddings are narrow). Padded internally to a
+        mesh multiple with excluded rows.
+    :param labels: as streaming_auroc — [N] or [L, N], < 0 = missing.
+    :return: as streaming_auroc.
+    """
+    lo_req, hi_req, lo, hi = _resolve_value_range(metric, value_range)
+
+    x = np.asarray(embeddings, np.float32)
+    n, d = x.shape
+    label_mat, single = _remap_label_matrix(labels, n)
+    n_labels = label_mat.shape[0]
+
+    if metric == "cosine":
+        denom = np.sqrt((x * x).sum(axis=1, keepdims=True))
+        x = x / np.where(denom == 0, 1.0, denom)
+
+    n_dev = mesh.shape[axis_name]
+    n_pad = int(-(-n // n_dev) * n_dev)
+    if n_pad != n:
+        x = np.concatenate([x, np.zeros((n_pad - n, d), np.float32)])
+        label_mat = np.concatenate(
+            [label_mat, np.full((n_labels, n_pad - n), -1, np.int32)], axis=1)
+    n_loc = n_pad // n_dev
+    assert n_loc * n_loc + (1 << _LO_BITS) < 2**31, (
+        f"{n_loc} rows/device overflows the per-step int32 budget; "
+        "use a bigger mesh")
+
+    mask_lo = (1 << _LO_BITS) - 1
+    half = n_dev // 2
+    n_steps = half + 1 if n_dev % 2 == 0 else (n_dev - 1) // 2 + 1
+    even = n_dev % 2 == 0
+
+    def local_fn(local, llab):
+        # local [n_loc, D]; llab [L, n_loc]
+        me = jax.lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        ar = jnp.arange(n_loc)
+
+        def body(s, carry):
+            block, blab, lo_h, hi_h, ob_lo, ob_hi = carry
+            src = (me - s) % n_dev
+            # orientation: the travelling block is the column side when it is
+            # the earlier block (src < me, plus the s=0 diagonal); when it is
+            # the later block (src > me) the pair {src, me} belongs to src's
+            # rows, so the tile is transposed — that pair is then NOT computed
+            # again by device src (its mirror step p-s is outside the loop).
+            swap = src > me
+            scores0 = jnp.matmul(local, block.T,
+                                 precision=jax.lax.Precision.HIGHEST)
+            scores = jnp.where(swap, scores0.T, scores0)
+            row_g = jnp.where(swap, src, me) * n_loc + ar[:, None]   # [n_loc,1]
+            col_g = jnp.where(swap, me, src) * n_loc + ar[None, :]   # [1,n_loc]
+            rlab = jnp.where(swap, blab, llab)
+            clab = jnp.where(swap, llab, blab)
+            # even p: the antipodal pair {me, me±p/2} is seen by both ends at
+            # s=p/2 — only the lower-half device processes it
+            active = jnp.asarray(True) if not even else (
+                (s != half) | (me < half))
+            idx = jnp.clip(((scores - lo) / (hi - lo) * bins).astype(jnp.int32),
+                           0, bins - 1).ravel()
+            tri = (row_g > col_g) & active  # strictly-lower-triangle pairs
+            oob_m = (scores < lo) | (scores >= hi)
+            for l in range(n_labels):  # static unroll; L is small
+                valid = tri & (rlab[l][:, None] >= 0) & (clab[l][None, :] >= 0)
+                eq = rlab[l][:, None] == clab[l][None, :]
+                lo_h = lo_h.at[0, l, idx].add(
+                    (valid & eq).ravel().astype(jnp.int32))
+                lo_h = lo_h.at[1, l, idx].add(
+                    (valid & ~eq).ravel().astype(jnp.int32))
+                ob_lo = ob_lo.at[l].add(
+                    jnp.sum((valid & oob_m).astype(jnp.int32)))
+            # spill so per-bin/per-label lo never exceeds n_loc^2 + 2^20 < 2^31
+            hi_h = hi_h + (lo_h >> _LO_BITS)
+            lo_h = lo_h & mask_lo
+            ob_hi = ob_hi + (ob_lo >> _LO_BITS)
+            ob_lo = ob_lo & mask_lo
+            block = jax.lax.ppermute(block, axis_name, perm)
+            blab = jax.lax.ppermute(blab, axis_name, perm)
+            return block, blab, lo_h, hi_h, ob_lo, ob_hi
+
+        lo_h = jnp.zeros((2, n_labels, bins), jnp.int32)
+        hi_h = jnp.zeros((2, n_labels, bins), jnp.int32)
+        ob_lo = jnp.zeros(n_labels, jnp.int32)
+        ob_hi = jnp.zeros(n_labels, jnp.int32)
+        # zeros are device-invariant; the loop carry must match the varying
+        # values ppermute/scatter produce (same dance as parallel/ring.py)
+        lo_h, hi_h, ob_lo, ob_hi = (
+            jax.lax.pcast(v, (axis_name,), to="varying")
+            for v in (lo_h, hi_h, ob_lo, ob_hi))
+        carry = jax.lax.fori_loop(0, n_steps, body,
+                                  (local, llab, lo_h, hi_h, ob_lo, ob_hi))
+        lo_h, hi_h, ob_lo, ob_hi = carry[2:]
+        return (jax.lax.psum(lo_h, axis_name), jax.lax.psum(hi_h, axis_name),
+                jax.lax.psum(ob_lo, axis_name), jax.lax.psum(ob_hi, axis_name))
+
+    from jax.sharding import PartitionSpec as P
+
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(P(axis_name, None), P(None, axis_name)),
+                       out_specs=(P(), P(), P(), P()))
+    lo_h, hi_h, ob_lo, ob_hi = fn(jnp.asarray(x), jnp.asarray(label_mat))
+    hist = (np.asarray(lo_h, np.float64)
+            + np.asarray(hi_h, np.float64) * float(1 << _LO_BITS))
+    hist_rel, hist_unrel = hist[0], hist[1]
+    oob = (np.asarray(ob_lo, np.int64)
+           + np.asarray(ob_hi, np.int64) * (1 << _LO_BITS))
+
+    return _finalize_histograms(hist_rel, hist_unrel, oob, lo_req, hi_req,
+                                lo, hi, bins, single, return_histograms)

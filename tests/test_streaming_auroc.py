@@ -141,3 +141,72 @@ def test_multi_label_single_sweep_matches_separate_calls(rng):
     _, hr, hu, edges = streaming_auroc(x, np.stack([labels_a, labels_b]),
                                        block=64, return_histograms=True)
     assert hr.shape[0] == 2 and hu.shape[0] == 2
+
+
+class TestRingStreamingAuroc:
+    """Mesh-distributed sweep must match the single-device path bit-for-bit
+    (same binning, same pair semantics, exact counting via split accumulators)."""
+
+    def _mesh(self):
+        from dae_rnn_news_recommendation_tpu.parallel import get_mesh
+        return get_mesh(8)
+
+    def test_matches_single_device(self, rng):
+        from dae_rnn_news_recommendation_tpu.eval import (
+            ring_streaming_auroc, streaming_auroc)
+
+        x = rng.normal(size=(96, 12)).astype(np.float32)
+        labels = rng.integers(0, 5, 96)
+        want = streaming_auroc(x, labels, bins=512)
+        got = ring_streaming_auroc(x, labels, self._mesh(), bins=512)
+        assert got == pytest.approx(want, abs=0)  # identical histograms
+
+    def test_multi_label_and_histograms(self, rng):
+        from dae_rnn_news_recommendation_tpu.eval import (
+            ring_streaming_auroc, streaming_auroc)
+
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        lab = np.stack([rng.integers(0, 4, 64),
+                        np.where(rng.uniform(size=64) < 0.3, -1,
+                                 rng.integers(0, 3, 64))])
+        want, w_rel, w_unrel, w_edges = streaming_auroc(
+            x, lab, bins=256, return_histograms=True)
+        got, g_rel, g_unrel, g_edges = ring_streaming_auroc(
+            x, lab, self._mesh(), bins=256, return_histograms=True)
+        np.testing.assert_array_equal(g_rel, w_rel)
+        np.testing.assert_array_equal(g_unrel, w_unrel)
+        np.testing.assert_allclose(g_edges, w_edges)
+        assert got == pytest.approx(want, abs=0)
+
+    def test_ragged_rows_padded(self, rng):
+        """N not divisible by the mesh: padded rows must contribute nothing."""
+        from dae_rnn_news_recommendation_tpu.eval import (
+            ring_streaming_auroc, streaming_auroc)
+
+        x = rng.normal(size=(37, 6)).astype(np.float32)
+        labels = rng.integers(0, 3, 37)
+        want = streaming_auroc(x, labels, bins=128)
+        got = ring_streaming_auroc(x, labels, self._mesh(), bins=128)
+        assert got == pytest.approx(want, abs=0)
+
+    def test_out_of_range_raises(self, rng):
+        from dae_rnn_news_recommendation_tpu.eval import ring_streaming_auroc
+
+        x = rng.normal(size=(32, 4)).astype(np.float32) * 10
+        labels = rng.integers(0, 3, 32)
+        with pytest.raises(ValueError, match="value_range"):
+            ring_streaming_auroc(x, labels, self._mesh(),
+                                 metric="linear kernel", value_range=(-1, 1))
+
+    def test_odd_mesh_matches(self, rng):
+        """Odd device count exercises the no-antipodal-split branch of the
+        triangular ring schedule."""
+        from dae_rnn_news_recommendation_tpu.eval import (
+            ring_streaming_auroc, streaming_auroc)
+        from dae_rnn_news_recommendation_tpu.parallel import get_mesh
+
+        x = rng.normal(size=(55, 7)).astype(np.float32)
+        labels = rng.integers(0, 4, 55)
+        want = streaming_auroc(x, labels, bins=128)
+        got = ring_streaming_auroc(x, labels, get_mesh(5), bins=128)
+        assert got == pytest.approx(want, abs=0)
