@@ -212,6 +212,7 @@ class DenoisingAutoencoder:
             self._eval_step = make_eval_step(self.config, loss_fn=self._loss_fn)
             self._batch_multiple = 1
         self._encode_fn = make_encode_fn(self.config)
+        self._sparse_encode_fn = None  # built lazily per config in transform()
 
     def _data_extremes(self, train_set):
         """Global min/max for salt_and_pepper (reference utils.py:131-132 computes them
@@ -407,24 +408,66 @@ class DenoisingAutoencoder:
     def transform(self, data, name="train", save=False, batch_size=4096,
                   from_checkpoint=True):
         """Encode `data` (reference autoencoder.py:479-505). Restores the latest
-        checkpoint by default, matching the reference's restore-per-call semantics."""
+        checkpoint by default, matching the reference's restore-per-call semantics.
+
+        Sparse inputs take the sparse-ingest device stream (ops/sparse_ingest.py):
+        rows cross host->device as padded (uint16 indices, f32 values) — ~50x
+        fewer feed bytes at ~2% density — and x @ W runs as an on-device weighted
+        gather-accumulate. Dense inputs take the dense encode path unchanged."""
         if from_checkpoint or self.params is None:
             self._restore_latest()
         n = data.shape[0]
-        out = np.empty((n, self.n_components), np.float32)
-        for start in range(0, n, batch_size):
-            idx = np.arange(start, min(start + batch_size, n))
-            x = densify_rows(data, idx)
-            pad = batch_size - len(idx)
-            if pad > 0 and start > 0:  # keep a single compiled shape for full batches
-                x = np.concatenate([x, np.zeros((pad, x.shape[1]), np.float32)])
-                out[start:] = np.asarray(self._encode_fn(self.params, jnp.asarray(x)))[: len(idx)]
-            else:
-                out[start:start + len(idx)] = np.asarray(
-                    self._encode_fn(self.params, jnp.asarray(x)))[: len(idx)]
+        if sp.issparse(data):
+            out = self._transform_sparse(data, batch_size)
+        else:
+            out = np.empty((n, self.n_components), np.float32)
+            for start in range(0, n, batch_size):
+                idx = np.arange(start, min(start + batch_size, n))
+                x = densify_rows(data, idx)
+                pad = batch_size - len(idx)
+                if pad > 0 and start > 0:  # keep a single compiled shape for full batches
+                    x = np.concatenate([x, np.zeros((pad, x.shape[1]), np.float32)])
+                    out[start:] = np.asarray(self._encode_fn(self.params, jnp.asarray(x)))[: len(idx)]
+                else:
+                    out[start:start + len(idx)] = np.asarray(
+                        self._encode_fn(self.params, jnp.asarray(x)))[: len(idx)]
         if save:
             np.save(os.path.join(self.data_dir, name), out)
             np.save(os.path.join(self.data_dir, "weights"), np.asarray(self.params["W"]))
+        return out
+
+    def _transform_sparse(self, data, batch_size):
+        """Sparse-ingest encode stream: pad rows to one global K (single compiled
+        shape), dispatch every batch asynchronously, collect at the end — host
+        packing of batch i+1 overlaps the device encode of batch i."""
+        from ..ops.sparse_ingest import pad_csr_batch, sparse_encode
+
+        data = data.tocsr()
+        n = data.shape[0]
+        k = int(np.diff(data.indptr).max(initial=1))
+        if getattr(self, "_sparse_encode_fn", None) is None:
+            config = self.config
+            self._sparse_encode_fn = jax.jit(
+                lambda p, i, v: sparse_encode(p, i, v, config, chunk=512))
+        results, counts = [], []
+        for start in range(0, n, batch_size):
+            stop = min(start + batch_size, n)
+            padded = pad_csr_batch(data[start:stop], k=k)
+            idx, vals = padded["indices"], padded["values"]
+            if stop - start < batch_size and start > 0:
+                # zero-pad the ragged tail: (index 0, value 0) rows encode to 0
+                pad = batch_size - (stop - start)
+                idx = np.concatenate([idx, np.zeros((pad, idx.shape[1]), idx.dtype)])
+                vals = np.concatenate(
+                    [vals, np.zeros((pad, vals.shape[1]), vals.dtype)])
+            results.append(self._sparse_encode_fn(
+                self.params, jnp.asarray(idx), jnp.asarray(vals)))
+            counts.append(stop - start)
+        out = np.empty((n, self.n_components), np.float32)
+        start = 0
+        for dev, cnt in zip(results, counts):
+            out[start : start + cnt] = np.asarray(dev)[:cnt]
+            start += cnt
         return out
 
     def _restore_latest(self):
@@ -452,6 +495,7 @@ class DenoisingAutoencoder:
         self.params = init_params(jax.random.PRNGKey(0), self.config)
         self.opt_state = self.optimizer.init(self.params)
         self._encode_fn = make_encode_fn(self.config)
+        self._sparse_encode_fn = None
         path, _ = latest_checkpoint(model_path)
         self.params = load_params(path or model_path, self.params)
         self._loaded_path = model_path  # transform() restores from here, not model_path

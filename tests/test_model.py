@@ -281,3 +281,22 @@ def test_checkpoint_retention(tmp_path, monkeypatch, rng):
 
     assert prune_checkpoints(str(tmp_path / "nonexistent"), 3) == []
     assert prune_checkpoints(model2.model_path, 0) == []
+
+
+def test_transform_sparse_matches_dense_path(workdir):
+    """Sparse inputs take the sparse-ingest device stream; it must produce the
+    same codes as densifying on host and running the dense encode."""
+    m, X, _ = _fit_small(workdir)
+    enc_sparse = m.transform(X)                       # csr -> sparse-ingest path
+    enc_dense = m.transform(np.asarray(X.todense()))  # ndarray -> dense path
+    np.testing.assert_allclose(enc_sparse, enc_dense, rtol=1e-5, atol=1e-6)
+
+    # ragged tail + multi-batch: batch_size smaller than N, N % batch_size != 0
+    enc_batched = m.transform(X, batch_size=17)
+    np.testing.assert_allclose(enc_batched, enc_sparse, rtol=1e-5, atol=1e-6)
+
+    # empty rows encode to exactly zero on both paths (dae_core H(0) == 0)
+    X_holes = X.tolil()
+    X_holes[0] = 0
+    enc_holes = m.transform(X_holes.tocsr())
+    np.testing.assert_array_equal(enc_holes[0], np.zeros(enc_holes.shape[1]))
