@@ -33,8 +33,9 @@ import scipy.sparse as sp
 from ..data.batcher import PaddedBatcher, densify_rows, prefetch
 from ..train.optimizers import make_optimizer
 from ..train.step import loss_and_metrics, make_encode_fn, make_eval_step, make_train_step
-from ..utils.checkpoint import (latest_checkpoint, load_checkpoint, load_params,
-                                prune_checkpoints, save_checkpoint)
+from ..utils.checkpoint import (AsyncCheckpointer, latest_checkpoint,
+                                load_checkpoint, load_params, prune_checkpoints,
+                                save_checkpoint)
 from ..utils.dirs import create_run_directories
 from ..utils.metrics import MetricsWriter
 from ..utils.provenance import write_parameter_file
@@ -343,7 +344,7 @@ class DenoisingAutoencoder:
             else:
                 ran_validation = False
             if self.checkpoint_every and epoch % self.checkpoint_every == 0:
-                self._save(epoch)
+                self._save(epoch, blocking=False)
 
         # reference quirk kept: one final validation if the last epoch missed the cadence
         if self.num_epochs != 0 and not ran_validation:
@@ -398,9 +399,19 @@ class DenoisingAutoencoder:
                 print(f"Triplet={means.get('triplet_loss', float('nan')):.4f}\t", end="")
             print()
 
-    def _save(self, epoch):
+    def _save(self, epoch, blocking=True):
+        """Mid-run saves (blocking=False) hand the host copy to a background
+        writer so disk IO overlaps the next epochs; the end-of-fit save and any
+        restore wait for in-flight writes first."""
         state = {"params": self.params, "opt_state": self.opt_state,
                  "epoch": np.asarray(epoch)}
+        if getattr(self, "_async_ckpt", None) is None:
+            self._async_ckpt = AsyncCheckpointer()
+        if not blocking:
+            self._async_ckpt.save(self.model_path, state, epoch,
+                                  keep=self.keep_checkpoint_max)
+            return
+        self._async_ckpt.wait()
         save_checkpoint(self.model_path, state, epoch)
         if self.keep_checkpoint_max:
             prune_checkpoints(self.model_path, self.keep_checkpoint_max)
@@ -471,6 +482,8 @@ class DenoisingAutoencoder:
         return out
 
     def _restore_latest(self):
+        if getattr(self, "_async_ckpt", None) is not None:
+            self._async_ckpt.wait()  # an in-flight mid-run save must be durable
         # honor an explicit load_model() path over this run's model_path
         root = getattr(self, "_loaded_path", None) or self.model_path
         path, step = latest_checkpoint(root)

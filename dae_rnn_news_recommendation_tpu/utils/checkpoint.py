@@ -47,6 +47,41 @@ def save_checkpoint(ckpt_dir, state, step, use_orbax=True):
     return base
 
 
+class AsyncCheckpointer:
+    """Background-thread checkpoint writer for mid-run saves: the train loop
+    pays only for the device->host copy; serialization and disk IO overlap the
+    following epochs. One save in flight at a time (a new save waits for the
+    previous one), so ordering is preserved and host memory stays bounded at
+    one extra state copy. Call `wait()` before restoring or at end of fit."""
+
+    def __init__(self):
+        self._future = None
+        self._executor = None
+
+    def save(self, ckpt_dir, state, step, use_orbax=True, keep=0):
+        import concurrent.futures
+
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt")
+        host_state = jax.tree_util.tree_map(np.asarray, state)
+        self.wait()
+
+        def work():
+            save_checkpoint(ckpt_dir, host_state, step, use_orbax=use_orbax)
+            if keep:
+                prune_checkpoints(ckpt_dir, keep)
+
+        self._future = self._executor.submit(work)
+
+    def wait(self):
+        """Block until the in-flight save (if any) is durable; re-raises its
+        exception."""
+        if self._future is not None:
+            f, self._future = self._future, None
+            f.result()
+
+
 def latest_checkpoint(ckpt_dir):
     """(path, step) of the newest checkpoint under ckpt_dir, or (None, -1)."""
     if not os.path.isdir(ckpt_dir):

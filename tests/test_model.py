@@ -300,3 +300,23 @@ def test_transform_sparse_matches_dense_path(workdir):
     X_holes[0] = 0
     enc_holes = m.transform(X_holes.tocsr())
     np.testing.assert_array_equal(enc_holes[0], np.zeros(enc_holes.shape[1]))
+
+
+def test_async_mid_run_checkpoints(workdir):
+    """checkpoint_every saves run on a background writer; all checkpoints must
+    be durable by the end of fit and the newest must restore exactly."""
+    m, X, labels = _fit_small(workdir, checkpoint_every=1, num_epochs=4)
+    steps = sorted(int(n.split("_")[1]) for n in os.listdir(m.model_path)
+                   if n.startswith("step_"))
+    assert steps == [1, 2, 3, 4]  # 3 async mid-run + 1 blocking final
+    # transform restores from the latest checkpoint (waits for in-flight writes)
+    enc = m.transform(X)
+    assert np.isfinite(enc).all()
+    # and the saved state resumes exactly (epoch recorded in aux)
+    from dae_rnn_news_recommendation_tpu.utils.checkpoint import (
+        latest_checkpoint, load_checkpoint)
+    path, step = latest_checkpoint(m.model_path)
+    state = load_checkpoint(path, {"params": m.params, "opt_state": m.opt_state})
+    assert state["epoch"] == 4
+    np.testing.assert_array_equal(np.asarray(state["params"]["W"]),
+                                  np.asarray(m.params["W"]))
