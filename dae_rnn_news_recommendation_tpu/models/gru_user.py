@@ -202,6 +202,25 @@ class GRUUserModel:
                 print(f"epoch {epoch+1}: loss={float(last):.4f}")
         return self
 
+    def save(self, path):
+        """Persist the trained cell (npz: gate arrays + geometry)."""
+        assert self.params is not None, "nothing to save: call fit() first"
+        np.savez(path, __d_embed=np.asarray(self.d_embed),
+                 __d_hidden=np.asarray(self.d_hidden),
+                 **{k: np.asarray(v) for k, v in self.params.items()})
+        return path
+
+    @classmethod
+    def load(cls, path, **kwargs):
+        """Rebuild a model saved by save(); extra kwargs go to the constructor
+        (training hyperparameters are not needed for inference)."""
+        data = np.load(path)
+        model = cls(int(data["__d_embed"]), d_hidden=int(data["__d_hidden"]),
+                    **kwargs)
+        model.params = {k: jnp.asarray(data[k]) for k in data.files
+                        if not k.startswith("__")}
+        return model
+
     def user_state(self, seq, mask=None):
         """Final user state for each sequence: [N, H]."""
         _, final = self._apply(self.params, jnp.asarray(seq),

@@ -47,6 +47,46 @@ class StackedDenoisingAutoencoder:
         self.params = []
         self.fit_representation_ = None
 
+    _META_KEYS = ("layer_sizes", "enc_act_func", "dec_act_func", "loss_func",
+                  "corr_type", "corr_frac", "opt", "learning_rate", "momentum",
+                  "num_epochs", "batch_size", "seed", "verbose", "compute_dtype")
+
+    def save(self, path):
+        """Persist the pretrained/fine-tuned stack (npz: per-layer arrays +
+        json'd constructor args + input width, so load() rebuilds the configs)."""
+        import json
+
+        assert self.params, "nothing to save: call fit() first"
+        arrays = {
+            f"layer{i}_{k}": np.asarray(v)
+            for i, p in enumerate(self.params) for k, v in p.items()
+        }
+        meta = {k: getattr(self, k) for k in self._META_KEYS}
+        meta["n_features"] = int(self.configs[0].n_features)
+        np.savez(path, __meta=np.asarray(json.dumps(meta)), **arrays)
+        return path
+
+    @classmethod
+    def load(cls, path):
+        """Rebuild a stack saved by save(): same configs, same weights."""
+        import json
+
+        data = np.load(path)
+        meta = json.loads(str(data["__meta"]))
+        n_features = meta.pop("n_features")
+        model = cls(**meta)
+        n_in = n_features
+        model.configs, model.params = [], []
+        for li, n_out in enumerate(model.layer_sizes):
+            model.configs.append(model._layer_config(n_in, n_out, first=(li == 0)))
+            prefix = f"layer{li}_"
+            model.params.append({
+                k[len(prefix):]: jnp.asarray(data[k])
+                for k in data.files if k.startswith(prefix)
+            })
+            n_in = n_out
+        return model
+
     def _layer_config(self, n_in, n_out, first):
         return DAEConfig(
             n_features=int(n_in), n_components=int(n_out),

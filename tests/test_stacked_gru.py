@@ -191,3 +191,37 @@ def test_gru_fit_with_ragged_mask(rng):
     assert model.params is not None
     states = model.user_state(seq, mask)
     assert np.isfinite(states).all()
+
+
+def test_stacked_save_load_roundtrip(tmp_path, rng):
+    from dae_rnn_news_recommendation_tpu.models import StackedDenoisingAutoencoder
+
+    X = (rng.uniform(size=(48, 20)) < 0.3).astype(np.float32)
+    m = StackedDenoisingAutoencoder([8, 4], num_epochs=2, batch_size=16, seed=3,
+                                    corr_type="none")
+    m.fit(X)
+    path = str(tmp_path / "stack.npz")
+    m.save(path)
+    m2 = StackedDenoisingAutoencoder.load(path)
+    np.testing.assert_allclose(m2.encode(X), m.encode(X), rtol=1e-6, atol=1e-7)
+    assert [c.n_components for c in m2.configs] == [8, 4]
+    # the loaded stack keeps training (fine-tune path intact)
+    m2.fit_finetune(X, num_epochs=1)
+
+
+def test_gru_save_load_roundtrip(tmp_path, rng):
+    from dae_rnn_news_recommendation_tpu.models import GRUUserModel
+
+    d, t, n = 6, 5, 12
+    seq = rng.normal(size=(n, t, d)).astype(np.float32)
+    pos = rng.normal(size=(n, t, d)).astype(np.float32)
+    neg = rng.normal(size=(n, t, d)).astype(np.float32)
+    # d_hidden must equal d_embed for the rank loss (<state, embed> scores)
+    m = GRUUserModel(d, num_epochs=2, batch_size=6, seed=5)
+    m.fit(seq, pos, neg)
+    path = str(tmp_path / "gru.npz")
+    m.save(path)
+    m2 = GRUUserModel.load(path)
+    assert (m2.d_embed, m2.d_hidden) == (6, 6)
+    np.testing.assert_allclose(m2.user_state(seq), m.user_state(seq),
+                               rtol=1e-6, atol=1e-7)
