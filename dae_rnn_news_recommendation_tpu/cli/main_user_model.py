@@ -206,9 +206,8 @@ def main(argv=None):
                "d_embed": int(emb.shape[1])}
     print(json.dumps(metrics))
 
-    gru_dir = models_dir
-    leaves = {k: np.asarray(v) for k, v in gru.params.items()}
-    np.savez(os.path.join(gru_dir, "gru_user_params.npz"), **leaves)
+    # loadable via GRUUserModel.load (geometry embedded in the npz)
+    gru.save(os.path.join(models_dir, "gru_user_params.npz"))
     with open(os.path.join(logs_dir, "user_model_metrics.json"), "w") as f:
         json.dump(metrics, f)
     print(__file__ + ": End")

@@ -62,7 +62,7 @@ class DenoisingAutoencoder:
                  compute_dtype="float32", checkpoint_every=0, val_batch_size=512,
                  n_devices=1, mesh=None, mining_scope="global", results_root="results",
                  use_tensorboard=True, n_components=None, profile=False,
-                 prefetch_depth=2, keep_checkpoint_max=0):
+                 prefetch_depth=2, keep_checkpoint_max=0, sparse_feed=True):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
 
         :param n_components: explicit code size; overrides the compress_factor
@@ -113,6 +113,10 @@ class DenoisingAutoencoder:
         # for checkpoint_every runs (0 = keep all)
         self.prefetch_depth = prefetch_depth
         self.keep_checkpoint_max = keep_checkpoint_max
+        # scipy-sparse train/validation sets feed as (indices, values) and
+        # densify on device (data/batcher.SparseIngestBatcher) — ~50x fewer
+        # host->device bytes at news-corpus density, identical math
+        self.sparse_feed = sparse_feed
 
         assert isinstance(self.verbose_step, int)
         assert self.verbose >= 0
@@ -254,8 +258,9 @@ class DenoisingAutoencoder:
                                    self.use_tensorboard)
         extremes = self._data_extremes(train_set)
         seed = self.seed if self.seed is not None and self.seed >= 0 else None
-        batcher = self._batcher_cls(self.batch_size, shuffle=True, seed=seed,
-                                    mesh_batch_multiple=self._batch_multiple)
+        batcher = self._feed_batcher(train_set)(
+            self.batch_size, shuffle=True, seed=seed,
+            mesh_batch_multiple=self._batch_multiple)
 
         try:
             self._train_loop(train_set, train_set_label, validation_set,
@@ -353,10 +358,21 @@ class DenoisingAutoencoder:
                                  validation_set_label, val_writer)
             self._log_param_histograms(train_writer, last_epoch * n_batches)
 
+    def _feed_batcher(self, data):
+        """The batcher class for `data`: the sparse-ingest feed for scipy-sparse
+        inputs (unless sparse_feed=False), the dense padded feed otherwise."""
+        if (self.sparse_feed and self._batcher_cls is PaddedBatcher
+                and sp.issparse(data)):
+            from ..data.batcher import SparseIngestBatcher
+
+            return SparseIngestBatcher
+        return self._batcher_cls
+
     def _validation_batches(self, validation_set, validation_set_label):
         n = (validation_set["org"] if isinstance(validation_set, dict) else validation_set).shape[0]
         b = min(self.val_batch_size, n)
-        batcher = self._batcher_cls(b, shuffle=False, mesh_batch_multiple=self._batch_multiple)
+        batcher = self._feed_batcher(validation_set)(
+            b, shuffle=False, mesh_batch_multiple=self._batch_multiple)
         labels = validation_set_label if self._needs_labels else None
         return batcher.epoch(validation_set, labels)
 

@@ -25,7 +25,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..train.step import loss_and_metrics
 from .mesh import get_mesh  # noqa: F401  (re-exported for the estimator)
 
-_ROW_MATRICES = ("x", "x_corr", "org", "pos", "neg", "org_corr", "pos_corr", "neg_corr")
+_ROW_MATRICES = ("x", "x_corr", "org", "pos", "neg", "org_corr", "pos_corr",
+                 "neg_corr", "indices", "values")
 _ROW_VECTORS = ("labels", "row_valid")
 
 
@@ -135,10 +136,12 @@ def _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate):
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
-def _clean_feed(batch):
+def _clean_feed(batch, config):
     """Validation feeds the clean set as the 'corrupted' input (reference
-    autoencoder.py:300-304)."""
-    batch = dict(batch)
+    autoencoder.py:300-304). Sparse-ingest batches densify on device first."""
+    from ..train.step import materialize_x
+
+    batch = materialize_x(dict(batch), config)
     if "org" in batch:
         for n in ("org", "pos", "neg"):
             batch[f"{n}_corr"] = batch[n]
@@ -162,7 +165,7 @@ def make_parallel_eval_step(config, mesh, mining_scope="global",
 
         @jax.jit
         def shard_eval(params, batch):
-            batch = _clean_feed(batch)
+            batch = _clean_feed(batch, config)
             specs = {
                 k: (P(data_axis, None) if k in _ROW_MATRICES else
                     (P(data_axis) if k in _ROW_VECTORS else P()))
@@ -178,7 +181,7 @@ def make_parallel_eval_step(config, mesh, mining_scope="global",
         raise ValueError(f"unknown mining_scope: {mining_scope!r}")
 
     def eval_step(params, batch):
-        _, metrics = loss_fn(params, _clean_feed(batch), jax.random.PRNGKey(0),
+        _, metrics = loss_fn(params, _clean_feed(batch, config), jax.random.PRNGKey(0),
                              config)
         return metrics
 

@@ -26,6 +26,20 @@ from ..models import dae_core
 from ..ops import corruption, losses, triplet
 
 
+def materialize_x(batch, config):
+    """Ensure batch['x'] exists: sparse-ingest feeds ship (indices, values)
+    [B, K] and densify ON DEVICE here (inside the jitted step), so the feed
+    crosses host->device at ~nnz cost while the math stays identical."""
+    if "x" in batch or "org" in batch:
+        return batch
+    from ..ops.sparse_ingest import densify_on_device
+
+    batch = dict(batch)
+    batch["x"] = densify_on_device(batch["indices"], batch["values"],
+                                   config.n_features)
+    return batch
+
+
 def _corrupt_batch(key, batch, config):
     x = batch["x"]
     if config.corr_type == "none":
@@ -43,6 +57,7 @@ def _corrupt_batch(key, batch, config):
 def loss_and_metrics(params, batch, key, config):
     """Full training objective (reference _create_cost_function_node,
     autoencoder.py:417-442). Returns (cost, metrics_dict)."""
+    batch = materialize_x(batch, config)
     x = batch["x"]
     row_valid = batch.get("row_valid")
     x_corr = batch.get("x_corr")
@@ -133,7 +148,7 @@ def make_eval_step(config, loss_fn=loss_and_metrics):
 
     def step(params, batch):
         eval_cfg = config
-        batch = dict(batch)
+        batch = materialize_x(dict(batch), config)
         # feed clean data as the "corrupted" input, like the reference
         if "org" in batch:
             for n in ("org", "pos", "neg"):

@@ -203,3 +203,22 @@ def test_parallel_first_import_order():
                                           "JAX_PLATFORMS": "cpu"})
     assert out.returncode == 0, out.stderr[-2000:]
     assert "ok" in out.stdout
+
+
+def test_estimator_with_mesh_shard_scope_sparse_feed(tmp_path, monkeypatch):
+    """mining_scope='shard' + the sparse-ingest feed + chunked validation all
+    compose: (indices, values) batches densify per shard inside shard_map."""
+    monkeypatch.chdir(tmp_path)
+    import scipy.sparse as sp
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+    X = sp.random(64, 32, density=0.3, format="csr", random_state=1, dtype=np.float32)
+    labels = np.random.default_rng(1).integers(0, 4, 64)
+    m = DenoisingAutoencoder(model_name="meshs", compress_factor=8, num_epochs=2,
+                             batch_size=16, verbose=False, seed=3,
+                             triplet_strategy="batch_all", n_devices=8,
+                             mining_scope="shard", verbose_step=1,
+                             use_tensorboard=False)
+    m.fit(X, validation_set=X[:32], train_set_label=labels,
+          validation_set_label=labels[:32])
+    enc = m.transform(X)
+    assert enc.shape == (64, 4) and np.isfinite(enc).all()
