@@ -27,6 +27,7 @@ from .dp import (  # noqa: F401
     param_shardings,
     batch_shardings,
 )
+from .feed import batch_spec, put_replicated, put_sharded_batch  # noqa: F401
 from .ring import ring_pairwise_similarity  # noqa: F401
 from .seq import pipeline_gru_apply  # noqa: F401
 from .pp import pipeline_stack_encode, stack_tower_params  # noqa: F401

@@ -1,0 +1,60 @@
+"""Multi-host batch placement: process-local rows -> one global sharded batch.
+
+The reference's only transport is the in-process feed_dict copy (SURVEY §5.8);
+on a multi-host TPU deployment each process must load ITS OWN slice of the
+batch and hand jit a global jax.Array. These helpers wrap that assembly so the
+parallel train/eval steps (parallel/dp.py) work unchanged from 1 chip to a
+multi-host pod:
+
+  * single process: a plain device_put with the batch's NamedShardings;
+  * multi process: jax.make_array_from_process_local_data stitches each
+    process's local rows into the global row-sharded array (row keys), or the
+    replicated value every process holds (scalars, params, opt state).
+
+Each process passes only its local rows for row-sharded keys — the global
+batch never materializes on any single host.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .dp import _ROW_MATRICES, _ROW_VECTORS
+
+
+def batch_spec(key, data_axis="data", model_axis=None):
+    """PartitionSpec for one batch key (rows over data, features over model)."""
+    if key in _ROW_MATRICES:
+        return P(data_axis, model_axis)
+    if key in _ROW_VECTORS:
+        return P(data_axis)
+    return P()  # scalars (corr_min / corr_max)
+
+
+def put_sharded_batch(local_batch, mesh, data_axis="data", model_axis=None):
+    """Assemble a global on-mesh batch from this process's local rows.
+
+    :param local_batch: dict of host arrays. Under multi-process, row-keyed
+        entries hold only THIS process's rows (global row count = local rows x
+        process_count, rows ordered by process index); scalars hold the same
+        value on every process.
+    :return: dict of global jax.Arrays ready for the parallel train/eval steps.
+    """
+    multi = jax.process_count() > 1
+    out = {}
+    for k, v in local_batch.items():
+        sharding = NamedSharding(mesh, batch_spec(k, data_axis, model_axis))
+        if multi:
+            out[k] = jax.make_array_from_process_local_data(sharding, v)
+        else:
+            out[k] = jax.device_put(v, sharding)
+    return out
+
+
+def put_replicated(tree, mesh):
+    """Replicate a pytree (params / opt state) over the mesh; every process
+    must pass the same host values."""
+    rep = NamedSharding(mesh, P())
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda v: jax.make_array_from_process_local_data(rep, v), tree)
+    return jax.tree_util.tree_map(lambda v: jax.device_put(v, rep), tree)

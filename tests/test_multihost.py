@@ -47,6 +47,45 @@ _WORKER = textwrap.dedent("""
     garr = jax.make_array_from_process_local_data(sharding, local, (4, 3))
     total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(garr)
     assert float(total) == 2 * 3 * 1.0 + 2 * 3 * 2.0, float(total)
+
+    # full distributed train step over the combined mesh: each process feeds its
+    # local rows (parallel/feed.py), global mining must equal the single-device
+    # oracle on the concatenated batch
+    from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+    from dae_rnn_news_recommendation_tpu.parallel import (
+        make_parallel_train_step, put_replicated, put_sharded_batch)
+    from dae_rnn_news_recommendation_tpu.train import make_optimizer
+    from dae_rnn_news_recommendation_tpu.train.step import make_train_step
+
+    b, f, d = 16, 32, 8  # 4 rows per process slice of the global batch
+    config = DAEConfig(n_features=f, n_components=d, enc_act_func="tanh",
+                       dec_act_func="none", loss_func="mean_squared",
+                       corr_type="none", corr_frac=0.0,
+                       triplet_strategy="batch_all", alpha=1.0,
+                       matmul_precision="highest")
+    rng = np.random.default_rng(0)  # same stream on both processes
+    full = {
+        "x": (rng.uniform(size=(b, f)) < 0.3).astype(np.float32),
+        "labels": rng.integers(0, 4, b).astype(np.int32),
+        "row_valid": np.ones(b, np.float32),
+    }
+    params = init_params(jax.random.PRNGKey(0), config)
+    optimizer = make_optimizer("ada_grad", 0.1)
+    opt_state = optimizer.init(params)
+
+    lo, hi = pid * (b // 2), (pid + 1) * (b // 2)  # this process's rows
+    gbatch = put_sharded_batch({k: v[lo:hi] for k, v in full.items()}, mesh)
+    gparams = put_replicated(params, mesh)
+    gopt = put_replicated(jax.tree_util.tree_map(np.asarray, opt_state), mesh)
+
+    step = make_parallel_train_step(config, optimizer, mesh,
+                                    mining_scope="global", donate=False)
+    _, _, metrics = step(gparams, gopt, jax.random.PRNGKey(7), gbatch)
+    dist_cost = float(metrics["cost"])
+
+    single = make_train_step(config, optimizer, donate=False)
+    _, _, m1 = single(params, opt_state, jax.random.PRNGKey(7), full)
+    np.testing.assert_allclose(dist_cost, float(m1["cost"]), rtol=1e-5)
     print("MULTIHOST_OK", pid, flush=True)
 """)
 
