@@ -125,13 +125,15 @@ class SparseIngestBatcher(PaddedBatcher):
     def _prepare(self, data):
         assert sp.issparse(data), "SparseIngestBatcher needs a scipy sparse matrix"
         csr = data.tocsr()
+        if csr.data.dtype != np.float32:
+            csr = csr.astype(np.float32)  # once per epoch, not per batch
         return csr, int(np.diff(csr.indptr).max(initial=1))
 
     def _payload(self, ctx, idx, n_real):
-        from ..ops.sparse_ingest import pad_csr_batch
+        from ..ops.sparse_ingest import pad_csr_rows
 
         csr, k = ctx
-        padded = pad_csr_batch(csr[idx], k=k)
+        padded = pad_csr_rows(csr, idx, k=k)  # native gather+pack, one pass
         values = padded["values"]
         if n_real < len(idx):
             values[n_real:] = 0.0  # padded rows contribute nothing

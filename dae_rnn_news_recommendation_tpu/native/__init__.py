@@ -59,6 +59,13 @@ def _bind(lib):
                        ctypes.c_int64, idxp, f32p, ctypes.c_int]
         fn.restype = None
 
+    for name, idxp in (("pack_csr_gather_u16", u16p),
+                       ("pack_csr_gather_u32", u32p)):
+        fn = getattr(lib, name)
+        fn.argtypes = [i64p, i32p, f32p, i64p, ctypes.c_int64, ctypes.c_int64,
+                       ctypes.c_int64, idxp, f32p, ctypes.c_int]
+        fn.restype = None
+
     lib.densify_csr.argtypes = [i64p, i32p, f32p, ctypes.c_int64,
                                 ctypes.c_int64, f32p, ctypes.c_int]
     lib.densify_csr.restype = None

@@ -44,6 +44,56 @@ void pack_rows(const int64_t* indptr, const int32_t* indices, const float* data,
 }
 
 template <typename OutIdx>
+void pack_gather_rows(const int64_t* indptr, const int32_t* indices,
+                      const float* data, const int64_t* row_ids,
+                      int64_t row_lo, int64_t row_hi, int64_t k, OutIdx pad_index,
+                      OutIdx* out_indices, float* out_values) {
+  for (int64_t i = row_lo; i < row_hi; ++i) {
+    const int64_t r = row_ids[i];
+    const int64_t lo = indptr[r];
+    const int64_t n0 = indptr[r + 1] - lo;
+    const int64_t n = n0 < k ? n0 : k;
+    OutIdx* oi = out_indices + i * k;
+    for (int64_t j = 0; j < n; ++j) oi[j] = static_cast<OutIdx>(indices[lo + j]);
+    for (int64_t j = n; j < k; ++j) oi[j] = pad_index;
+    if (out_values != nullptr) {
+      float* ov = out_values + i * k;
+      if (data != nullptr)
+        std::memcpy(ov, data + lo, sizeof(float) * static_cast<size_t>(n));
+      else
+        for (int64_t j = 0; j < n; ++j) ov[j] = 1.0f;
+      for (int64_t j = n; j < k; ++j) ov[j] = 0.0f;
+    }
+  }
+}
+
+template <typename OutIdx>
+void pack_gather_impl(const int64_t* indptr, const int32_t* indices,
+                      const float* data, const int64_t* row_ids, int64_t n_rows,
+                      int64_t k, int64_t pad_index, OutIdx* out_indices,
+                      float* out_values, int threads) {
+  if (threads <= 1 || n_rows < 4096) {
+    pack_gather_rows<OutIdx>(indptr, indices, data, row_ids, 0, n_rows, k,
+                             static_cast<OutIdx>(pad_index), out_indices,
+                             out_values);
+    return;
+  }
+  std::vector<std::thread> pool;
+  const int64_t per = (n_rows + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    const int64_t lo = t * per;
+    const int64_t hi = std::min<int64_t>(lo + per, n_rows);
+    if (lo >= hi) break;
+    pool.emplace_back([=] {
+      pack_gather_rows<OutIdx>(indptr, indices, data, row_ids, lo, hi, k,
+                               static_cast<OutIdx>(pad_index), out_indices,
+                               out_values);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+template <typename OutIdx>
 void pack_csr_impl(const int64_t* indptr, const int32_t* indices,
                    const float* data, int64_t n_rows, int64_t k,
                    int64_t pad_index, OutIdx* out_indices, float* out_values,
@@ -125,6 +175,25 @@ void pack_csr_u32(const int64_t* indptr, const int32_t* indices,
                   int threads) {
   pack_csr_impl<uint32_t>(indptr, indices, data, n_rows, k, pad_index,
                           out_indices, out_values, threads);
+}
+
+// Gather+pack in one pass: pack rows row_ids[0..n_rows) of the source csr
+// directly into the padded tiles — no intermediate csr slice (the scipy
+// fancy-index the per-batch feed would otherwise pay).
+void pack_csr_gather_u16(const int64_t* indptr, const int32_t* indices,
+                         const float* data, const int64_t* row_ids,
+                         int64_t n_rows, int64_t k, int64_t pad_index,
+                         uint16_t* out_indices, float* out_values, int threads) {
+  pack_gather_impl<uint16_t>(indptr, indices, data, row_ids, n_rows, k,
+                             pad_index, out_indices, out_values, threads);
+}
+
+void pack_csr_gather_u32(const int64_t* indptr, const int32_t* indices,
+                         const float* data, const int64_t* row_ids,
+                         int64_t n_rows, int64_t k, int64_t pad_index,
+                         uint32_t* out_indices, float* out_values, int threads) {
+  pack_gather_impl<uint32_t>(indptr, indices, data, row_ids, n_rows, k,
+                             pad_index, out_indices, out_values, threads);
 }
 
 }  // extern "C"

@@ -80,6 +80,50 @@ def pad_csr_batch(rows, k=None, k_multiple=64, index_dtype=np.uint16, binary=Fal
     return {"indices": indices, "values": values, "k": kk}
 
 
+def pad_csr_rows(csr, row_ids, k, k_multiple=64, index_dtype=np.uint16,
+                 binary=False):
+    """Gather rows `row_ids` of a csr matrix and pack them padded — one native
+    pass, no intermediate csr slice (the scipy fancy-index `csr[row_ids]` costs
+    more than the pack itself at feed rates). Layout contract matches
+    pad_csr_batch exactly; rows longer than the padded K are truncated to their
+    first K entries, so pass a K >= the matrix's max row nnz (the feed computes
+    it once per epoch). Falls back to pad_csr_batch(csr[row_ids]) when the
+    native library is unavailable.
+    """
+    csr = csr.tocsr()
+    b = len(row_ids)
+    f = csr.shape[1]
+    pad_index = f if binary else 0
+    if f + (1 if binary else 0) > np.iinfo(index_dtype).max + 1:
+        index_dtype = np.uint32
+    kk = max(k_multiple, int(np.ceil(int(k) / k_multiple) * k_multiple))
+
+    lib = native.load()
+    if lib is None or index_dtype not in (np.uint16, np.uint32):
+        return pad_csr_batch(csr[row_ids], k=kk, k_multiple=k_multiple,
+                             index_dtype=index_dtype, binary=binary)
+    import ctypes
+
+    indices = np.empty((b, kk), index_dtype)
+    values = None if binary else np.empty((b, kk), np.float32)
+    indptr = np.ascontiguousarray(csr.indptr, np.int64)
+    cols = np.ascontiguousarray(csr.indices, np.int32)
+    data = None if binary else np.ascontiguousarray(csr.data, np.float32)
+    rows64 = np.ascontiguousarray(row_ids, np.int64)
+    ctype = ctypes.c_uint16 if index_dtype == np.uint16 else ctypes.c_uint32
+    pack = (lib.pack_csr_gather_u16 if index_dtype == np.uint16
+            else lib.pack_csr_gather_u32)
+    pack(native.as_ptr(indptr, ctypes.c_int64),
+         native.as_ptr(cols, ctypes.c_int32),
+         None if binary else native.as_ptr(data, ctypes.c_float),
+         native.as_ptr(rows64, ctypes.c_int64),
+         b, kk, pad_index,
+         native.as_ptr(indices, ctype),
+         None if binary else native.as_ptr(values, ctypes.c_float),
+         min(8, max(1, b // 8192)))
+    return {"indices": indices, "values": values, "k": kk}
+
+
 def extend_w_for_binary(w):
     """Append a zero row at index F so binary-mode padding (index F) is a no-op."""
     return jnp.concatenate([w, jnp.zeros((1, w.shape[1]), w.dtype)], axis=0)

@@ -105,3 +105,51 @@ def test_sparse_encode_is_jittable(csr):
     fn = jax.jit(lambda p, i, v: SI.sparse_encode(p, i, v, cfg))
     out = fn(params, jnp.asarray(padded["indices"]), jnp.asarray(padded["values"]))
     assert out.shape == (33, 32)
+
+
+def test_pad_csr_rows_matches_slice_then_pack(rng):
+    """Native gather+pack must equal pad_csr_batch on the scipy row slice,
+    including shuffled/duplicate row ids, value and binary modes."""
+    import scipy.sparse as sp
+
+    from dae_rnn_news_recommendation_tpu.ops.sparse_ingest import (
+        pad_csr_batch, pad_csr_rows)
+
+    m = sp.random(200, 500, density=0.05, format="csr",
+                  random_state=np.random.RandomState(4), dtype=np.float32)
+    ids = rng.integers(0, 200, 64)
+    ids[5] = ids[6]  # duplicates allowed (shuffled epochs can't produce them,
+                     # but the contract is plain gather)
+    k = int(np.diff(m.indptr).max(initial=1))
+
+    got = pad_csr_rows(m, ids, k=k)
+    want = pad_csr_batch(m[ids], k=k)
+    np.testing.assert_array_equal(got["indices"], want["indices"])
+    np.testing.assert_array_equal(got["values"], want["values"])
+    assert got["k"] == want["k"]
+
+    mb = (m > 0).astype(np.float32)
+    got_b = pad_csr_rows(mb, ids, k=k, binary=True)
+    want_b = pad_csr_batch(mb[ids], k=k, binary=True)
+    np.testing.assert_array_equal(got_b["indices"], want_b["indices"])
+    assert got_b["values"] is None
+
+
+def test_pad_csr_rows_float64_input(rng):
+    """tfidf matrices are float64; values must come back float32 and exact."""
+    import scipy.sparse as sp
+
+    from dae_rnn_news_recommendation_tpu.ops.sparse_ingest import pad_csr_rows
+
+    m = sp.random(50, 100, density=0.1, format="csr",
+                  random_state=np.random.RandomState(5), dtype=np.float64)
+    ids = np.arange(50)
+    k = int(np.diff(m.indptr).max(initial=1))
+    got = pad_csr_rows(m, ids, k=k)
+    assert got["values"].dtype == np.float32
+    dense = np.asarray(m.todense(), np.float32)
+    for i in range(50):
+        row = dense[i]
+        nz = np.flatnonzero(row)
+        np.testing.assert_array_equal(got["indices"][i][: len(nz)], nz)
+        np.testing.assert_allclose(got["values"][i][: len(nz)], row[nz])
