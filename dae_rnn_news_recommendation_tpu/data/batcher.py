@@ -206,6 +206,40 @@ class TripletPaddedBatcher(PaddedBatcher):
         return batch
 
 
+class TripletSparseIngestBatcher(TripletPaddedBatcher):
+    """Sparse-ingest feed for {org,pos,neg} csr dicts: each tower ships as
+    ({key}_indices, {key}_values) and densifies on device (train/step.py
+    materialize_x) — the triplet model's feed is 3x the single-input one, so
+    the byte savings triple."""
+
+    def _prepare(self, data):
+        from ..ops.sparse_ingest import pad_csr_batch  # noqa: F401  (dep check)
+
+        ctx = {}
+        for key in ("org", "pos", "neg"):
+            assert sp.issparse(data[key]), (
+                "TripletSparseIngestBatcher needs scipy sparse matrices")
+            csr = data[key].tocsr()
+            if csr.data.dtype != np.float32:
+                csr = csr.astype(np.float32)
+            ctx[key] = (csr, int(np.diff(csr.indptr).max(initial=1)))
+        return ctx
+
+    def _payload(self, ctx, idx, n_real):
+        from ..ops.sparse_ingest import pad_csr_rows
+
+        batch = {}
+        for key in ("org", "pos", "neg"):
+            csr, k = ctx[key]
+            padded = pad_csr_rows(csr, idx, k=k)
+            values = padded["values"]
+            if n_real < len(idx):
+                values[n_real:] = 0.0
+            batch[f"{key}_indices"] = padded["indices"]
+            batch[f"{key}_values"] = values
+        return batch
+
+
 def prefetch(iterator, depth=2):
     """Run `iterator` on a background thread, keeping up to `depth` items ready.
 

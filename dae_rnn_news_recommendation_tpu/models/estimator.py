@@ -361,11 +361,16 @@ class DenoisingAutoencoder:
     def _feed_batcher(self, data):
         """The batcher class for `data`: the sparse-ingest feed for scipy-sparse
         inputs (unless sparse_feed=False), the dense padded feed otherwise."""
-        if (self.sparse_feed and self._batcher_cls is PaddedBatcher
-                and sp.issparse(data)):
-            from ..data.batcher import SparseIngestBatcher
+        if not self.sparse_feed:
+            return self._batcher_cls
+        from ..data.batcher import (SparseIngestBatcher, TripletPaddedBatcher,
+                                    TripletSparseIngestBatcher)
 
+        if self._batcher_cls is PaddedBatcher and sp.issparse(data):
             return SparseIngestBatcher
+        if (self._batcher_cls is TripletPaddedBatcher and isinstance(data, dict)
+                and all(sp.issparse(data[k]) for k in ("org", "pos", "neg"))):
+            return TripletSparseIngestBatcher
         return self._batcher_cls
 
     def _validation_batches(self, validation_set, validation_set_label):

@@ -26,7 +26,8 @@ from ..train.step import loss_and_metrics
 from .mesh import get_mesh  # noqa: F401  (re-exported for the estimator)
 
 _ROW_MATRICES = ("x", "x_corr", "org", "pos", "neg", "org_corr", "pos_corr",
-                 "neg_corr", "indices", "values")
+                 "neg_corr", "indices", "values", "org_indices", "org_values",
+                 "pos_indices", "pos_values", "neg_indices", "neg_values")
 _ROW_VECTORS = ("labels", "row_valid")
 
 

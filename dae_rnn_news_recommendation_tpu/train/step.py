@@ -26,18 +26,31 @@ from ..models import dae_core
 from ..ops import corruption, losses, triplet
 
 
+# dense key -> its sparse-ingest feed keys (single-input and triplet batches)
+_SPARSE_FEED_KEYS = {
+    "x": ("indices", "values"),
+    "org": ("org_indices", "org_values"),
+    "pos": ("pos_indices", "pos_values"),
+    "neg": ("neg_indices", "neg_values"),
+}
+
+
 def materialize_x(batch, config):
-    """Ensure batch['x'] exists: sparse-ingest feeds ship (indices, values)
-    [B, K] and densify ON DEVICE here (inside the jitted step), so the feed
-    crosses host->device at ~nnz cost while the math stays identical."""
-    if "x" in batch or "org" in batch:
-        return batch
+    """Ensure the dense inputs exist: sparse-ingest feeds ship (indices, values)
+    [B, K] pairs and densify ON DEVICE here (inside the jitted step), so the
+    feed crosses host->device at ~nnz cost while the math stays identical.
+    Covers both the single-input ('x') and precomputed-triplet
+    ('org'/'pos'/'neg') batch shapes."""
     from ..ops.sparse_ingest import densify_on_device
 
-    batch = dict(batch)
-    batch["x"] = densify_on_device(batch["indices"], batch["values"],
-                                   config.n_features)
-    return batch
+    out = None
+    for dense_key, (ik, vk) in _SPARSE_FEED_KEYS.items():
+        if dense_key not in batch and ik in batch:
+            if out is None:
+                out = dict(batch)
+            out[dense_key] = densify_on_device(out[ik], out[vk],
+                                               config.n_features)
+    return out if out is not None else batch
 
 
 def _corrupt_batch(key, batch, config):
@@ -99,8 +112,10 @@ def triplet_loss_and_metrics(params, batch, key, config):
     three weight-sharing towers — in JAX simply the same pure fn applied thrice —
     summed reconstruction losses + alpha * softplus margin loss.
 
-    Batch keys: org, pos, neg (clean [B,F] each) + row_valid.
+    Batch keys: org, pos, neg (clean [B,F] each) + row_valid — or their
+    sparse-ingest (indices, values) pairs, densified on device here.
     """
+    batch = materialize_x(batch, config)
     row_valid = batch.get("row_valid")
     keys = jax.random.split(key, 3)
     hs, ys = {}, {}

@@ -229,3 +229,31 @@ class TestSparseIngestBatcher:
         for k in m_sparse.params:
             np.testing.assert_array_equal(np.asarray(m_sparse.params[k]),
                                           np.asarray(m_dense.params[k]), err_msg=k)
+
+    def test_triplet_fit_sparse_feed_matches_dense_feed(self, tmp_path,
+                                                        monkeypatch, rng):
+        """The precomputed-triplet estimator must train bit-identically through
+        the triplet sparse-ingest feed."""
+        import scipy.sparse as sp
+
+        from dae_rnn_news_recommendation_tpu.models import (
+            DenoisingAutoencoderTriplet)
+
+        monkeypatch.chdir(tmp_path)
+        def mat(seed):
+            return sp.random(40, 24, density=0.3, format="csr",
+                             random_state=seed, dtype=np.float64)
+
+        train = {"org": mat(0), "pos": mat(1), "neg": mat(2)}
+        kw = dict(compress_factor=6, num_epochs=3, batch_size=16, opt="ada_grad",
+                  learning_rate=0.1, corr_type="masking", corr_frac=0.3,
+                  verbose=False, seed=11, use_tensorboard=False)
+        m_sparse = DenoisingAutoencoderTriplet(model_name="tsp", **kw)
+        m_sparse.fit(train)
+        m_dense = DenoisingAutoencoderTriplet(model_name="tdn",
+                                              sparse_feed=False, **kw)
+        m_dense.fit(train)
+        for k in m_sparse.params:
+            np.testing.assert_array_equal(np.asarray(m_sparse.params[k]),
+                                          np.asarray(m_dense.params[k]),
+                                          err_msg=k)
