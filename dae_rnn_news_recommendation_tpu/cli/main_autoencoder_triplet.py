@@ -22,8 +22,17 @@ def main(argv=None):
     FLAGS = parse_flags(argv, triplet_mode=True)
     print(__file__ + ": Start")
 
+    mesh = None
+    if FLAGS.model_parallel > 1:
+        from ..parallel import get_mesh_2d
+        assert FLAGS.n_devices % FLAGS.model_parallel == 0, (
+            f"--model_parallel {FLAGS.model_parallel} must divide "
+            f"--n_devices {FLAGS.n_devices}")
+        mesh = get_mesh_2d(FLAGS.n_devices // FLAGS.model_parallel,
+                           FLAGS.model_parallel)
+
     model = DenoisingAutoencoderTriplet(
-        seed=FLAGS.seed, model_name=FLAGS.model_name,
+        mesh=mesh, seed=FLAGS.seed, model_name=FLAGS.model_name,
         compress_factor=FLAGS.compress_factor, enc_act_func=FLAGS.enc_act_func,
         dec_act_func=FLAGS.dec_act_func, xavier_init=FLAGS.xavier_init,
         corr_type=FLAGS.corr_type, corr_frac=FLAGS.corr_frac,

@@ -202,12 +202,21 @@ class DenoisingAutoencoder:
         if self.mesh is not None or self.n_devices > 1:
             from ..parallel.dp import make_parallel_train_step, make_parallel_eval_step, get_mesh
             self.mesh = self.mesh or get_mesh(self.n_devices)
+            # a 2-D mesh with a 'model' axis shards W's feature rows over it
+            # (the max_features=50k layout, get_mesh_2d) — derived, not a flag
+            model_axis = ("model" if self.mesh.shape.get("model", 1) > 1
+                          else None)
+            if model_axis and self.mining_scope == "shard":
+                raise ValueError(
+                    "mining_scope='shard' runs on a 1-D data mesh; use "
+                    "mining_scope='global' with a feature-sharded (2-D) mesh")
             self._train_step = make_parallel_train_step(
                 self.config, self.optimizer, self.mesh,
-                mining_scope=self.mining_scope, loss_fn=self._loss_fn)
+                mining_scope=self.mining_scope, loss_fn=self._loss_fn,
+                model_axis=model_axis)
             self._eval_step = make_parallel_eval_step(
                 self.config, self.mesh, mining_scope=self.mining_scope,
-                loss_fn=self._loss_fn)
+                loss_fn=self._loss_fn, model_axis=model_axis)
             # rows shard over the data axis only — pad batches to that extent
             self._batch_multiple = int(self.mesh.shape.get("data",
                                                            self.mesh.devices.size))

@@ -26,8 +26,11 @@ from ..train.step import loss_and_metrics
 from .mesh import get_mesh  # noqa: F401  (re-exported for the estimator)
 
 _ROW_MATRICES = ("x", "x_corr", "org", "pos", "neg", "org_corr", "pos_corr",
-                 "neg_corr", "indices", "values", "org_indices", "org_values",
-                 "pos_indices", "pos_values", "neg_indices", "neg_values")
+                 "neg_corr")
+# sparse-ingest pairs are [B, K] where K is the padded-nnz axis, NOT the
+# feature axis — they shard over data only, never over a model axis
+_ROW_NNZ = ("indices", "values", "org_indices", "org_values",
+            "pos_indices", "pos_values", "neg_indices", "neg_values")
 _ROW_VECTORS = ("labels", "row_valid")
 
 
@@ -44,17 +47,21 @@ def param_shardings(mesh, model_axis=None):
     }
 
 
+def _key_spec(k, data_axis="data", model_axis=None):
+    """PartitionSpec for one batch key."""
+    if k in _ROW_MATRICES:
+        return P(data_axis, model_axis)
+    if k in _ROW_NNZ:
+        return P(data_axis, None)
+    if k in _ROW_VECTORS:
+        return P(data_axis)
+    return P()  # scalars (corr_min/corr_max)
+
+
 def batch_shardings(mesh, keys, data_axis="data", model_axis=None):
     """Shardings for a batch dict: rows over `data`, features over `model` (if any)."""
-    out = {}
-    for k in keys:
-        if k in _ROW_MATRICES:
-            out[k] = NamedSharding(mesh, P(data_axis, model_axis))
-        elif k in _ROW_VECTORS:
-            out[k] = NamedSharding(mesh, P(data_axis))
-        else:  # scalars (corr_min/corr_max)
-            out[k] = NamedSharding(mesh, P())
-    return out
+    return {k: NamedSharding(mesh, _key_spec(k, data_axis, model_axis))
+            for k in keys}
 
 
 def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
@@ -111,11 +118,7 @@ def _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate):
         return cost, metrics
 
     def _specs(batch):
-        return {
-            k: (P(data_axis, None) if k in _ROW_MATRICES else
-                (P(data_axis) if k in _ROW_VECTORS else P()))
-            for k in batch
-        }
+        return {k: _key_spec(k, data_axis) for k in batch}
 
     def step(params, opt_state, key, batch):
         keys = jax.random.split(key, n_shards)
@@ -167,11 +170,7 @@ def make_parallel_eval_step(config, mesh, mining_scope="global",
         @jax.jit
         def shard_eval(params, batch):
             batch = _clean_feed(batch, config)
-            specs = {
-                k: (P(data_axis, None) if k in _ROW_MATRICES else
-                    (P(data_axis) if k in _ROW_VECTORS else P()))
-                for k in batch
-            }
+            specs = {k: _key_spec(k, data_axis) for k in batch}
             return jax.shard_map(
                 local_metrics, mesh=mesh, in_specs=(P(), specs), out_specs=P(),
             )(params, batch)

@@ -18,16 +18,13 @@ batch never materializes on any single host.
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .dp import _ROW_MATRICES, _ROW_VECTORS
+from .dp import _key_spec
 
 
 def batch_spec(key, data_axis="data", model_axis=None):
-    """PartitionSpec for one batch key (rows over data, features over model)."""
-    if key in _ROW_MATRICES:
-        return P(data_axis, model_axis)
-    if key in _ROW_VECTORS:
-        return P(data_axis)
-    return P()  # scalars (corr_min / corr_max)
+    """PartitionSpec for one batch key (rows over data, features over model;
+    sparse-ingest [B, K] pairs never shard their nnz axis)."""
+    return _key_spec(key, data_axis, model_axis)
 
 
 def put_sharded_batch(local_batch, mesh, data_axis="data", model_axis=None):

@@ -87,6 +87,10 @@ def build_parser(triplet_mode=False):
     p.add_argument("--synthetic", action="store_true", default=False,
                    help="use the built-in synthetic UCI-like corpus")
     p.add_argument("--n_devices", type=int, default=1)
+    p.add_argument("--model_parallel", type=int, default=1,
+                   help="shard W's feature rows over a 'model' mesh axis of "
+                        "this size (the max_features=50k layout); must divide "
+                        "--n_devices, and requires mining_scope=global")
     p.add_argument("--mining_scope", default="global", choices=["global", "shard"])
     p.add_argument("--compute_dtype", default="float32",
                    choices=["float32", "bfloat16"])

@@ -147,3 +147,18 @@ def test_main_autoencoder_from_parquet(workdir):
     import pandas as pd
     back = pd.read_parquet(model.data_dir + "article.snappy.parquet")
     assert back.story.notna().any()
+
+
+def test_main_autoencoder_model_parallel(workdir):
+    """--model_parallel 2 with --n_devices 8 runs the driver on a 2-D
+    (data x model) mesh with W feature-sharded."""
+    from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import main
+
+    model, aurocs = main([
+        "--model_name", "mp", "--synthetic", "--num_epochs", "1",
+        "--train_row", "96", "--validate_row", "32", "--max_features", "256",
+        "--batch_size", "0.5", "--n_devices", "8", "--model_parallel", "2",
+        "--seed", "0",
+    ])
+    assert dict(model.mesh.shape) == {"data": 4, "model": 2}
+    assert any(np.isfinite(v) for v in aurocs.values())

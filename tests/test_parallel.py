@@ -222,3 +222,45 @@ def test_estimator_with_mesh_shard_scope_sparse_feed(tmp_path, monkeypatch):
           validation_set_label=labels[:32])
     enc = m.transform(X)
     assert enc.shape == (64, 4) and np.isfinite(enc).all()
+
+
+def test_estimator_2d_mesh_matches_single_device(tmp_path, monkeypatch):
+    """A 2-D (data x model) mesh through the estimator: W feature-sharded,
+    global mining — fit must match the single-device run to float tolerance,
+    through the sparse-ingest feed."""
+    monkeypatch.chdir(tmp_path)
+    import scipy.sparse as sp
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+    from dae_rnn_news_recommendation_tpu.parallel import get_mesh_2d
+
+    X = sp.random(64, 32, density=0.3, format="csr", random_state=2,
+                  dtype=np.float32)
+    labels = np.random.default_rng(2).integers(0, 4, 64)
+    kw = dict(compress_factor=8, num_epochs=2, batch_size=16, opt="ada_grad",
+              learning_rate=0.1, verbose=False, seed=4,
+              triplet_strategy="batch_all", use_tensorboard=False)
+    m1 = DenoisingAutoencoder(model_name="one", **kw)
+    m1.fit(X, train_set_label=labels)
+    m2 = DenoisingAutoencoder(model_name="two", mesh=get_mesh_2d(4, 2), **kw)
+    m2.fit(X, train_set_label=labels)
+    for k in m1.params:
+        np.testing.assert_allclose(np.asarray(m2.params[k]),
+                                   np.asarray(m1.params[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_estimator_2d_mesh_shard_scope_rejected(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    import scipy.sparse as sp
+    import pytest as _pytest
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+    from dae_rnn_news_recommendation_tpu.parallel import get_mesh_2d
+
+    X = sp.random(32, 16, density=0.3, format="csr", random_state=3,
+                  dtype=np.float32)
+    m = DenoisingAutoencoder(model_name="bad", compress_factor=4, num_epochs=1,
+                             batch_size=8, verbose=False, seed=1,
+                             triplet_strategy="none", mining_scope="shard",
+                             mesh=get_mesh_2d(4, 2), use_tensorboard=False)
+    with _pytest.raises(ValueError, match="1-D data mesh"):
+        m.fit(X)
