@@ -278,7 +278,9 @@ class DenoisingAutoencoder:
         finally:
             train_writer.close()
             val_writer.close()
-        self._save(self._epoch0 + self.num_epochs)
+        # _last_epoch < the requested total iff a graceful stop broke the loop;
+        # saving the true epoch keeps restore_previous_model's schedule exact
+        self._save(getattr(self, "_last_epoch", self._epoch0 + self.num_epochs))
         return self
 
     def _log_param_histograms(self, train_writer, gstep):
@@ -297,12 +299,52 @@ class DenoisingAutoencoder:
         if self.profile:
             jax.profiler.start_trace(os.path.join(self.tf_summary_dir, "profile"))
         try:
-            self._train_loop_inner(train_set, train_set_label, validation_set,
-                                   validation_set_label, batcher, extremes,
-                                   train_writer, val_writer)
+            with self._graceful_stop():
+                self._train_loop_inner(train_set, train_set_label, validation_set,
+                                       validation_set_label, batcher, extremes,
+                                       train_writer, val_writer)
         finally:
             if self.profile:
                 jax.profiler.stop_trace()
+
+    def _graceful_stop(self):
+        """SIGTERM/SIGINT during fit request a graceful stop: the current epoch
+        finishes, a checkpoint is saved (fit's end-of-run save path), and fit
+        returns normally — so a preempted TPU job resumes from the last full
+        epoch with restore_previous_model instead of losing the run. A second
+        signal falls through to the default handler (hard kill still possible).
+        No-op outside the main thread (signals can't be installed there)."""
+        import contextlib
+        import signal
+
+        @contextlib.contextmanager
+        def ctx():
+            self._stop_requested = False
+            installed = []
+
+            def handler(signum, frame):
+                self._stop_requested = True
+                print(f"fit: received signal {signum}; will checkpoint and "
+                      "stop after the current epoch", flush=True)
+                signal.signal(signum, prev[signum])  # second signal: default
+
+            prev = {}
+            try:
+                for sig in (signal.SIGTERM, signal.SIGINT):
+                    try:
+                        prev[sig] = signal.signal(sig, handler)
+                        installed.append(sig)
+                    except ValueError:  # not the main thread
+                        break
+                yield
+            finally:
+                for sig in installed:
+                    try:
+                        signal.signal(sig, prev[sig])
+                    except ValueError:
+                        pass
+
+        return ctx()
 
     def _train_loop_inner(self, train_set, train_set_label, validation_set,
                           validation_set_label, batcher, extremes, train_writer,
@@ -315,6 +357,7 @@ class DenoisingAutoencoder:
             b = int(np.ceil(b / self._batch_multiple) * self._batch_multiple)
         n_batches = int(np.ceil(n_rows / b))
         ran_validation = False
+        self._last_epoch = self._epoch0
         for e in range(self.num_epochs):
             epoch = self._epoch0 + e + 1
             self.train_cost_batch = [], [], []
@@ -359,13 +402,17 @@ class DenoisingAutoencoder:
                 ran_validation = False
             if self.checkpoint_every and epoch % self.checkpoint_every == 0:
                 self._save(epoch, blocking=False)
+            self._last_epoch = epoch
+            if getattr(self, "_stop_requested", False):
+                print(f"fit: stopping early after epoch {epoch} "
+                      "(signal received); checkpointing", flush=True)
+                break
 
         # reference quirk kept: one final validation if the last epoch missed the cadence
         if self.num_epochs != 0 and not ran_validation:
-            last_epoch = self._epoch0 + self.num_epochs
-            self._run_validation(last_epoch, validation_set,
+            self._run_validation(self._last_epoch, validation_set,
                                  validation_set_label, val_writer)
-            self._log_param_histograms(train_writer, last_epoch * n_batches)
+            self._log_param_histograms(train_writer, self._last_epoch * n_batches)
 
     def _feed_batcher(self, data):
         """The batcher class for `data`: the sparse-ingest feed for scipy-sparse

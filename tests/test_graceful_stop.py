@@ -1,0 +1,64 @@
+"""Graceful-interruption fit: SIGTERM mid-run must finish the epoch, save a
+checkpoint with the true epoch, and return normally — so a preempted job
+resumes exactly (SURVEY §5 failure-recovery; the reference loses the whole run,
+autoencoder.py:156 saves only after all epochs)."""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+_SCRIPT = textwrap.dedent("""
+    import os, sys
+    repo = sys.argv[1]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np, scipy.sparse as sp
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+
+    X = sp.random(200, 64, density=0.3, format="csr", random_state=0,
+                  dtype=np.float32)
+    labels = np.random.default_rng(0).integers(0, 5, 200)
+    m = DenoisingAutoencoder(model_name="g", compress_factor=8, num_epochs=500,
+                             batch_size=32, opt="ada_grad", learning_rate=0.1,
+                             verbose=True, verbose_step=1, seed=0,
+                             triplet_strategy="batch_all", use_tensorboard=False)
+    # verbose_step=1 prints a line per epoch -> the parent signals on epoch 2
+    m.fit(X, train_set_label=labels)
+    from dae_rnn_news_recommendation_tpu.utils.checkpoint import (
+        latest_checkpoint)
+    path, step = latest_checkpoint(m.model_path)
+    print("STOPPED_AT", step, flush=True)
+""")
+
+
+def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
+    script = tmp_path / "run.py"
+    script.write_text(_SCRIPT)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    proc = subprocess.Popen([sys.executable, str(script), repo],
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True, cwd=tmp_path, env=env)
+    # wait for a couple of per-epoch lines, then interrupt
+    lines = []
+    for line in proc.stdout:
+        lines.append(line)
+        if line.startswith("At step 2"):
+            proc.send_signal(signal.SIGTERM)
+        if line.startswith("STOPPED_AT"):
+            break
+    out, _ = proc.communicate(timeout=300)
+    lines.append(out or "")
+    joined = "".join(lines)
+    assert proc.returncode == 0, joined[-2000:]
+    stopped = [ln for ln in joined.splitlines() if ln.startswith("STOPPED_AT")]
+    assert stopped, joined[-2000:]
+    step = int(stopped[0].split()[1])
+    assert 2 <= step < 500, joined[-1000:]  # stopped early, checkpoint present
+    assert "stopping early" in joined
