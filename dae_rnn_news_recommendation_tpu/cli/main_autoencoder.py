@@ -53,7 +53,8 @@ def prepare_or_restore_data(model, FLAGS):
     if FLAGS.synthetic:
         n = train_row + validate_row
         article_contents = articles.synthetic_articles(
-            n_articles=max(n, 100), seed=max(FLAGS.seed, 0))
+            n_articles=max(n, 100), vocab_size=FLAGS.synthetic_vocab,
+            seed=max(FLAGS.seed, 0))
     else:
         article_contents = articles.read_articles(path=FLAGS.data_path)
     article_contents = article_contents.sort_index(ascending=False)

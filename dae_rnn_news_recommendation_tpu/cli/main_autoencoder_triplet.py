@@ -48,7 +48,8 @@ def main(argv=None):
 
     if FLAGS.synthetic:
         article_contents = articles.synthetic_articles(
-            n_articles=max(train_row + validate_row, 100), seed=max(FLAGS.seed, 0))
+            n_articles=max(train_row + validate_row, 100),
+            vocab_size=FLAGS.synthetic_vocab, seed=max(FLAGS.seed, 0))
     else:
         article_contents = articles.read_articles(path=FLAGS.data_path)
 

@@ -86,6 +86,10 @@ def build_parser(triplet_mode=False):
                    help="article parquet; --synthetic generates data instead")
     p.add_argument("--synthetic", action="store_true", default=False,
                    help="use the built-in synthetic UCI-like corpus")
+    p.add_argument("--synthetic_vocab", type=int, default=3000,
+                   help="vocabulary size of the synthetic corpus; raise it to "
+                        "reach reference-scale feature counts (the UCI workload "
+                        "is 10k features, main_autoencoder.py:50)")
     p.add_argument("--n_devices", type=int, default=1)
     p.add_argument("--model_parallel", type=int, default=1,
                    help="shard W's feature rows over a 'model' mesh axis of "
