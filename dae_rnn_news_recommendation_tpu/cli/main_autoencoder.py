@@ -224,9 +224,11 @@ def main(argv=None):
             visualize_similarity_from_histograms,
         )
 
+        wanted = [r.strip() for r in FLAGS.eval_reps.split(",") if r.strip()]
         reps = {"tfidf": (X_tfidf, X_tfidf_validate),
                 "binary_count": (X, X_validate),
                 "encoded": (X_encoded, X_encoded_validate)}
+        reps = {k: v for k, v in reps.items() if k in wanted}
         label_kinds = (("label_category_publish_name", "(Category)"),
                        ("label_story", "(Story)"))
         names = {"tfidf": "TFIDF Vectorized",
@@ -267,14 +269,18 @@ def main(argv=None):
         return model, aurocs
 
     print("calculate similarity")
-    sims = {
-        "binary_count": pairwise_similarity(X, metric="cosine"),
-        "binary_count_validate": pairwise_similarity(X_validate, metric="cosine"),
-        "tfidf": pairwise_similarity(X_tfidf, metric="linear kernel"),
-        "tfidf_validate": pairwise_similarity(X_tfidf_validate, metric="linear kernel"),
-        "encoded": pairwise_similarity(X_encoded, metric="cosine"),
-        "encoded_validate": pairwise_similarity(X_encoded_validate, metric="cosine"),
+    wanted = [r.strip() for r in FLAGS.eval_reps.split(",") if r.strip()]
+    sim_sources = {
+        "binary_count": (X, X_validate, "cosine"),
+        "tfidf": (X_tfidf, X_tfidf_validate, "linear kernel"),
+        "encoded": (X_encoded, X_encoded_validate, "cosine"),
     }
+    sims = {}
+    for kind, (tr_rep, vl_rep, metric) in sim_sources.items():
+        if kind not in wanted and kind != "binary_count":
+            continue  # binary_count always computed: the NN report needs it
+        sims[kind] = pairwise_similarity(tr_rep, metric=metric)
+        sims[kind + "_validate"] = pairwise_similarity(vl_rep, metric=metric)
     print("calculate similarity done")
 
     print("plot")
@@ -284,6 +290,8 @@ def main(argv=None):
         for kind, name in (("tfidf", "TFIDF Vectorized"),
                            ("binary_count", "Binary Count Vectorized"),
                            ("encoded", "Encoded")):
+            if kind not in wanted:
+                continue
             for split in ("train", "validate"):
                 sim = sims[kind if split == "train" else kind + "_validate"]
                 key = f"similarity_boxplot_{kind}{'_validate' if split=='validate' else ''}{suffix}"
@@ -296,6 +304,8 @@ def main(argv=None):
         print(f"AUROC {k}: {v:.4f}")
 
     n_train = len(labels[("category_publish_name", "train")])
+    if "encoded" not in sims:  # eval_reps excluded it; NN report compares vs it
+        sims["encoded"] = pairwise_similarity(X_encoded, metric="cosine")
     for row in nearest_neighbor_report(article_contents.iloc[:n_train],
                                        sims["encoded"], sims["binary_count"]):
         print(row["article"])

@@ -113,6 +113,11 @@ def build_parser(triplet_mode=False):
                         "streaming path automatically (a full [N, N] float32 "
                         "similarity matrix at this default is ~1.6 GB; six of "
                         "them is the host-memory wall)")
+    p.add_argument("--eval_reps", default="tfidf,binary_count,encoded",
+                   help="comma list of representations to AUROC-evaluate. At "
+                        "very large N the wide sparse reps (tfidf/binary at "
+                        "50k features) cost ~F/D times the encoded sweep — "
+                        "restrict to 'encoded' for scale runs")
     p.add_argument("--sparse_feed", type=int, default=1,
                    help="1 (default): scipy-sparse train/validation sets feed "
                         "the device as (indices, values) pairs and densify "

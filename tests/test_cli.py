@@ -162,3 +162,21 @@ def test_main_autoencoder_model_parallel(workdir):
     ])
     assert dict(model.mesh.shape) == {"data": 4, "model": 2}
     assert any(np.isfinite(v) for v in aurocs.values())
+
+
+def test_main_autoencoder_eval_reps_filter(workdir):
+    """--eval_reps restricts the AUROC sweep (scale runs skip the wide sparse
+    representations); works on both eval branches."""
+    from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import main
+
+    args = ["--synthetic", "--validation", "--num_epochs", "1",
+            "--train_row", "100", "--validate_row", "30", "--max_features", "200",
+            "--batch_size", "0.5", "--seed", "0", "--eval_reps", "encoded"]
+    _, aurocs = main(["--model_name", "er1"] + args)
+    assert set(aurocs) == {
+        "similarity_boxplot_encoded(Category)",
+        "similarity_boxplot_encoded(Story)",
+        "similarity_boxplot_encoded_validate(Category)",
+        "similarity_boxplot_encoded_validate(Story)"}
+    _, aurocs_s = main(["--model_name", "er2"] + args + ["--streaming_eval"])
+    assert set(aurocs_s) == set(aurocs)
