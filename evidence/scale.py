@@ -1,0 +1,123 @@
+"""Scale evidence: BASELINE config 3 — batch_hard mining, max_features=50000,
+100k articles — run end to end and recorded in-repo (SCALE.md + scale.json).
+
+This is the configuration the reference cannot run at all: its eval
+materializes six [N, N] float32 matrices (240 GB at N=100k) and its batch_all
+masks OOM beyond ~1k rows (SURVEY §2.3, §5.7). Here the whole pipeline —
+100k-doc vectorization, batch_hard training (10k-row batches via the
+sparse-ingest feed), encode, and the exact streaming AUROC over all 10^10
+pairs — completes on a single chip.
+
+The wide sparse representations (tfidf/binary at 50k features) are excluded
+from the AUROC sweep via --eval_reps: their pair sweeps cost ~F/D times the
+encoded one (~5e14 FLOPs each), which is not an eval any framework runs at
+this size; the learned embedding is the representation under test.
+
+Reproduce:  JAX_PLATFORMS= python evidence/scale.py   (~30 min single chip)
+"""
+
+import datetime
+import json
+import os
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+SEED = 0
+ARGS = [
+    "--model_name", "evidence_scale", "--synthetic",
+    "--synthetic_vocab", "60000", "--validation",
+    "--num_epochs", "60", "--train_row", "100000", "--validate_row", "5000",
+    "--max_features", "50000", "--batch_size", "0.1",
+    "--opt", "ada_grad", "--learning_rate", "0.5",
+    "--triplet_strategy", "batch_hard", "--alpha", "1.0",
+    "--corr_type", "masking", "--corr_frac", "0.3",
+    "--compute_dtype", "bfloat16", "--eval_reps", "encoded",
+    "--verbose", "--verbose_step", "20", "--seed", str(SEED),
+]
+
+
+def main():
+    t0 = time.time()
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"scale evidence on platform={platform}")
+
+    from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import (
+        main as main_autoencoder)
+
+    scratch = tempfile.mkdtemp(prefix="evidence_scale_")
+    cwd = os.getcwd()
+    os.chdir(scratch)
+    try:
+        _, aurocs = main_autoencoder(ARGS)
+    finally:
+        os.chdir(cwd)
+    wall = time.time() - t0
+
+    checks = {}
+
+    def check(name, ok, detail):
+        checks[name] = {"pass": bool(ok), "detail": detail}
+        print(f"  [{'PASS' if ok else 'FAIL'}] {name}: {detail}")
+
+    enc_vl = aurocs["similarity_boxplot_encoded_validate(Category)"]
+    check("scale_run_completes", True,
+          f"100k x 50k batch_hard pipeline end to end in {wall:.0f}s "
+          "(train + encode + 10^10-pair streaming AUROC)")
+    check("scale_encoded_above_chance", enc_vl > 0.55,
+          f"encoded(Category) validate AUROC {enc_vl:.4f} > 0.55 at 100k rows")
+
+    payload = {
+        "generated": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "platform": platform,
+        "seed": SEED,
+        "wall_seconds": round(wall, 1),
+        "command": ARGS,
+        "aurocs": {k: float(v) for k, v in sorted(aurocs.items())},
+        "checks": checks,
+    }
+    with open(os.path.join(HERE, "scale.json"), "w") as f:
+        json.dump(payload, f, indent=2)
+
+    lines = [
+        "# Scale evidence — BASELINE config 3 (100k articles, 50k features)",
+        "",
+        f"Generated {payload['generated']} on platform `{platform}`, seed "
+        f"{SEED}, **{wall:.0f}s end to end** on one chip.",
+        "",
+        "Reproduce: `JAX_PLATFORMS= python evidence/scale.py`.",
+        "",
+        "Pipeline: 105k synthetic docs -> CountVectorizer (50k features) -> "
+        "DAE with batch_hard mining (10k-row batches, sparse-ingest feed, "
+        "bf16) -> 2500-dim codes -> exact streaming AUROC over all 10^10 "
+        "train pairs + validate pairs (histogram figures included). The "
+        "reference cannot run this configuration: its eval needs six "
+        "[100k, 100k] float32 matrices (240 GB) and its full-set validation "
+        "feed OOMs at ~1k rows under mining.",
+        "",
+        "| metric | value |",
+        "|---|---|",
+    ]
+    for k, v in payload["aurocs"].items():
+        lines.append(f"| {k} | {v:.4f} |")
+    lines += ["", "## Checks", ""]
+    for name, c in checks.items():
+        lines.append(f"- **{'PASS' if c['pass'] else 'FAIL'}** {name}: "
+                     f"{c['detail']}")
+    lines.append("")
+    with open(os.path.join(HERE, "SCALE.md"), "w") as f:
+        f.write("\n".join(lines))
+
+    n_fail = sum(not c["pass"] for c in checks.values())
+    print(f"scale evidence: {len(checks) - n_fail}/{len(checks)} checks passed")
+    return 1 if n_fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
