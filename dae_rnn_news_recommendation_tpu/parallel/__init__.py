@@ -15,9 +15,14 @@ shardings, let XLA insert the collectives over ICI.
   pp.py   — pipeline parallelism: the stacked DAE's equal-width hidden tower,
             one layer per 'stage' device, GPipe microbatch schedule,
             differentiable
-
-(Expert parallelism has no counterpart here: this model family has no MoE layers —
-every parallelism axis the DAE/GRU architecture admits is covered.)
+  ep.py   — expert parallelism: Switch-style mixture-of-denoisers, one expert DAE
+            per device over an 'expert' mesh axis, top-1 routing with static
+            capacity and all_to_all dispatch/return, load-balance aux loss;
+            oracle-tested against the dense all-experts path
+  mining.py — anchor-partitioned GLOBAL triplet mining for shard_map contexts:
+            each device mines its own rows as anchors against the gathered
+            codes (1/P of the batch_all cube per device), psums complete the
+            cross-anchor reductions; exact square-oracle semantics
 """
 
 from .mesh import get_mesh, get_mesh_2d, initialize_multihost  # noqa: F401
@@ -31,3 +36,14 @@ from .feed import batch_spec, put_replicated, put_sharded_batch  # noqa: F401
 from .ring import ring_pairwise_similarity  # noqa: F401
 from .seq import pipeline_gru_apply  # noqa: F401
 from .pp import pipeline_stack_encode, stack_tower_params  # noqa: F401
+from .ep import (  # noqa: F401
+    make_moe_encode_fn,
+    make_moe_train_step,
+    moe_forward_dense,
+    moe_init_params,
+    moe_loss_and_metrics,
+)
+from .mining import (  # noqa: F401
+    sharded_batch_all_triplet_loss,
+    sharded_batch_hard_triplet_loss,
+)

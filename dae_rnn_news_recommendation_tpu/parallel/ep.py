@@ -1,0 +1,352 @@
+"""Expert parallelism: a Switch-style mixture-of-denoisers over an `expert` mesh axis.
+
+Net-new — the reference (single-process TF1) has no parallelism at all (SURVEY §2.1);
+this completes the framework's mesh-axis set (dp/tp/sp/pp/ep). The model is a routed
+ensemble of the paper's modified DAEs (models/dae_core.py semantics per expert:
+H_e = act(x̃ W_e + bh_e) − act(bh_e), tied decode): a linear router picks ONE expert
+per article (top-1, Switch-transformer style), the chosen expert's encode/decode are
+scaled by the router probability so the gate receives gradient, and a load-balance
+auxiliary loss keeps the routing spread.
+
+TPU-native layout (one expert per device, E == mesh axis size):
+
+  - expert weights `W [E, F, D]` are sharded one-per-device along the leading axis —
+    each device holds only its own [F, D] expert (HBM scales with E);
+  - the batch is sharded over the SAME axis (data parallelism rides the expert axis);
+  - routing runs per shard: rows are packed into a [E, capacity, F] dispatch block
+    and exchanged with `lax.all_to_all` over ICI, the local expert runs ONE dense
+    [E*C, F] x [F, D] MXU matmul on its routed rows, and a second all_to_all returns
+    codes/reconstructions to the source shards;
+  - static capacity C = ceil(B_local / E * capacity_factor) keeps every shape
+    XLA-static; overflow rows are dropped from dispatch (standard Switch semantics)
+    and excluded from the loss via the returned `routed` mask.
+
+`moe_forward_dense` is the single-device oracle (computes ALL experts on all rows and
+selects — exact same math when nothing overflows); `tests/test_ep.py` asserts the
+all_to_all path matches it bitwise-close on the virtual 8-device mesh, gradients
+included.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..models import dae_core
+from ..ops import losses, triplet
+from ..ops.initializers import xavier_init
+from ..train.step import materialize_x
+from . import mining
+from .dp import _key_spec
+
+
+def moe_init_params(key, config, n_experts):
+    """Router [F, E] + per-expert DAE params stacked on a leading expert axis."""
+    kg, ke = jax.random.split(key)
+    expert_keys = jax.random.split(ke, n_experts)
+    w = jnp.stack([
+        xavier_init(k, config.n_features, config.n_components, config.xavier_const)
+        for k in expert_keys
+    ])
+    return {
+        "gate": xavier_init(kg, config.n_features, n_experts),
+        "W": w,  # [E, F, D]
+        "bh": jnp.zeros((n_experts, config.n_components), jnp.float32),
+        "bv": jnp.zeros((n_experts, config.n_features), jnp.float32),
+    }
+
+
+def _route(params, x_corr):
+    """Top-1 routing. Returns (expert_id [B], prob [B], probs [B, E])."""
+    logits = x_corr @ params["gate"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    e = jnp.argmax(probs, axis=-1)
+    p = jnp.max(probs, axis=-1)
+    return e, p, probs
+
+
+def _expert_forward(expert_params, x, config):
+    """One expert's DAE pass on its routed rows (dae_core semantics)."""
+    h = dae_core.encode(expert_params, x, config)
+    y = dae_core.decode(expert_params, h, config)
+    return h, y
+
+
+def _aux_loss(probs, one_hot, valid, n_experts):
+    """Switch load-balance loss over the VALID rows: E * sum_e f_e * pbar_e where
+    f_e = fraction of valid rows routed to e, pbar_e = mean router prob over
+    valid rows. Padded rows must not enter the stats — they would bias the
+    router gradient toward whichever expert absorbs all-zero inputs."""
+    n = jnp.maximum(jnp.sum(valid), 1.0)
+    f = jnp.sum(one_hot * valid[:, None], axis=0) / n
+    pbar = jnp.sum(probs * valid[:, None], axis=0) / n
+    return n_experts * jnp.sum(f * pbar)
+
+
+def moe_forward_dense(params, x_corr, config, row_valid=None):
+    """Single-device oracle: run EVERY expert on every row, select the top-1.
+
+    Returns (h [B, D], y [B, F], routed [B] == row_valid, aux scalar). Exactly
+    what the routed path computes when no valid row overflows capacity."""
+    e, p, probs = _route(params, x_corr)
+    n_experts = params["gate"].shape[1]
+    valid = (jnp.ones(x_corr.shape[0], probs.dtype) if row_valid is None
+             else row_valid.astype(probs.dtype))
+
+    def one_expert(wp):
+        return _expert_forward(wp, x_corr, config)
+
+    h_all, y_all = jax.vmap(one_expert)(
+        {"W": params["W"], "bh": params["bh"], "bv": params["bv"]}
+    )  # [E, B, D], [E, B, F]
+    rows = jnp.arange(x_corr.shape[0])
+    h = p[:, None] * h_all[e, rows]
+    y = p[:, None] * y_all[e, rows]
+    one_hot = jax.nn.one_hot(e, n_experts, dtype=probs.dtype)
+    return h, y, valid, _aux_loss(probs, one_hot, valid, n_experts)
+
+
+def capacity(batch_rows, n_experts, capacity_factor):
+    """Static per-(source shard, expert) dispatch capacity."""
+    return max(1, math.ceil(batch_rows / n_experts * capacity_factor))
+
+
+def moe_forward_routed(params, x_corr, config, cap, axis_name="expert",
+                       row_valid=None):
+    """The EP path, called per shard inside shard_map over `axis_name`.
+
+    `params['W']/['bh']/['bv']` carry this device's expert only (leading axis 1);
+    the gate is replicated. x_corr is this shard's [B_local, F] rows. Two
+    all_to_alls move rows to their expert and results back; everything between is
+    one dense MXU matmul per direction on the local expert. Padded rows
+    (row_valid == 0) never dispatch: they consume no capacity, enter no routing
+    statistic, and come back with routed == 0.
+    """
+    n_experts = params["gate"].shape[1]
+    b_local, f = x_corr.shape
+    valid = (jnp.ones(b_local, x_corr.dtype) if row_valid is None
+             else row_valid.astype(x_corr.dtype))
+
+    e, p, probs = _route(params, x_corr)
+    one_hot = jax.nn.one_hot(e, n_experts, dtype=probs.dtype) * valid[:, None]
+    # position of each row within its expert's local queue; rows past `cap` drop.
+    # Padded rows (all-zero one_hot row) are pushed to pos == cap: out of bounds
+    # HIGH so the 'drop'-mode scatter discards them — NOT -1, which would wrap
+    # (negative indices index from the end even under mode='drop') and clobber a
+    # real row's slot. `routed` masks them exactly like capacity drops.
+    pos = (jnp.cumsum(one_hot, axis=0) * one_hot).sum(-1).astype(jnp.int32) - 1
+    pos = jnp.where(valid > 0, pos, cap)
+    routed = (pos < cap).astype(x_corr.dtype)
+
+    # pack [E, C, F]: .at[] 'drop' mode discards overflow rows (pos >= cap)
+    disp = jnp.zeros((n_experts, cap, f), x_corr.dtype)
+    disp = disp.at[e, pos].set(x_corr, mode="drop")
+
+    # exchange: each device ends up with [E, C, F] = its expert's rows from every
+    # source shard; flatten to one dense batch for the local expert
+    recv = jax.lax.all_to_all(disp, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+    local = {"W": params["W"][0], "bh": params["bh"][0], "bv": params["bv"][0]}
+    h_flat, y_flat = _expert_forward(local, recv.reshape(n_experts * cap, f), config)
+
+    # return trip + combine at the source shard; overflow rows read garbage via the
+    # clamped gather and are zeroed by `routed`
+    d = h_flat.shape[-1]
+    h_back = jax.lax.all_to_all(h_flat.reshape(n_experts, cap, d), axis_name,
+                                split_axis=0, concat_axis=0, tiled=True)
+    y_back = jax.lax.all_to_all(y_flat.reshape(n_experts, cap, f), axis_name,
+                                split_axis=0, concat_axis=0, tiled=True)
+    pos_c = jnp.clip(pos, 0, cap - 1)
+    scale = (p * routed)[:, None]
+    h = scale * h_back[e, pos_c]
+    y = scale * y_back[e, pos_c]
+
+    # aux over the GLOBAL batch's VALID rows: psum the ROUTING STATS, not the
+    # per-shard aux — the Switch formula is bilinear in (frac, pbar), so
+    # mean-of-products over shards would differ from the global-batch value the
+    # dense oracle computes
+    n = jnp.maximum(jax.lax.psum(jnp.sum(valid), axis_name), 1.0)
+    frac = jax.lax.psum(jnp.sum(one_hot, axis=0), axis_name) / n
+    pbar = jax.lax.psum(jnp.sum(probs * valid[:, None], axis=0), axis_name) / n
+    aux = n_experts * jnp.sum(frac * pbar)
+    return h, y, routed, aux
+
+
+def _gather_rows(v, axis_name):
+    """all_gather local rows into the global batch, preserving shard order."""
+    return jax.lax.all_gather(v, axis_name, tiled=True)
+
+
+def _global_weighted_mean(per_row, weight, axis_name):
+    """sum(per_row*weight)/sum(weight) over the WHOLE batch: psum numerator and
+    denominator so the reduction matches the single-device weighted_loss exactly
+    (pmean of per-shard means would weight shards, not rows)."""
+    num = jax.lax.psum(jnp.sum(per_row * weight), axis_name)
+    den = jax.lax.psum(jnp.sum(weight), axis_name)
+    return num / jnp.maximum(den, 1e-16)
+
+
+def moe_loss_and_metrics(params, batch, key, config, router_weight=0.01,
+                         cap=None, axis_name=None):
+    """Training objective for the mixture: routed (or oracle) corrupt -> route ->
+    expert encode/decode -> weighted reconstruction + optional triplet mining on
+    the codes + router load-balance term. Rows dropped at capacity are excluded
+    from every loss term via the row mask.
+
+    Mining is GLOBAL-batch in both modes (dp.py's cheap-comms choice: the [B, D]
+    codes and labels are all_gathered over the expert axis; the [B, F]
+    reconstructions never move) — the routed objective is bit-for-bit the dense
+    oracle whenever capacity doesn't drop rows."""
+    from ..train.step import _corrupt_batch
+
+    batch = materialize_x(batch, config)
+    x = batch["x"]
+    row_valid = batch.get("row_valid")
+    x_corr = batch.get("x_corr")
+    if x_corr is None:
+        x_corr = _corrupt_batch(key, batch, config)
+
+    if axis_name is None:
+        h, y, routed, aux = moe_forward_dense(params, x_corr, config,
+                                              row_valid=row_valid)
+    else:
+        h, y, routed, aux = moe_forward_routed(params, x_corr, config, cap,
+                                               axis_name, row_valid=row_valid)
+    # routed <= row_valid by construction (padded rows never dispatch)
+    valid = routed
+    # routed fraction among the REAL rows (padding isn't a drop)
+    if row_valid is None:
+        n_real, n_routed = float(routed.shape[0]), jnp.sum(routed)
+    else:
+        n_real, n_routed = jnp.sum(row_valid), jnp.sum(routed)
+    if axis_name is not None:
+        n_real = jax.lax.psum(n_real, axis_name)
+        n_routed = jax.lax.psum(n_routed, axis_name)
+    routed_fraction = n_routed / jnp.maximum(n_real, 1.0)
+
+    if config.triplet_strategy != "none":
+        if axis_name is None:
+            mine = (triplet.batch_all_triplet_loss
+                    if config.triplet_strategy == "batch_all"
+                    else triplet.batch_hard_triplet_loss)
+            t_loss, data_weight, fraction, num, extras = mine(
+                batch["labels"], h, row_valid=valid)
+            ae_loss = losses.weighted_loss(x, y, config.loss_func,
+                                           weight=data_weight, row_valid=valid)
+        else:
+            # global mining, anchor-partitioned: gather only the small [B, D]
+            # codes + labels; each device mines ITS rows as anchors (1/E of the
+            # batch_all cube) and the cross-anchor sums psum (parallel/mining.py)
+            mine = (mining.sharded_batch_all_triplet_loss
+                    if config.triplet_strategy == "batch_all"
+                    else mining.sharded_batch_hard_triplet_loss)
+            t_loss, data_weight_local, fraction, num, extras = mine(
+                _gather_rows(batch["labels"], axis_name), h,
+                _gather_rows(h, axis_name), axis_name,
+                row_valid=_gather_rows(valid, axis_name))
+            per_row = losses.reconstruction_loss_per_row(x, y, config.loss_func)
+            ae_loss = _global_weighted_mean(per_row, data_weight_local * valid,
+                                            axis_name)
+        cost = ae_loss + config.alpha * t_loss + router_weight * aux
+        metrics = {"cost": cost, "autoencoder_loss": ae_loss,
+                   "triplet_loss": t_loss, "fraction_triplet": fraction,
+                   "num_triplet": num, "router_aux": aux,
+                   "routed_fraction": routed_fraction, **extras}
+    else:
+        if axis_name is None:
+            ae_loss = losses.weighted_loss(x, y, config.loss_func,
+                                           row_valid=valid)
+        else:
+            per_row = losses.reconstruction_loss_per_row(x, y, config.loss_func)
+            ae_loss = _global_weighted_mean(per_row, valid, axis_name)
+        cost = ae_loss + router_weight * aux
+        metrics = {"cost": cost, "autoencoder_loss": ae_loss, "router_aux": aux,
+                   "routed_fraction": routed_fraction}
+    return cost, metrics
+
+
+def make_moe_train_step(config, optimizer, mesh, capacity_factor=2.0,
+                        router_weight=0.01, axis_name="expert", donate=True):
+    """Jitted EP train step over `mesh` (one expert per device along `axis_name`).
+
+    Batch rows are sharded over the expert axis (dp rides the same axis); expert
+    params are sharded one-per-device; the gate is replicated (its gradient
+    transposes to a psum). Returns step(params, opt_state, key, batch)."""
+    n_experts = mesh.shape[axis_name]
+
+    def step(params, opt_state, key, batch):
+        keys = jax.random.split(key, n_experts)
+        # dp.py owns the batch-key taxonomy (row matrices / nnz pairs / row
+        # vectors / replicated scalars); rows shard over the expert axis here
+        b_specs = {k: _key_spec(k, data_axis=axis_name) for k in batch}
+        p_specs = {"gate": P(), "W": P(axis_name), "bh": P(axis_name),
+                   "bv": P(axis_name)}
+        row_key = next((k for k in ("x", "indices", "labels") if k in batch),
+                       None)
+        if row_key is None:
+            raise ValueError(
+                "MoE step supports single-input batches only ('x' or "
+                f"'indices'/'values' [+ 'labels']); got keys {sorted(batch)}. "
+                "Precomputed-triplet (org/pos/neg) batches are not routable — "
+                "use make_parallel_train_step for those.")
+        cap = capacity(batch[row_key].shape[0] // n_experts, n_experts,
+                       capacity_factor)
+
+        def local(p, b, k):
+            cost, metrics = moe_loss_and_metrics(
+                p, b, k[0], config, router_weight=router_weight, cap=cap,
+                axis_name=axis_name)
+            cost = jax.lax.pmean(cost, axis_name)
+            return cost, {m: jax.lax.pmean(v, axis_name)
+                          for m, v in metrics.items()}
+
+        def loss_of(p):
+            return jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(p_specs, b_specs, P(axis_name)),
+                out_specs=(P(), P()),
+            )(p, batch, keys)
+
+        (cost, metrics), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda a, u: a + u, params, updates)
+        return params, opt_state, metrics
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+
+def make_moe_encode_fn(config, mesh=None, capacity_factor=2.0, axis_name="expert"):
+    """Jitted mixture encode (transform analog). With a mesh, runs the routed EP
+    path; without, the dense oracle.
+
+    Returns run(params, x) -> (h [B, D], routed [B]). `routed` marks rows that
+    actually reached an expert: capacity-dropped rows come back as exact-zero
+    codes, and callers must not treat those as real embeddings (the dense path
+    never drops — its mask is all ones)."""
+    if mesh is None:
+        @jax.jit
+        def run(params, x):
+            h, _, routed, _ = moe_forward_dense(params, x, config)
+            return h, routed
+
+        return run
+
+    n_experts = mesh.shape[axis_name]
+    p_specs = {"gate": P(), "W": P(axis_name), "bh": P(axis_name),
+               "bv": P(axis_name)}
+
+    @jax.jit
+    def run(params, x):
+        cap = capacity(x.shape[0] // n_experts, n_experts, capacity_factor)
+
+        def local(p, xs):
+            h, _, routed, _ = moe_forward_routed(p, xs, config, cap, axis_name)
+            return h, routed
+
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(p_specs, P(axis_name)),
+            out_specs=(P(axis_name), P(axis_name)),
+        )(params, x)
+
+    return run

@@ -1,0 +1,154 @@
+"""Anchor-partitioned global triplet mining for shard_map contexts.
+
+`ops/triplet.py` mines a square batch: the batch_all path materializes the full
+[B, B, B] distance/mask cube. Under data- or expert-parallel shard_map with GLOBAL
+mining semantics, naively all_gathering the codes and calling those functions would
+replicate that cube on every device — E-way redundant compute and per-device memory
+cubic in the GLOBAL batch.
+
+These variants partition the work by ANCHOR instead: each device mines only its own
+B_local rows as anchors against the gathered [B, D] codes — a [B_local, B, B] slice
+(batch_all) or [B_local, B] matrix (batch_hard) — and the cross-anchor reductions
+(loss numerator/denominator, per-row participation counts, summary means) complete
+with psums over the mesh axis. The results are EXACTLY the global-batch semantics of
+ops/triplet.py (same arithmetic, associativity aside): `tests/test_sharded_mining.py` asserts
+equality against the square oracle on the virtual 8-device mesh.
+
+Returns mirror ops/triplet.py, except data_weight is returned for the LOCAL rows
+only ([B_local] — which is precisely what the caller's local reconstruction term
+needs; a row's participation as positive/negative on other devices' anchors arrives
+through the psum).
+
+Must be called inside shard_map over `axis_name`, with every shard holding the same
+gathered (labels, codes, row_valid) and its own contiguous row block (the layout
+`jax.lax.all_gather(..., tiled=True)` produces from a row-sharded batch).
+"""
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-16
+
+
+def _anchor_block(b_local, b_global, axis_name):
+    """Global row indices of this shard's anchors (contiguous tiled layout)."""
+    start = jax.lax.axis_index(axis_name) * b_local
+    return start, start + jnp.arange(b_local)
+
+
+def sharded_batch_all_triplet_loss(labels, encode_local, encode, axis_name,
+                                   pos_triplets_only=False, row_valid=None):
+    """Global-batch batch_all mining, this shard computing its anchors only.
+
+    :param labels: [B] gathered labels (identical on every shard)
+    :param encode_local: [B_local, D] this shard's codes
+    :param encode: [B, D] gathered codes (identical on every shard)
+    :param row_valid: [B] gathered validity mask (or None)
+    :return: (loss, data_weight_local [B_local], fraction, num_pos, extras) —
+        scalars are global (identical on every shard).
+    """
+    dtype = encode.dtype
+    b_local, b = encode_local.shape[0], encode.shape[0]
+    start, a_idx = _anchor_block(b_local, b, axis_name)
+    valid = (jnp.ones(b, dtype=bool) if row_valid is None
+             else row_valid.astype(bool))
+    valid_a = jax.lax.dynamic_slice_in_dim(valid, start, b_local)
+    labels_a = jax.lax.dynamic_slice_in_dim(labels, start, b_local)
+
+    dp = jnp.matmul(encode_local, encode.T,
+                    precision=jax.lax.Precision.HIGHEST)  # [B_local, B]
+    dist = -dp[:, :, None] + dp[:, None, :]  # [B_local, B, B]
+
+    # triplet mask, anchor axis sliced (ops/triplet.py:58 semantics)
+    g_idx = jnp.arange(b)
+    a_ne = a_idx[:, None] != g_idx[None, :]             # [B_local, B] a != j
+    p_ne_n = ~jnp.eye(b, dtype=bool)
+    distinct = a_ne[:, :, None] & a_ne[:, None, :] & p_ne_n[None, :, :]
+    label_eq = labels_a[:, None] == labels[None, :]     # [B_local, B]
+    valid_labels = label_eq[:, :, None] & (~label_eq[:, None, :])
+    all_valid = (valid_a[:, None, None] & valid[None, :, None]
+                 & valid[None, None, :])
+    valid_mask = (distinct & valid_labels & all_valid).astype(dtype)
+
+    num_valid = jax.lax.psum(jnp.sum(valid_mask), axis_name)
+    pos_mask = (valid_mask * dist > _EPS).astype(dtype)
+    num_pos = jax.lax.psum(jnp.sum(pos_mask), axis_name)
+
+    if pos_triplets_only:
+        mask, num = pos_mask, num_pos
+    else:
+        mask, num = valid_mask, num_valid
+
+    loss = (jax.lax.psum(jnp.sum(jax.nn.softplus(dist) * mask), axis_name)
+            / jnp.maximum(num, _EPS))
+
+    # participation (ops/triplet.py:111): as anchor (local axis) + as positive
+    # (axis 1 of somebody's slice) + as negative (axis 2) — the cross-anchor
+    # counts psum into [B] vectors, then slice back to local rows
+    as_anchor = jnp.sum(mask, axis=(1, 2))                     # [B_local]
+    as_pos = jax.lax.psum(jnp.sum(mask, axis=(0, 2)), axis_name)   # [B]
+    as_neg = jax.lax.psum(jnp.sum(mask, axis=(0, 1)), axis_name)   # [B]
+    data_weight = as_anchor + jax.lax.dynamic_slice_in_dim(
+        as_pos + as_neg, start, b_local)
+
+    fraction = num_pos / jnp.maximum(num_valid, _EPS)
+    return loss, data_weight, fraction, num_pos, {}
+
+
+def sharded_batch_hard_triplet_loss(labels, encode_local, encode, axis_name,
+                                    row_valid=None):
+    """Global-batch batch_hard mining, this shard's anchors only — [B_local, B]
+    working set. Keeps the reference quirks (zero-masked hardest-neg max,
+    float-equality tie double-count) exactly as ops/triplet.py:119."""
+    dtype = encode.dtype
+    b_local, b = encode_local.shape[0], encode.shape[0]
+    start, a_idx = _anchor_block(b_local, b, axis_name)
+    valid = (jnp.ones(b, dtype=bool) if row_valid is None
+             else row_valid.astype(bool))
+    valid_a = jax.lax.dynamic_slice_in_dim(valid, start, b_local)
+    validf = valid.astype(dtype)
+    validf_a = valid_a.astype(dtype)
+    labels_a = jax.lax.dynamic_slice_in_dim(labels, start, b_local)
+
+    dp = jnp.matmul(encode_local, encode.T,
+                    precision=jax.lax.Precision.HIGHEST)  # [B_local, B]
+
+    g_idx = jnp.arange(b)
+    a_ne = a_idx[:, None] != g_idx[None, :]
+    label_eq = labels_a[:, None] == labels[None, :]
+    both_valid = valid_a[:, None] & valid[None, :]
+    mask_ap = (a_ne & label_eq & both_valid).astype(dtype)
+    mask_an = ((~label_eq) & both_valid).astype(dtype)
+
+    neg_inf = jnp.asarray(-jnp.inf, dtype)
+    max_row = jnp.max(jnp.where(valid[None, :], dp, neg_inf), axis=1,
+                      keepdims=True)
+    max_row = jnp.where(jnp.isfinite(max_row), max_row, jnp.zeros_like(max_row))
+    hardest_pos = jnp.min(dp + max_row * (1.0 - mask_ap), axis=1, keepdims=True)
+    hardest_neg = jnp.max(mask_an * dp, axis=1, keepdims=True)
+
+    dist = jnp.maximum(hardest_neg - hardest_pos, 0.0)     # [B_local, 1]
+    count = (dist > 0.0).astype(dtype) * validf_a[:, None]
+
+    eq_pos = (dp == hardest_pos).astype(dtype) * validf[None, :]
+    eq_neg = (dp == hardest_neg).astype(dtype) * validf[None, :]
+    hit_pos = jax.lax.psum(jnp.sum(count * eq_pos, axis=0), axis_name)  # [B]
+    hit_neg = jax.lax.psum(jnp.sum(count * eq_neg, axis=0), axis_name)  # [B]
+    data_weight = jnp.squeeze(count, axis=1) + jax.lax.dynamic_slice_in_dim(
+        hit_pos + hit_neg, start, b_local)
+
+    total = jax.lax.psum(jnp.sum(count), axis_name)
+    loss = (jax.lax.psum(jnp.sum(jax.nn.softplus(dist) * count), axis_name)
+            / jnp.maximum(total, _EPS))
+    n_rows = jax.lax.psum(jnp.sum(validf_a), axis_name)
+    fraction = total / jnp.maximum(n_rows, 1.0)
+
+    extras = {
+        "hardest_positive_dotproduct":
+            jax.lax.psum(jnp.sum(hardest_pos[:, 0] * validf_a), axis_name)
+            / jnp.maximum(n_rows, 1.0),
+        "hardest_negative_dotproduct":
+            jax.lax.psum(jnp.sum(hardest_neg[:, 0] * validf_a), axis_name)
+            / jnp.maximum(n_rows, 1.0),
+    }
+    return loss, data_weight, fraction, total, extras

@@ -1,0 +1,207 @@
+"""Expert-parallel (mixture-of-denoisers) tests on the virtual 8-device CPU mesh.
+
+The load-bearing assertion, in the repo's oracle style: the all_to_all-routed EP
+path (one expert per device, static capacity, two collectives) must match the dense
+single-device oracle (all experts on all rows, top-1 select) — losses, metrics,
+gradients-after-one-step, and encode outputs — whenever capacity doesn't drop rows.
+Capacity-overflow semantics (Switch-style drops) are tested separately.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu.models import DAEConfig
+from dae_rnn_news_recommendation_tpu.parallel import get_mesh
+from dae_rnn_news_recommendation_tpu.parallel.ep import (
+    capacity,
+    make_moe_encode_fn,
+    make_moe_train_step,
+    moe_forward_dense,
+    moe_init_params,
+    moe_loss_and_metrics,
+)
+from dae_rnn_news_recommendation_tpu.train import make_optimizer
+
+B, F, D, E = 64, 48, 8, 8
+
+
+def _setup(strategy="none", corr_type="none"):
+    cfg = DAEConfig(n_features=F, n_components=D, enc_act_func="tanh",
+                    dec_act_func="none", loss_func="mean_squared",
+                    corr_type=corr_type, corr_frac=0.3,
+                    triplet_strategy=strategy, alpha=1.0,
+                    matmul_precision="highest")
+    params = moe_init_params(jax.random.PRNGKey(0), cfg, E)
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": jnp.asarray((rng.uniform(size=(B, F)) < 0.3).astype(np.float32)),
+        "labels": jnp.asarray(rng.integers(0, 4, B), jnp.int32),
+        "row_valid": jnp.ones(B, jnp.float32),
+    }
+    return cfg, params, batch
+
+
+def test_dense_oracle_shapes_and_aux():
+    """Dense path shapes; aux loss equals the NumPy Switch formula."""
+    cfg, params, batch = _setup()
+    h, y, routed, aux = moe_forward_dense(params, batch["x"], cfg)
+    assert h.shape == (B, D) and y.shape == (B, F)
+    assert np.all(np.asarray(routed) == 1.0)
+
+    x = np.asarray(batch["x"])
+    logits = x @ np.asarray(params["gate"])
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    e = p.argmax(-1)
+    f = np.bincount(e, minlength=E) / B
+    np.testing.assert_allclose(float(aux), E * float((f * p.mean(0)).sum()),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("strategy", ["none", "batch_all", "batch_hard"])
+def test_routed_matches_dense_oracle(strategy):
+    """EP train step over 8 devices == dense single-device oracle step when
+    capacity is ample (capacity_factor = E guarantees zero drops)."""
+    cfg, params, batch = _setup(strategy)
+    optimizer = make_optimizer("ada_grad", 0.1)
+    opt_state = optimizer.init(params)
+
+    # dense oracle: plain jit step on the unsharded mixture
+    def oracle_step(p, o, key, b):
+        (cost, metrics), grads = jax.value_and_grad(
+            moe_loss_and_metrics, has_aux=True)(p, b, key, cfg)
+        updates, o = optimizer.update(grads, o, p)
+        return jax.tree_util.tree_map(lambda a, u: a + u, p, updates), o, metrics
+
+    p1, _, m1 = jax.jit(oracle_step)(params, opt_state, jax.random.PRNGKey(7),
+                                     batch)
+
+    mesh = get_mesh(E, axis_name="expert")
+    step = make_moe_train_step(cfg, optimizer, mesh, capacity_factor=float(E),
+                               donate=False)
+    p8, _, m8 = step(params, opt_state, jax.random.PRNGKey(7), batch)
+
+    assert float(m8["routed_fraction"]) == 1.0
+    np.testing.assert_allclose(float(m8["cost"]), float(m1["cost"]), rtol=1e-5)
+    np.testing.assert_allclose(float(m8["router_aux"]), float(m1["router_aux"]),
+                               rtol=1e-5)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p8[k]), np.asarray(p1[k]),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_routed_encode_matches_dense():
+    cfg, params, batch = _setup()
+    mesh = get_mesh(E, axis_name="expert")
+    h_dense, r_dense = make_moe_encode_fn(cfg)(params, batch["x"])
+    h_ep, r_ep = make_moe_encode_fn(cfg, mesh, capacity_factor=float(E))(
+        params, batch["x"])
+    assert np.all(np.asarray(r_dense) == 1.0) and np.all(np.asarray(r_ep) == 1.0)
+    np.testing.assert_allclose(np.asarray(h_ep), np.asarray(h_dense),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_routed_encode_reports_drops():
+    """Capacity-dropped rows must surface in the returned mask, and their codes
+    must be exact zeros (never mistaken for real embeddings)."""
+    cfg, params, batch = _setup()
+    mesh = get_mesh(E, axis_name="expert")
+    h, routed = make_moe_encode_fn(cfg, mesh, capacity_factor=0.25)(
+        params, batch["x"])
+    routed = np.asarray(routed)
+    assert 0.0 < routed.mean() < 1.0
+    np.testing.assert_array_equal(np.asarray(h)[routed == 0.0], 0.0)
+
+
+def test_capacity_overflow_drops_rows():
+    """With capacity_factor < 1 some rows must drop: routed_fraction < 1, the
+    loss stays finite, and training still updates parameters."""
+    cfg, params, batch = _setup()
+    optimizer = make_optimizer("gradient_descent", 0.1)
+    opt_state = optimizer.init(params)
+    mesh = get_mesh(E, axis_name="expert")
+    step = make_moe_train_step(cfg, optimizer, mesh, capacity_factor=0.25,
+                               donate=False)
+    p8, _, m8 = step(params, opt_state, jax.random.PRNGKey(3), batch)
+    assert 0.0 < float(m8["routed_fraction"]) < 1.0
+    assert np.isfinite(float(m8["cost"]))
+    assert not np.allclose(np.asarray(p8["gate"]), np.asarray(params["gate"]))
+
+
+@pytest.mark.parametrize("strategy", ["none", "batch_all"])
+def test_padded_rows_never_route(strategy):
+    """Padded rows (row_valid=0) must not consume dispatch capacity, must not
+    enter the aux-loss routing stats, and the routed path must still equal the
+    dense oracle on the real rows."""
+    cfg, params, batch = _setup(strategy)
+    batch = dict(batch, row_valid=jnp.asarray(
+        (np.arange(B) < B - 24).astype(np.float32)))
+    optimizer = make_optimizer("ada_grad", 0.1)
+    opt_state = optimizer.init(params)
+
+    def oracle_step(p, o, key, b):
+        (cost, metrics), grads = jax.value_and_grad(
+            moe_loss_and_metrics, has_aux=True)(p, b, key, cfg)
+        updates, o = optimizer.update(grads, o, p)
+        return jax.tree_util.tree_map(lambda a, u: a + u, p, updates), o, metrics
+
+    p1, _, m1 = jax.jit(oracle_step)(params, opt_state, jax.random.PRNGKey(7),
+                                     batch)
+    mesh = get_mesh(E, axis_name="expert")
+    step = make_moe_train_step(cfg, optimizer, mesh, capacity_factor=float(E),
+                               donate=False)
+    p8, _, m8 = step(params, opt_state, jax.random.PRNGKey(7), batch)
+
+    # every REAL row routes; fraction is relative to real rows, not batch slots
+    assert float(m1["routed_fraction"]) == 1.0
+    assert float(m8["routed_fraction"]) == 1.0
+    np.testing.assert_allclose(float(m8["cost"]), float(m1["cost"]), rtol=1e-5)
+    np.testing.assert_allclose(float(m8["router_aux"]), float(m1["router_aux"]),
+                               rtol=1e-5)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p8[k]), np.asarray(p1[k]),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_padded_rows_cannot_evict_real_rows():
+    """With capacity exactly fitting the real rows, adding padding must not
+    displace any real row's dispatch slot (the -1-wraparound hazard)."""
+    cfg, params, batch = _setup()
+    valid = np.ones(B, np.float32)
+    valid[::2] = 0.0  # padding interleaved BEFORE real rows in shard order
+    batch = dict(batch, row_valid=jnp.asarray(valid))
+    mesh = get_mesh(E, axis_name="expert")
+    optimizer = make_optimizer("gradient_descent", 0.1)
+    step = make_moe_train_step(cfg, optimizer, mesh, capacity_factor=float(E),
+                               donate=False)
+    _, _, m = step(params, optimizer.init(params), jax.random.PRNGKey(5), batch)
+    assert float(m["routed_fraction"]) == 1.0  # all real rows kept
+
+
+def test_gate_receives_gradient():
+    """The router must train: scaling expert outputs by the top-1 probability
+    routes gradient through the (otherwise non-differentiable) argmax."""
+    cfg, params, batch = _setup()
+    grads = jax.grad(lambda p: moe_loss_and_metrics(
+        p, batch, jax.random.PRNGKey(0), cfg)[0])(params)
+    assert float(jnp.abs(grads["gate"]).max()) > 0.0
+
+
+def test_corruption_inside_moe_step():
+    """Masking corruption composes with routing (per-shard keys, finite loss)."""
+    cfg, params, batch = _setup(corr_type="masking")
+    optimizer = make_optimizer("ada_grad", 0.1)
+    opt_state = optimizer.init(params)
+    mesh = get_mesh(E, axis_name="expert")
+    step = make_moe_train_step(cfg, optimizer, mesh, capacity_factor=float(E),
+                               donate=False)
+    _, _, m = step(params, opt_state, jax.random.PRNGKey(11), batch)
+    assert np.isfinite(float(m["cost"]))
+
+
+def test_capacity_formula():
+    assert capacity(8, 8, 2.0) == 2
+    assert capacity(64, 8, 1.0) == 8
+    assert capacity(3, 8, 1.0) == 1
