@@ -137,7 +137,15 @@ def main(argv=None):
         mesh = get_mesh_2d(FLAGS.n_devices // FLAGS.model_parallel,
                            FLAGS.model_parallel)
 
-    model = DenoisingAutoencoder(
+    model_cls, extra_kwargs = DenoisingAutoencoder, {}
+    if FLAGS.n_experts > 1:
+        from ..models import MoEDenoisingAutoencoder
+
+        model_cls = MoEDenoisingAutoencoder
+        extra_kwargs = {"n_experts": FLAGS.n_experts}
+
+    model = model_cls(
+        **extra_kwargs,
         mesh=mesh, seed=FLAGS.seed, model_name=FLAGS.model_name,
         compress_factor=FLAGS.compress_factor, enc_act_func=FLAGS.enc_act_func,
         dec_act_func=FLAGS.dec_act_func, xavier_init=FLAGS.xavier_init,

@@ -16,6 +16,7 @@ _LAZY = {
     "DenoisingAutoencoder": "estimator",
     "DenoisingAutoencoderTriplet": "estimator_triplet",
     "StackedDenoisingAutoencoder": "stacked",
+    "MoEDenoisingAutoencoder": "estimator_moe",
 }
 
 # __all__ lists only the eager names: a star-import must not trigger __getattr__,

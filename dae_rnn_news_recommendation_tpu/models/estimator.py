@@ -504,24 +504,28 @@ class DenoisingAutoencoder:
         gather-accumulate. Dense inputs take the dense encode path unchanged."""
         if from_checkpoint or self.params is None:
             self._restore_latest()
-        n = data.shape[0]
         if sp.issparse(data):
             out = self._transform_sparse(data, batch_size)
         else:
-            out = np.empty((n, self.n_components), np.float32)
-            for start in range(0, n, batch_size):
-                idx = np.arange(start, min(start + batch_size, n))
-                x = densify_rows(data, idx)
-                pad = batch_size - len(idx)
-                if pad > 0 and start > 0:  # keep a single compiled shape for full batches
-                    x = np.concatenate([x, np.zeros((pad, x.shape[1]), np.float32)])
-                    out[start:] = np.asarray(self._encode_fn(self.params, jnp.asarray(x)))[: len(idx)]
-                else:
-                    out[start:start + len(idx)] = np.asarray(
-                        self._encode_fn(self.params, jnp.asarray(x)))[: len(idx)]
+            out = self._dense_encode_loop(data, batch_size)
         if save:
             np.save(os.path.join(self.data_dir, name), out)
             np.save(os.path.join(self.data_dir, "weights"), np.asarray(self.params["W"]))
+        return out
+
+    def _dense_encode_loop(self, data, batch_size):
+        """Batched dense encode with a tail pad that keeps a single compiled
+        shape for full batches (dense ndarray or row-sliceable sparse input)."""
+        n = data.shape[0]
+        out = np.empty((n, self.n_components), np.float32)
+        for start in range(0, n, batch_size):
+            idx = np.arange(start, min(start + batch_size, n))
+            x = densify_rows(data, idx)
+            pad = batch_size - len(idx)
+            if pad > 0 and start > 0:
+                x = np.concatenate([x, np.zeros((pad, x.shape[1]), np.float32)])
+            out[start:start + len(idx)] = np.asarray(
+                self._encode_fn(self.params, jnp.asarray(x)))[: len(idx)]
         return out
 
     def _transform_sparse(self, data, batch_size):

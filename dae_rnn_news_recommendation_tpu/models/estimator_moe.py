@@ -1,0 +1,183 @@
+"""sklearn-style estimator for the expert-parallel mixture-of-denoisers.
+
+Net-new model family (no reference counterpart — the reference implements a single
+DAE, autoencoder/autoencoder.py): a Switch-style top-1-routed ensemble of the
+paper's modified DAEs (parallel/ep.py). Same estimator surface as
+`DenoisingAutoencoder` (ctor / fit / transform / load_model /
+get_model_parameters / get_weights_as_images) so the drivers and eval tail work
+unchanged; `cli/main_autoencoder.py --n_experts E` selects it.
+
+Device story:
+  - single device (n_devices=1): the dense mixture — every expert runs on every
+    row, top-1 selected. Exact, no capacity drops; fine while E·F·D params fit
+    one HBM.
+  - expert-parallel (n_devices=E>1): one expert per device over an `expert` mesh
+    axis, all_to_all dispatch with static capacity (parallel/ep.py). Training
+    may drop overflow rows (Switch semantics, excluded from the loss);
+    validation and transform use the dense path, which never drops.
+"""
+
+import functools
+import os
+
+import jax
+import numpy as np
+import scipy.sparse as sp
+
+from ..parallel.ep import (make_moe_train_step, moe_forward_dense,
+                           moe_init_params, moe_loss_and_metrics)
+from ..train.optimizers import make_optimizer
+from ..train.step import make_eval_step, make_train_step
+from ..utils.checkpoint import latest_checkpoint, load_checkpoint
+from .estimator import DenoisingAutoencoder
+
+
+class MoEDenoisingAutoencoder(DenoisingAutoencoder):
+    """Mixture-of-denoisers with online triplet mining; sklearn-like interface."""
+
+    def __init__(self, algo_name="moe_dae", n_experts=4, capacity_factor=2.0,
+                 router_weight=0.01, **kwargs):
+        """:param n_experts: number of expert DAEs (== n_devices when
+            expert-parallel; any value on a single device)
+        :param capacity_factor: static dispatch capacity multiplier (routed path
+            only); rows past ceil(B_local/E * cf) drop from the training loss
+        :param router_weight: weight of the Switch load-balance auxiliary loss
+        Everything else: see DenoisingAutoencoder."""
+        super().__init__(algo_name=algo_name, **kwargs)
+        assert int(n_experts) >= 1
+        self.n_experts = int(n_experts)
+        self.capacity_factor = float(capacity_factor)
+        self.router_weight = float(router_weight)
+        # the estimator machinery (dense train step, eval step) runs the mixture
+        # through the standard loss_fn hook
+        self._loss_fn = functools.partial(moe_loss_and_metrics,
+                                          router_weight=self.router_weight)
+
+    def _parameter_dict(self):
+        d = super()._parameter_dict()
+        d.update({"n_experts": self.n_experts,
+                  "capacity_factor": self.capacity_factor,
+                  "router_weight": self.router_weight})
+        return d
+
+    def _build(self, n_features, restore_previous_model):
+        self.config = self._make_config(n_features)
+        self.optimizer = make_optimizer(self.opt, self.learning_rate, self.momentum)
+        key = self._root_key()
+        self._key, init_key = jax.random.split(key)
+        self.params = moe_init_params(init_key, self.config, self.n_experts)
+        self.opt_state = self.optimizer.init(self.params)
+        self._epoch0 = 0
+
+        if restore_previous_model:
+            path, step = latest_checkpoint(self.model_path)
+            if path is None:
+                raise FileNotFoundError(
+                    f"restore_previous_model=True but no checkpoint under "
+                    f"{self.model_path}")
+            state = load_checkpoint(path, {"params": self.params,
+                                           "opt_state": self.opt_state,
+                                           "epoch": np.asarray(0)})
+            self.params = state["params"]
+            self.opt_state = state["opt_state"]
+            self._epoch0 = int(state["epoch"])
+
+        if self.mesh is not None or self.n_devices > 1:
+            from ..parallel.mesh import get_mesh
+
+            if self.mesh is None:
+                self.mesh = get_mesh(self.n_devices, axis_name="expert")
+            assert "expert" in self.mesh.shape, (
+                "MoE runs over an 'expert' mesh axis; got axes "
+                f"{tuple(self.mesh.shape)}")
+            assert self.mesh.shape["expert"] == self.n_experts, (
+                f"one expert per device: n_experts={self.n_experts} must equal "
+                f"the expert axis size {self.mesh.shape['expert']}")
+            self._train_step = make_moe_train_step(
+                self.config, self.optimizer, self.mesh,
+                capacity_factor=self.capacity_factor,
+                router_weight=self.router_weight)
+            self._batch_multiple = self.n_experts
+        else:
+            self._train_step = make_train_step(self.config, self.optimizer,
+                                               loss_fn=self._loss_fn)
+            self._batch_multiple = 1
+        # validation + transform run the dense mixture: exact, never drops, and
+        # the [E, F, D] params fit a single device at this model family's scale
+        self._eval_step = make_eval_step(self.config, loss_fn=self._loss_fn)
+        config = self.config
+        self._encode_fn = jax.jit(
+            lambda p, x: moe_forward_dense(p, x, config)[0])
+        self._sparse_encode_fn = None
+
+    def _transform_sparse(self, data, batch_size):
+        """Sparse inputs densify per batch on host and take the dense mixture
+        encode (the DAE's gather-accumulate stream keys on a single [F, D]
+        weight; the routed equivalent would need per-row expert gathers —
+        not worth it for an eval-path encode)."""
+        return self._dense_encode_loop(data.tocsr(), batch_size)
+
+    def _log_param_histograms(self, train_writer, gstep):
+        for tag, leaf in (("gate", self.params["gate"]),
+                          ("enc_w", self.params["W"]),
+                          ("hidden_bias", self.params["bh"]),
+                          ("visible_bias", self.params["bv"])):
+            train_writer.histogram(tag, np.asarray(leaf), gstep)
+
+    def load_model(self, shape, model_path):
+        """Restore a trained mixture given (n_features, n_components)."""
+        import dataclasses
+
+        from ..utils.checkpoint import load_params
+
+        n_features, n_components = shape
+        self.config = dataclasses.replace(self._make_config(n_features),
+                                          n_components=int(n_components))
+        self.n_components = int(n_components)
+        self.optimizer = make_optimizer(self.opt, self.learning_rate,
+                                        self.momentum)
+        self.params = moe_init_params(jax.random.PRNGKey(0), self.config,
+                                      self.n_experts)
+        self.opt_state = self.optimizer.init(self.params)
+        config = self.config
+        self._encode_fn = jax.jit(
+            lambda p, x: moe_forward_dense(p, x, config)[0])
+        self._sparse_encode_fn = None
+        path, _ = latest_checkpoint(model_path)
+        self.params = load_params(path or model_path, self.params)
+        self._loaded_path = model_path
+        return self
+
+    def get_model_parameters(self):
+        self._restore_latest()
+        return {
+            "gate": np.asarray(self.params["gate"]),
+            "enc_w": np.asarray(self.params["W"]),      # [E, F, D]
+            "enc_b": np.asarray(self.params["bh"]),     # [E, D]
+            "dec_b": np.asarray(self.params["bv"]),     # [E, F]
+        }
+
+    def get_weights_as_images(self, width, height, outdir="img/", max_images=10,
+                              model_path=None):
+        """Per-expert hidden-unit weight images (parent semantics, one set per
+        expert, suffixed -e{i})."""
+        assert max_images <= self.n_components
+        if model_path is not None:
+            self.load_model((self.config.n_features, self.n_components),
+                            model_path)
+        else:
+            self._restore_latest()
+        outdir = os.path.join(self.data_dir, outdir)
+        os.makedirs(outdir, exist_ok=True)
+        import matplotlib
+        matplotlib.use("Agg")
+        from matplotlib import pyplot as plt
+
+        w = np.asarray(self.params["W"])  # [E, F, D]
+        perm = np.random.permutation(self.n_components)[:max_images]
+        for e in range(w.shape[0]):
+            for p in perm:
+                img = w[e, :, p][: width * height].reshape(height, width)
+                path = os.path.join(
+                    outdir, f"{self.model_name}-e{e}-enc_weights_{p}.png")
+                plt.imsave(path, img, cmap="gray")

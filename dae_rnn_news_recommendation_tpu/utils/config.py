@@ -91,6 +91,11 @@ def build_parser(triplet_mode=False):
                         "reach reference-scale feature counts (the UCI workload "
                         "is 10k features, main_autoencoder.py:50)")
     p.add_argument("--n_devices", type=int, default=1)
+    p.add_argument("--n_experts", type=int, default=1,
+                   help="train a Switch-style mixture of N expert DAEs "
+                        "(models/estimator_moe.py) instead of a single DAE; "
+                        "with --n_devices > 1 each expert lives on its own "
+                        "device over an 'expert' mesh axis")
     p.add_argument("--model_parallel", type=int, default=1,
                    help="shard W's feature rows over a 'model' mesh axis of "
                         "this size (the max_features=50k layout); must divide "
@@ -159,6 +164,17 @@ def validate(args, triplet_mode=False):
         assert args.loss_func in ("mean_squared", "cosine_proximity"), (
             "tfidf input is not Bernoulli — cross_entropy is invalid "
             "(reference main_autoencoder.py:108-109)")
+    if getattr(args, "n_experts", 1) > 1:
+        assert not triplet_mode, (
+            "--n_experts selects the MoE estimator, which has no precomputed-"
+            "triplet variant — it is only valid on main_autoencoder")
+        if args.n_devices > 1:
+            assert args.n_devices == args.n_experts, (
+                "expert parallelism places one expert per device: --n_experts "
+                f"{args.n_experts} must equal --n_devices {args.n_devices}")
+            assert getattr(args, "model_parallel", 1) == 1, (
+                "--n_experts and --model_parallel are mutually exclusive mesh "
+                "layouts")
     if args.main_dir == "":
         args.main_dir = args.model_name
     return args
