@@ -51,6 +51,11 @@ STARSPACE_ARGS = [
     "--max_features", "2000", "--dim", "50", "--epochs", "30",
     "--threads", "4", "--seed", str(SEED),
 ]
+# same corpus/budget as MAIN_ARGS by construction (the evidence check claims
+# it); only the model family and the eval scope differ
+assert MAIN_ARGS[0] == "--model_name"
+MOE_ARGS = (["--model_name", "evidence_moe"] + MAIN_ARGS[2:]
+            + ["--n_experts", "4", "--eval_reps", "encoded"])
 # the reference's headline workload shape: 8000 rows x 10000 features -> 500
 # (main_autoencoder.py:50 compress_factor 20, :60 batch 10%), bf16 compute,
 # streaming eval tail
@@ -90,6 +95,8 @@ def main():
         _, tri_aurocs = main_triplet(TRIPLET_ARGS)
         print("== native StarSpace baseline ==")
         ss_result, ss_aurocs = main_starspace(STARSPACE_ARGS)
+        print("== mixture-of-denoisers (4 experts, net-new family) ==")
+        _, moe_aurocs = main_autoencoder(MOE_ARGS)
         print("== reference-scale run (8000 x 10000 -> 500, bf16, "
               "streaming eval) ==")
         t_ref = time.time()
@@ -119,6 +126,11 @@ def main():
           f"encoded {enc_vl:.4f} > tfidf {tfidf_vl:.4f} (Category, validate)")
     check("triplet_encoded_above_chance", tri_aurocs["encoded"] > 0.5,
           f"triplet encoded AUROC {tri_aurocs['encoded']:.4f} > 0.5")
+    moe_vl = moe_aurocs["similarity_boxplot_encoded_validate(Category)"]
+    check("moe_encoded_beats_tfidf_validate",
+          moe_vl > 0.65 and moe_vl > tfidf_vl,
+          f"4-expert mixture encoded {moe_vl:.4f} > tfidf {tfidf_vl:.4f} "
+          "(Category, validate; same corpus/budget as the single DAE)")
     ref_enc = ref_aurocs["similarity_boxplot_encoded_validate(Category)"]
     ref_tfidf = ref_aurocs["similarity_boxplot_tfidf_validate(Category)"]
     check("refscale_encoded_beats_tfidf",
@@ -141,12 +153,14 @@ def main():
             "main_autoencoder": MAIN_ARGS,
             "main_autoencoder_triplet": TRIPLET_ARGS,
             "main_starspace": STARSPACE_ARGS,
+            "main_autoencoder_moe": MOE_ARGS,
             "main_autoencoder_refscale": REFSCALE_ARGS,
         },
         "aurocs_online_mining": {k: float(v) for k, v in sorted(aurocs.items())},
         "aurocs_refscale": {k: float(v) for k, v in sorted(ref_aurocs.items())},
         "refscale_wall_seconds": round(t_ref, 1),
         "aurocs_triplet": {k: float(v) for k, v in sorted(tri_aurocs.items())},
+        "aurocs_moe": {k: float(v) for k, v in sorted(moe_aurocs.items())},
         "aurocs_starspace": {k: float(v) for k, v in sorted(ss_aurocs.items())},
         "starspace": {"best_loss": ss_loss, "best_epoch": ss_epoch},
         "checks": checks,
@@ -209,6 +223,21 @@ def _write_md(p):
             cat = r[f"similarity_boxplot_{rep}{sfx}(Category)"]
             sto = r[f"similarity_boxplot_{rep}{sfx}(Story)"]
             lines.append(f"| {rep} | {split} | {cat:.4f} | {sto:.4f} |")
+    m = p["aurocs_moe"]
+    lines += [
+        "",
+        "## Mixture-of-denoisers (--n_experts 4, net-new family)",
+        "",
+        "Same corpus and training budget as the online-mining run above, "
+        "routed across 4 expert DAEs (Switch-style top-1 gating):",
+        "",
+        "| representation | split | Category | Story |",
+        "|---|---|---|---|",
+    ]
+    for split, sfx in (("train", ""), ("validate", "_validate")):
+        cat = m[f"similarity_boxplot_encoded{sfx}(Category)"]
+        sto = m[f"similarity_boxplot_encoded{sfx}(Story)"]
+        lines.append(f"| encoded (4-expert MoE) | {split} | {cat:.4f} | {sto:.4f} |")
     lines += [
         "",
         "## Precomputed-triplet driver",

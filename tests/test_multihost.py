@@ -86,6 +86,30 @@ _WORKER = textwrap.dedent("""
     single = make_train_step(config, optimizer, donate=False)
     _, _, m1 = single(params, opt_state, jax.random.PRNGKey(7), full)
     np.testing.assert_allclose(dist_cost, float(m1["cost"]), rtol=1e-5)
+
+    # expert-parallel MoE step across processes: one expert per device, the
+    # all_to_all dispatch/return and mining all_gathers cross the process
+    # boundary over gloo; ample capacity -> must equal the dense oracle
+    from dae_rnn_news_recommendation_tpu.parallel.ep import (
+        make_moe_train_step, moe_init_params, moe_loss_and_metrics)
+
+    ep_mesh = get_mesh(4, axis_name="expert")
+    moe_params = moe_init_params(jax.random.PRNGKey(1), config, 4)
+    moe_opt = optimizer.init(moe_params)
+    gmoe_params = put_replicated(moe_params, ep_mesh)
+    gmoe_opt = put_replicated(jax.tree_util.tree_map(np.asarray, moe_opt),
+                              ep_mesh)
+    ep_batch = put_sharded_batch({k: v[lo:hi] for k, v in full.items()},
+                                 ep_mesh, data_axis="expert")
+    ep_step = make_moe_train_step(config, optimizer, ep_mesh,
+                                  capacity_factor=4.0, donate=False)
+    _, _, ep_metrics = ep_step(gmoe_params, gmoe_opt, jax.random.PRNGKey(9),
+                               ep_batch)
+    assert float(ep_metrics["routed_fraction"]) == 1.0
+    cost0, _ = moe_loss_and_metrics(moe_params, full, jax.random.PRNGKey(9),
+                                    config)
+    np.testing.assert_allclose(float(ep_metrics["cost"]), float(cost0),
+                               rtol=1e-5)
     print("MULTIHOST_OK", pid, flush=True)
 """)
 
