@@ -32,20 +32,26 @@ import scipy.sparse as sp
 
 BASELINE_ARTICLES_PER_SEC = 200_000.0
 F, D = 10_000, 500
-BATCH = 8192
 NNZ_PER_ROW = 200  # ~2% density, UCI-news-like
-N_BATCHES = 24
-WARMUP = 3
-PREFETCH = 4
 
-# train bench: reference defaults — 8000 rows, batch_size = 10% (main_autoencoder.py:60)
-TRAIN_BATCH = 800
-TRAIN_STEPS = 30
-TRAIN_WARMUP = 3
+# Workload sizes per platform: the TPU sizes are the headline measurement; the
+# CPU fallback keeps the same metric definitions but must FINISH inside
+# CPU_CHILD_TIMEOUT (measured 2026-07: ~50s; the TPU sizes run >15 min on this
+# host's CPU, which would zero the round record whenever the tunnel is down).
+SIZES = {
+    "tpu": dict(batch=8192, n_batches=24, warmup=3, prefetch=4,
+                train_batch=800, train_steps=30, train_warmup=3,
+                stream_rows=16384, stream_batch=2048, stream_epochs=2),
+    "cpu": dict(batch=2048, n_batches=6, warmup=1, prefetch=2,
+                train_batch=256, train_steps=6, train_warmup=1,
+                stream_rows=2048, stream_batch=512, stream_epochs=1),
+}
 
-ATTEMPTS = 4
-BACKOFFS = (5, 15, 30)
-CHILD_TIMEOUT = 900
+ATTEMPTS = 3          # last attempt forces the CPU fallback
+BACKOFFS = (5, 15)
+CHILD_TIMEOUT = 900   # per TPU attempt (healthy tunnel runs need the headroom)
+CPU_CHILD_TIMEOUT = 420
+PROBE_TIMEOUT = 90    # backend-init probe before each TPU attempt
 
 
 def _make_pool(n_rows, rng):
@@ -56,33 +62,34 @@ def _make_pool(n_rows, rng):
     return sp.csr_matrix((data, idx.ravel(), indptr), shape=(n_rows, F))
 
 
-def _bench_encode(jax, params, config):
+def _bench_encode(jax, params, config, sz):
     import jax.numpy as jnp  # noqa: F401  (device path)
 
     from dae_rnn_news_recommendation_tpu.ops.sparse_ingest import (
         pad_csr_batch, sparse_encode)
 
     enc_fn = jax.jit(lambda p, i: sparse_encode(p, i, None, config, chunk=512))
+    batch, n_batches = sz["batch"], sz["n_batches"]
 
     rng = np.random.default_rng(0)
     # EVERY timed dispatch gets distinct input contents: the TPU tunnel in this
     # environment memoizes (executable, inputs) pairs, so repeating a pool slice
-    # would measure the cache, not the stream. 3 passes x N_BATCHES distinct
+    # would measure the cache, not the stream. 3 passes x n_batches distinct
     # batches, padded up front (host prep is not part of the timed stream).
-    n_distinct = 3 * N_BATCHES
-    pool = _make_pool(n_distinct * BATCH, rng)
+    n_distinct = 3 * n_batches
+    pool = _make_pool(n_distinct * batch, rng)
     # binary mode: values are implicit 1.0, so only indices cross the wire
     host_feeds = [
-        pad_csr_batch(pool[i * BATCH : (i + 1) * BATCH], binary=True)["indices"]
+        pad_csr_batch(pool[i * batch : (i + 1) * batch], binary=True)["indices"]
         for i in range(n_distinct)
     ]
     warmup_feeds = [
-        pad_csr_batch(_make_pool(BATCH, np.random.default_rng(100 + i)),
+        pad_csr_batch(_make_pool(batch, np.random.default_rng(100 + i)),
                       binary=True)["indices"]
-        for i in range(WARMUP)
+        for i in range(sz["warmup"])
     ]
 
-    for i in range(WARMUP):
+    for i in range(sz["warmup"]):
         enc_fn(params, jax.device_put(warmup_feeds[i])).block_until_ready()
 
     def one_pass(feeds):
@@ -90,25 +97,25 @@ def _bench_encode(jax, params, config):
             return jax.device_put(feeds[i])
 
         t0 = time.perf_counter()
-        inflight = [put(i) for i in range(PREFETCH)]
+        inflight = [put(i) for i in range(sz["prefetch"])]
         out = None
-        for i in range(N_BATCHES):
+        for i in range(n_batches):
             di = inflight.pop(0)
             out = enc_fn(params, di)
-            if i + PREFETCH < N_BATCHES:
-                inflight.append(put(i + PREFETCH))
+            if i + sz["prefetch"] < n_batches:
+                inflight.append(put(i + sz["prefetch"]))
         out.block_until_ready()
         return time.perf_counter() - t0
 
     # best of three passes (each on its own distinct batches): single-chip-over-
     # tunnel timing jitters run to run, and peak sustained throughput is the
     # figure of merit for the stream design
-    dt = min(one_pass(host_feeds[p * N_BATCHES : (p + 1) * N_BATCHES])
+    dt = min(one_pass(host_feeds[p * n_batches : (p + 1) * n_batches])
              for p in range(3))
-    return N_BATCHES * BATCH / dt
+    return n_batches * batch / dt
 
 
-def _bench_train(jax):
+def _bench_train(jax, sz):
     """Steady-state fit() hot loop: batch_all mining at the reference default shape."""
     import jax.numpy as jnp
 
@@ -121,6 +128,7 @@ def _bench_train(jax):
         loss_func="cross_entropy", corr_type="masking", corr_frac=0.3,
         triplet_strategy="batch_all", alpha=1.0, compute_dtype="bfloat16",
     )
+    tb = sz["train_batch"]
     params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
     optimizer = make_optimizer("ada_grad", 0.1)
     opt_state = jax.device_put(optimizer.init(params))
@@ -129,27 +137,27 @@ def _bench_train(jax):
     rng = np.random.default_rng(1)
     batch = {
         "x": jax.device_put(jnp.asarray(
-            (rng.uniform(size=(TRAIN_BATCH, F)) < 0.02).astype(np.float32))),
+            (rng.uniform(size=(tb, F)) < 0.02).astype(np.float32))),
         "labels": jax.device_put(jnp.asarray(
-            rng.integers(0, 30, TRAIN_BATCH), jnp.int32)),
-        "row_valid": jax.device_put(jnp.ones(TRAIN_BATCH, jnp.float32)),
+            rng.integers(0, 30, tb), jnp.int32)),
+        "row_valid": jax.device_put(jnp.ones(tb, jnp.float32)),
     }
     key = jax.random.PRNGKey(2)
-    for i in range(TRAIN_WARMUP):
+    for i in range(sz["train_warmup"]):
         key, sub = jax.random.split(key)
         params, opt_state, metrics = step(params, opt_state, sub, batch)
     jax.block_until_ready(metrics)
 
     t0 = time.perf_counter()
-    for i in range(TRAIN_STEPS):
+    for i in range(sz["train_steps"]):
         key, sub = jax.random.split(key)
         params, opt_state, metrics = step(params, opt_state, sub, batch)
     jax.block_until_ready(metrics)
     dt = time.perf_counter() - t0
-    return TRAIN_STEPS * TRAIN_BATCH / dt
+    return sz["train_steps"] * tb / dt
 
 
-def _bench_train_stream(jax):
+def _bench_train_stream(jax, sz):
     """End-to-end fit hot loop INCLUDING the host feed: csr -> sparse-ingest
     batches (uint16 indices + f32 values, prefetched) -> on-device densify +
     train step. This is what a real fit() pays per epoch."""
@@ -161,7 +169,7 @@ def _bench_train_stream(jax):
     from dae_rnn_news_recommendation_tpu.train import make_optimizer
     from dae_rnn_news_recommendation_tpu.train.step import make_train_step
 
-    n_rows, batch = 16384, 2048
+    n_rows, batch = sz["stream_rows"], sz["stream_batch"]
     rng = np.random.default_rng(3)
     data = _make_pool(n_rows, rng).astype(np.float32)
     labels = rng.integers(0, 30, n_rows).astype(np.int32)
@@ -191,7 +199,7 @@ def _bench_train_stream(jax):
 
     one_epoch()  # compile + warm caches
     t0 = time.perf_counter()
-    epochs = 2
+    epochs = sz["stream_epochs"]
     for _ in range(epochs):
         one_epoch()
     dt = time.perf_counter() - t0
@@ -201,9 +209,17 @@ def _bench_train_stream(jax):
 def child_main():
     import jax
 
+    # honor a parent-requested CPU fallback even under the axon site hook,
+    # which ignores the JAX_PLATFORMS env var and would hang on a dead tunnel:
+    # the config flip before the first device touch is the reliable recipe
+    # (same as __graft_entry__.py / tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
     from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
 
     platform = jax.devices()[0].platform
+    sz = SIZES.get(platform, SIZES["cpu"])
 
     config = DAEConfig(
         n_features=F, n_components=D, enc_act_func="sigmoid", dec_act_func="sigmoid",
@@ -212,16 +228,18 @@ def child_main():
     )
     params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
 
-    encode_aps = _bench_encode(jax, params, config)
+    encode_aps = _bench_encode(jax, params, config, sz)
 
     extra = {"platform": platform}
     try:
-        extra["train_articles_per_sec"] = round(_bench_train(jax), 1)
-        extra["train_shape"] = f"batch {TRAIN_BATCH}, {F}->{D}, batch_all+adagrad"
+        extra["train_articles_per_sec"] = round(_bench_train(jax, sz), 1)
+        extra["train_shape"] = (f"batch {sz['train_batch']}, {F}->{D}, "
+                                "batch_all+adagrad")
     except Exception as e:  # train figure is secondary; never lose the headline
         extra["train_error"] = repr(e)[-300:]
     try:
-        extra["fit_stream_articles_per_sec"] = round(_bench_train_stream(jax), 1)
+        extra["fit_stream_articles_per_sec"] = round(
+            _bench_train_stream(jax, sz), 1)
     except Exception as e:
         extra["fit_stream_error"] = repr(e)[-300:]
 
@@ -239,21 +257,45 @@ def _diag(attempt, note):
           file=sys.stderr, flush=True)
 
 
+def _tpu_alive(attempt):
+    """Cheap backend-init probe in a throwaway subprocess: a DEAD tunnel hangs
+    at init (not at compute), so a 90s probe distinguishes 'retry is worth
+    900s' from 'go straight to the CPU fallback'."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT,
+            env=dict(os.environ))
+        alive = proc.returncode == 0 and "tpu" in proc.stdout
+    except subprocess.TimeoutExpired:
+        alive = False
+    if not alive:
+        _diag(attempt, f"tpu probe failed within {PROBE_TIMEOUT}s; "
+              "skipping to cpu fallback")
+    return alive
+
+
 def main():
     """Parent: run the bench in fresh subprocesses (fresh JAX backend init each try),
-    retry with backoff on flake, fall back to cpu on the final attempt."""
+    retry with backoff on flake, fall back to cpu on the final attempt. A dead
+    tunnel is detected by a short probe so the fallback isn't gated on two full
+    child timeouts."""
     for attempt in range(ATTEMPTS):
         env = dict(os.environ)
-        if attempt == ATTEMPTS - 1:
+        timeout_s = CHILD_TIMEOUT
+        cpu_fallback = attempt == ATTEMPTS - 1 or not _tpu_alive(attempt)
+        if cpu_fallback:
             env["JAX_PLATFORMS"] = "cpu"
-            _diag(attempt, "final attempt: falling back to JAX_PLATFORMS=cpu")
+            timeout_s = CPU_CHILD_TIMEOUT
+            _diag(attempt, "falling back to JAX_PLATFORMS=cpu")
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child"],
-                capture_output=True, text=True, timeout=CHILD_TIMEOUT, env=env,
+                capture_output=True, text=True, timeout=timeout_s, env=env,
             )
         except subprocess.TimeoutExpired:
-            _diag(attempt, f"child timed out after {CHILD_TIMEOUT}s")
+            _diag(attempt, f"child timed out after {timeout_s}s")
             continue
         line = next(
             (ln for ln in reversed(proc.stdout.splitlines())
