@@ -293,8 +293,9 @@ def main():
             env["JAX_PLATFORMS"] = "cpu"
             timeout_s = CPU_CHILD_TIMEOUT
             _diag(attempt, "final attempt: falling back to JAX_PLATFORMS=cpu")
-        elif attempt > 0 and not _tpu_alive(attempt):
-            time.sleep(BACKOFFS[min(attempt, len(BACKOFFS) - 1)])
+        elif attempt > 0 and not (_tpu_alive(attempt) or _tpu_alive(attempt)):
+            # two probes per retry so one transient probe flake can't forfeit
+            # the TPU attempt; the probes' own wall time is the backoff
             continue
         try:
             proc = subprocess.run(
@@ -311,7 +312,9 @@ def main():
             print(line, flush=True)
             return 0
         _diag(attempt, f"rc={proc.returncode} stderr: {proc.stderr[-400:]}")
-        if attempt < ATTEMPTS - 1:
+        if attempt < ATTEMPTS - 2:
+            # backoff only when the NEXT attempt retries the tunnel; the final
+            # CPU fallback doesn't depend on tunnel recovery
             time.sleep(BACKOFFS[min(attempt, len(BACKOFFS) - 1)])
     print(json.dumps({
         "metric": "encode_articles_per_sec", "value": 0.0,

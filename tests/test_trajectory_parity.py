@@ -1,0 +1,138 @@
+"""End-to-end training-trajectory parity against an independent-framework oracle.
+
+BASELINE.json's north star demands "triplet loss parity 1e-4 vs TF1 CPU @ epoch
+50". TF 1.12 doesn't exist in this environment, so the stand-in oracle is a
+from-scratch torch (CPU, autograd) reimplementation of the reference's training
+semantics — same modified encoder H = f(xW+b) − f(b) (reference
+autoencoder.py:389), tied decode (:411), batch_all/batch_hard mining over dot
+products with the reference's exact mask/softplus/data_weight formulas
+(triplet_loss_utils.py:79-259, quirks included), weighted cross-entropy
+(:262-277), and TF1 optimizer semantics (adagrad accumulator 0.1,
+autoencoder.py:444-477) — fed IDENTICAL initial parameters and full-batch data.
+
+Fifty epochs of the jitted JAX step vs fifty epochs of torch autograd must agree
+on every epoch's cost. Measured divergence is ~1e-7 relative in float32 (two
+independent autodiff systems, different reduction orders); the assertion uses
+1e-5 — an order of magnitude inside the 1e-4 north star.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+# torch is the independent oracle, not a framework dependency — skip cleanly in
+# environments without it (repo convention, cf. tests/test_tb_writer.py)
+torch = pytest.importorskip("torch")
+
+from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+from dae_rnn_news_recommendation_tpu.train import make_optimizer
+from dae_rnn_news_recommendation_tpu.train.step import make_train_step
+
+N, F, D = 128, 64, 8
+ALPHA, LR, EPOCHS = 1.0, 0.5, 50
+EPS = 1e-16
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    x = (rng.uniform(size=(N, F)) < 0.25).astype(np.float32)
+    labels = rng.integers(0, 4, N).astype(np.int32)
+    return x, labels
+
+
+def _jax_trajectory(strategy, opt_name, x_np, labels_np, p0):
+    cfg = DAEConfig(n_features=F, n_components=D, enc_act_func="sigmoid",
+                    dec_act_func="sigmoid", loss_func="cross_entropy",
+                    corr_type="none", corr_frac=0.0, triplet_strategy=strategy,
+                    alpha=ALPHA, matmul_precision="highest")
+    opt = make_optimizer(opt_name, LR)
+    step = make_train_step(cfg, opt, donate=False)
+    params = {k: jnp.asarray(v) for k, v in p0.items()}
+    state = opt.init(params)
+    batch = {"x": jnp.asarray(x_np), "labels": jnp.asarray(labels_np),
+             "row_valid": jnp.ones(N, jnp.float32)}
+    costs = []
+    for _ in range(EPOCHS):
+        params, state, m = step(params, state, jax.random.PRNGKey(0), batch)
+        costs.append(float(m["cost"]))
+    return np.array(costs)
+
+
+def _torch_batch_all(dp, lab):
+    dist = -dp[:, :, None] + dp[:, None, :]
+    ne = ~torch.eye(N, dtype=torch.bool)
+    distinct = ne[:, :, None] & ne[:, None, :] & ne[None, :, :]
+    leq = lab[None, :] == lab[:, None]
+    vmask = (distinct & leq[:, :, None] & ~leq[:, None, :]).float()
+    t_loss = ((torch.nn.functional.softplus(dist) * vmask).sum()
+              / torch.clamp(vmask.sum(), min=EPS))
+    dw = vmask.sum((1, 2)) + vmask.sum((0, 1)) + vmask.sum((0, 2))
+    return t_loss, dw
+
+
+def _torch_batch_hard(dp, lab):
+    # reference quirks preserved: hardest-pos via row-max shift
+    # (triplet_loss_utils.py:227-231), zero-masked hardest-neg max (:240),
+    # float-equality tie double-count in data_weight (:251-253)
+    ne = ~torch.eye(N, dtype=torch.bool)
+    leq = lab[None, :] == lab[:, None]
+    mask_ap = (ne & leq).float()
+    mask_an = (~leq).float()
+    max_row = dp.max(dim=1, keepdim=True).values
+    hardest_pos = (dp + max_row * (1.0 - mask_ap)).min(dim=1, keepdim=True).values
+    hardest_neg = (mask_an * dp).max(dim=1, keepdim=True).values
+    dist = torch.clamp(hardest_neg - hardest_pos, min=0.0)
+    count = (dist > 0.0).float()
+    eq_pos = (dp == hardest_pos).float()
+    eq_neg = (dp == hardest_neg).float()
+    dw = (count.squeeze(1) + (count * eq_pos).sum(0) + (count * eq_neg).sum(0))
+    t_loss = ((torch.nn.functional.softplus(dist) * count).sum()
+              / torch.clamp(count.sum(), min=EPS))
+    return t_loss, dw
+
+
+def _torch_trajectory(strategy, opt_name, x_np, labels_np, p0):
+    t = {k: torch.tensor(v, dtype=torch.float32, requires_grad=True)
+         for k, v in p0.items()}
+    acc = {k: torch.full_like(t[k], 0.1) for k in t}  # TF1 adagrad accumulator
+    x = torch.tensor(x_np)
+    lab = torch.tensor(labels_np.astype(np.int64))
+    mine = _torch_batch_all if strategy == "batch_all" else _torch_batch_hard
+    costs = []
+    for _ in range(EPOCHS):
+        W, bh, bv = t["W"], t["bh"], t["bv"]
+        h = torch.sigmoid(x @ W + bh) - torch.sigmoid(bh)
+        y = torch.sigmoid(h @ W.T + bv)
+        t_loss, dw = mine(h @ h.T, lab)
+        per_row = -(x * torch.log(torch.clamp(y, min=EPS))
+                    + (1 - x) * torch.log(torch.clamp(1 - y, min=EPS))).sum(1)
+        ae = (per_row * dw).sum() / torch.clamp(dw.sum(), min=EPS)
+        cost = ae + ALPHA * t_loss
+        cost.backward()
+        with torch.no_grad():
+            for k in t:
+                g = t[k].grad
+                if opt_name == "ada_grad":
+                    acc[k] += g * g
+                    t[k] -= LR * g / (torch.sqrt(acc[k]) + 1e-7)
+                else:
+                    t[k] -= LR * g
+                t[k].grad = None
+        costs.append(float(cost.detach()))
+    return np.array(costs)
+
+
+@pytest.mark.parametrize("opt_name", ["gradient_descent", "ada_grad"])
+@pytest.mark.parametrize("strategy", ["batch_all", "batch_hard"])
+def test_fifty_epoch_trajectory_parity(strategy, opt_name):
+    x_np, labels_np = _data()
+    cfg = DAEConfig(n_features=F, n_components=D, triplet_strategy=strategy)
+    p0 = {k: np.asarray(v)
+          for k, v in init_params(jax.random.PRNGKey(0), cfg).items()}
+    ours = _jax_trajectory(strategy, opt_name, x_np, labels_np, p0)
+    oracle = _torch_trajectory(strategy, opt_name, x_np, labels_np, p0)
+    assert np.isfinite(ours).all() and np.isfinite(oracle).all()
+    # the training must actually move (a frozen model would trivially "agree")
+    assert ours[-1] < ours[0]
+    np.testing.assert_allclose(ours, oracle, rtol=1e-5, atol=1e-6)
