@@ -10,15 +10,18 @@ components (StarSpace-style baseline trainer, fast CSR batcher).
 Reference capability map (see SURVEY.md):
   ops/       — corruption, reconstruction losses, triplet mining (triplet_loss_utils.py, utils.py twins)
   models/    — DAE core + sklearn-style estimators (autoencoder.py, autoencoder_triplet.py twins),
-               stacked DAE pretrain, GRU user-state RNN (the paper's unimplemented half)
+               stacked DAE pretrain, Switch-style mixture-of-denoisers, GRU
+               user-state RNN (the paper's unimplemented half)
   train/     — jitted train-step factory, optax optimizer zoo, epoch driver
-  parallel/  — mesh construction, data/tensor sharding, global-batch mining collectives
+  parallel/  — mesh construction; dp/tp/sp/pp/ep sharding strategies; ring
+               (ppermute) eval collectives; anchor-partitioned global mining;
+               multi-host init + sharded feeds
   data/      — article pipeline, padded batcher, save/read IO (datasets/articles.py, helpers.py twins)
   eval/      — pairwise similarity, AUROC plots (helpers.py twin)
   utils/     — config/flags + .env override, provenance, metrics, checkpointing
   cli/       — main_autoencoder / main_autoencoder_triplet drivers
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"  # keep in sync with pyproject.toml
 
 from . import ops  # noqa: F401
