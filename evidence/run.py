@@ -75,11 +75,29 @@ CACHE = os.path.join(HERE, ".stage_cache.json")
 
 
 def _fingerprint():
-    """Stage results are only reusable for the exact driver args + seed that
-    produced them — a cache from an edited configuration must invalidate, or
-    stale numbers would be committed under the new flags."""
-    return json.dumps([SEED, MAIN_ARGS, TRIPLET_ARGS, STARSPACE_ARGS, MOE_ARGS,
-                       REFSCALE_ARGS])
+    """Stage results are only reusable for the exact driver args + seed + CODE
+    that produced them — a cache from an edited configuration or an edited
+    repo must invalidate, or stale numbers would be committed under the new
+    flags/code. Code state = HEAD + a stable hash of the working-tree diff
+    (PROGRESS.jsonl excluded: the round driver rewrites it every few minutes,
+    and its churn must not invalidate an otherwise-identical resume)."""
+    import hashlib
+    import subprocess
+
+    def git(*argv):
+        return subprocess.run(["git", *argv], cwd=REPO, capture_output=True,
+                              text=True).stdout
+
+    try:
+        head = git("rev-parse", "HEAD").strip()
+        diff = git("diff", "HEAD", "--", ".", ":(exclude)PROGRESS.jsonl")
+        names = "\n".join(l for l in git("status", "--porcelain").splitlines()
+                          if "PROGRESS.jsonl" not in l)
+        code = hashlib.sha256((diff + names).encode()).hexdigest()
+    except OSError:
+        head, code = "nogit", "nogit"
+    return json.dumps([head, code, SEED, MAIN_ARGS, TRIPLET_ARGS,
+                       STARSPACE_ARGS, MOE_ARGS, REFSCALE_ARGS])
 
 
 def _load_cache():

@@ -78,3 +78,31 @@ def test_parse_flags_end_to_end(tmp_path, monkeypatch):
     args = C.parse_flags(["--model_name", "m", "--num_epochs", "3"])
     assert args.num_epochs == 3
     assert args.main_dir == "m"
+
+
+def test_n_experts_rejects_model_parallel(tmp_path, monkeypatch):
+    """Expert and model parallelism are mutually exclusive mesh layouts."""
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(AssertionError, match="mutually exclusive"):
+        C.parse_flags(["--model_name", "m", "--n_experts", "8",
+                       "--n_devices", "8", "--model_parallel", "2"])
+
+
+def test_n_experts_requires_matching_devices(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(AssertionError, match="one expert per device"):
+        C.parse_flags(["--model_name", "m", "--n_experts", "4",
+                       "--n_devices", "8"])
+
+
+def test_package_version_matches_pyproject():
+    """__version__ and pyproject.toml must stay in sync (the docstring says so)."""
+    import os
+
+    tomllib = pytest.importorskip("tomllib")  # stdlib only from Python 3.11
+    import dae_rnn_news_recommendation_tpu as pkg
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    assert pkg.__version__ == meta["project"]["version"]
