@@ -176,17 +176,37 @@ def densify_on_device(indices, values, n_features, dtype=jnp.float32):
     return out.at[rows, idx].add(values.astype(dtype))
 
 
-def sparse_encode(params, indices, values, config, chunk=256):
+def sparse_encode(params, indices, values, config, chunk=256, via_dense=False):
     """The DAE encode pass (models/dae_core.py) fed by (indices, values):
-    H = act(gather_sum + bh) - act(bh). `values=None` = binary mode."""
+    H = act(x@W + bh) - act(bh). `values=None` = binary mode.
+
+    Two equivalent device strategies for x@W (identical results, tested):
+      via_dense=False — chunked weighted gather-accumulate over W's rows
+        (VPU/bandwidth bound; never materializes [B, F]);
+      via_dense=True — scatter into a dense [B, F] HBM tile, then one MXU
+        matmul (burns 2x[B,F] HBM traffic to buy systolic-array throughput).
+    Which wins depends on density and chip generation — measure on hardware
+    before switching a production default."""
     from ..models.dae_core import resolve_activation, _precision
 
     act = resolve_activation(config.enc_act_func)
     dt = jnp.dtype(config.compute_dtype)
     w = params["W"].astype(dt)
-    if values is None:
-        w = extend_w_for_binary(w)
-    pre = sparse_encode_matmul(w, indices, values, chunk=chunk,
-                               precision=_precision(config) or jax.lax.Precision.DEFAULT)
+    if via_dense:
+        f = params["W"].shape[0]
+        if values is None:
+            # binary-mode padding points at out-of-vocab index F: scatter into
+            # an F+1-wide tile so padding lands in a throwaway column
+            x = densify_on_device(indices, jnp.ones(indices.shape, dt), f + 1,
+                                  dtype=dt)[:, :f]
+        else:
+            x = densify_on_device(indices, values, f, dtype=dt)
+        pre = jnp.matmul(x, w, precision=_precision(config))
+    else:
+        if values is None:
+            w = extend_w_for_binary(w)
+        pre = sparse_encode_matmul(
+            w, indices, values, chunk=chunk,
+            precision=_precision(config) or jax.lax.Precision.DEFAULT)
     h = pre.astype(jnp.float32) + params["bh"]
     return act(h) - act(params["bh"])

@@ -153,3 +153,27 @@ def test_pad_csr_rows_float64_input(rng):
         nz = np.flatnonzero(row)
         np.testing.assert_array_equal(got["indices"][i][: len(nz)], nz)
         np.testing.assert_allclose(got["values"][i][: len(nz)], row[nz])
+
+
+@pytest.mark.parametrize("binary", [False, True])
+def test_sparse_encode_via_dense_matches_gather(csr, binary):
+    """The via_dense (densify + MXU matmul) strategy must equal the
+    gather-accumulate strategy and the dense oracle, both feed modes."""
+    data = csr.copy()
+    if binary:
+        data.data[:] = 1.0
+    cfg = DAEConfig(n_features=400, n_components=32, enc_act_func="sigmoid",
+                    dec_act_func="none", loss_func="mean_squared",
+                    corr_type="none", triplet_strategy="none",
+                    matmul_precision="highest")
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    padded = SI.pad_csr_batch(data, binary=binary)
+    idx = jnp.asarray(padded["indices"])
+    vals = None if binary else jnp.asarray(padded["values"])
+    gather = SI.sparse_encode(params, idx, vals, cfg, via_dense=False)
+    dense = SI.sparse_encode(params, idx, vals, cfg, via_dense=True)
+    oracle = dense_encode(params, jnp.asarray(data.toarray()), cfg)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(oracle),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(gather),
+                               rtol=1e-4, atol=1e-5)
