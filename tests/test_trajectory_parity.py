@@ -92,6 +92,25 @@ def _torch_batch_hard(dp, lab):
     return t_loss, dw
 
 
+
+def _torch_tower(t, x):
+    """One DAE tower pass + clamped cross-entropy per-row loss (the reference
+    semantics both parity tests share)."""
+    W, bh, bv = t["W"], t["bh"], t["bv"]
+    h = torch.sigmoid(x @ W + bh) - torch.sigmoid(bh)
+    y = torch.sigmoid(h @ W.T + bv)
+    per_row = -(x * torch.log(torch.clamp(y, min=EPS))
+                + (1 - x) * torch.log(torch.clamp(1 - y, min=EPS))).sum(1)
+    return h, per_row
+
+
+def _torch_sgd(t, lr):
+    with torch.no_grad():
+        for k in t:
+            t[k] -= lr * t[k].grad
+            t[k].grad = None
+
+
 def _torch_trajectory(strategy, opt_name, x_np, labels_np, p0):
     t = {k: torch.tensor(v, dtype=torch.float32, requires_grad=True)
          for k, v in p0.items()}
@@ -101,24 +120,20 @@ def _torch_trajectory(strategy, opt_name, x_np, labels_np, p0):
     mine = _torch_batch_all if strategy == "batch_all" else _torch_batch_hard
     costs = []
     for _ in range(EPOCHS):
-        W, bh, bv = t["W"], t["bh"], t["bv"]
-        h = torch.sigmoid(x @ W + bh) - torch.sigmoid(bh)
-        y = torch.sigmoid(h @ W.T + bv)
+        h, per_row = _torch_tower(t, x)
         t_loss, dw = mine(h @ h.T, lab)
-        per_row = -(x * torch.log(torch.clamp(y, min=EPS))
-                    + (1 - x) * torch.log(torch.clamp(1 - y, min=EPS))).sum(1)
         ae = (per_row * dw).sum() / torch.clamp(dw.sum(), min=EPS)
         cost = ae + ALPHA * t_loss
         cost.backward()
-        with torch.no_grad():
-            for k in t:
-                g = t[k].grad
-                if opt_name == "ada_grad":
+        if opt_name == "ada_grad":
+            with torch.no_grad():
+                for k in t:
+                    g = t[k].grad
                     acc[k] += g * g
                     t[k] -= LR * g / (torch.sqrt(acc[k]) + 1e-7)
-                else:
-                    t[k] -= LR * g
-                t[k].grad = None
+                    t[k].grad = None
+        else:
+            _torch_sgd(t, LR)
         costs.append(float(cost.detach()))
     return np.array(costs)
 
@@ -134,5 +149,54 @@ def test_fifty_epoch_trajectory_parity(strategy, opt_name):
     oracle = _torch_trajectory(strategy, opt_name, x_np, labels_np, p0)
     assert np.isfinite(ours).all() and np.isfinite(oracle).all()
     # the training must actually move (a frozen model would trivially "agree")
+    assert ours[-1] < ours[0]
+    np.testing.assert_allclose(ours, oracle, rtol=1e-5, atol=1e-6)
+
+
+def test_fifty_epoch_triplet_tower_parity():
+    """Same oracle treatment for the precomputed-triplet objective (reference
+    autoencoder_triplet.py:296-315): three weight-sharing towers, summed plain
+    reconstruction losses + alpha * mean softplus(-(dot(a,p) - dot(a,n)))."""
+    from dae_rnn_news_recommendation_tpu.train.step import (
+        triplet_loss_and_metrics)
+
+    rng = np.random.default_rng(1)
+    trip = {n: (rng.uniform(size=(N, F)) < 0.25).astype(np.float32)
+            for n in ("org", "pos", "neg")}
+    cfg = DAEConfig(n_features=F, n_components=D, enc_act_func="sigmoid",
+                    dec_act_func="sigmoid", loss_func="cross_entropy",
+                    corr_type="none", corr_frac=0.0, triplet_strategy="none",
+                    alpha=ALPHA, matmul_precision="highest")
+    p0 = {k: np.asarray(v)
+          for k, v in init_params(jax.random.PRNGKey(3), cfg).items()}
+
+    opt = make_optimizer("gradient_descent", LR)
+    step = make_train_step(cfg, opt, loss_fn=triplet_loss_and_metrics,
+                           donate=False)
+    params = {k: jnp.asarray(v) for k, v in p0.items()}
+    state = opt.init(params)
+    batch = {**{k: jnp.asarray(v) for k, v in trip.items()},
+             "row_valid": jnp.ones(N, jnp.float32)}
+    ours = []
+    for _ in range(EPOCHS):
+        params, state, m = step(params, state, jax.random.PRNGKey(0), batch)
+        ours.append(float(m["cost"]))
+
+    t = {k: torch.tensor(v, dtype=torch.float32, requires_grad=True)
+         for k, v in p0.items()}
+    tx = {k: torch.tensor(v) for k, v in trip.items()}
+    oracle = []
+    for _ in range(EPOCHS):
+        hs, ae = {}, 0.0
+        for n in ("org", "pos", "neg"):
+            hs[n], per_row = _torch_tower(t, tx[n])
+            ae = ae + per_row.mean()
+        margin = (hs["org"] * hs["pos"] - hs["org"] * hs["neg"]).sum(1)
+        cost = ae + ALPHA * torch.nn.functional.softplus(-margin).mean()
+        cost.backward()
+        _torch_sgd(t, LR)
+        oracle.append(float(cost.detach()))
+
+    ours, oracle = np.array(ours), np.array(oracle)
     assert ours[-1] < ours[0]
     np.testing.assert_allclose(ours, oracle, rtol=1e-5, atol=1e-6)
