@@ -231,6 +231,11 @@ def child_main():
     encode_aps = _bench_encode(jax, params, config, sz)
 
     extra = {"platform": platform}
+    if platform != "tpu":
+        extra["note"] = ("CPU fallback (TPU tunnel unavailable at bench time); "
+                         "TPU-session figures: README 'Performance' and "
+                         "evidence/ — encode 1.4-3.1M articles/s observed on "
+                         "v5e across sessions")
     try:
         extra["train_articles_per_sec"] = round(_bench_train(jax, sz), 1)
         extra["train_shape"] = (f"batch {sz['train_batch']}, {F}->{D}, "
