@@ -13,9 +13,15 @@ Two figures:
 
 Reliability: the axon TPU tunnel flakes at backend init, and JAX caches a failed
 backend for the life of the process — so retries MUST use fresh subprocesses. The
-parent retries the child with backoff and falls back to JAX_PLATFORMS=cpu as a last
-resort (a recorded cpu number beats an empty record; the unit string carries the
-platform). Each failed attempt emits a diagnostic JSON line on stderr.
+parent probes backend init (90s throwaway subprocess) before EVERY TPU attempt — a
+dead tunnel hangs at init, and a 90s probe is 10x cheaper than discovering the hang
+via the child timeout — then supervises the child with a no-progress watchdog: the
+child heartbeats one stderr line per phase, and a silent child is killed after
+NOPROGRESS_TIMEOUT instead of burning the full overall timeout (the observed
+failure mode: a tunnel that dies mid-session leaves the child mute at device init
+for the whole 900s). Last resort is JAX_PLATFORMS=cpu (a recorded cpu number beats
+an empty record; the unit string carries the platform). Each failed attempt emits a
+diagnostic JSON line on stderr.
 
 North star (BASELINE.json): >= 200_000 articles/sec (TPU v3-8 class).
 Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline", "extra"}.
@@ -52,6 +58,15 @@ BACKOFFS = (5, 15)
 CHILD_TIMEOUT = 900   # per TPU attempt (healthy tunnel runs need the headroom)
 CPU_CHILD_TIMEOUT = 420
 PROBE_TIMEOUT = 90    # backend-init probe before each TPU attempt
+# kill a child that stops heartbeating: the largest legitimate silent gap is one
+# backend init or one XLA compile (~30-120s observed); a mid-run tunnel death is
+# silent forever. 300s cuts that loss from CHILD_TIMEOUT to a bounded slice.
+NOPROGRESS_TIMEOUT = 300
+
+
+def _phase(note):
+    """Child-side heartbeat, one line per phase, consumed by the parent watchdog."""
+    print(json.dumps({"bench_phase": note}), file=sys.stderr, flush=True)
 
 
 def _make_pool(n_rows, rng):
@@ -77,20 +92,25 @@ def _bench_encode(jax, params, config, sz):
     # would measure the cache, not the stream. 3 passes x n_batches distinct
     # batches, padded up front (host prep is not part of the timed stream).
     n_distinct = 3 * n_batches
+    _phase(f"encode: packing {n_distinct} input batches on host")
     pool = _make_pool(n_distinct * batch, rng)
     # binary mode: values are implicit 1.0, so only indices cross the wire
-    host_feeds = [
-        pad_csr_batch(pool[i * batch : (i + 1) * batch], binary=True)["indices"]
-        for i in range(n_distinct)
-    ]
+    host_feeds = []
+    for i in range(n_distinct):
+        host_feeds.append(
+            pad_csr_batch(pool[i * batch : (i + 1) * batch], binary=True)["indices"])
+        if (i + 1) % 16 == 0:  # host prep heartbeat: TPU sizes pack ~600k rows
+            _phase(f"encode: packed {i + 1}/{n_distinct}")
     warmup_feeds = [
         pad_csr_batch(_make_pool(batch, np.random.default_rng(100 + i)),
                       binary=True)["indices"]
         for i in range(sz["warmup"])
     ]
 
+    _phase("encode: inputs packed; compiling + warmup")
     for i in range(sz["warmup"]):
         enc_fn(params, jax.device_put(warmup_feeds[i])).block_until_ready()
+    _phase("encode: warm")
 
     def one_pass(feeds):
         def put(i):
@@ -110,9 +130,11 @@ def _bench_encode(jax, params, config, sz):
     # best of three passes (each on its own distinct batches): single-chip-over-
     # tunnel timing jitters run to run, and peak sustained throughput is the
     # figure of merit for the stream design
-    dt = min(one_pass(host_feeds[p * n_batches : (p + 1) * n_batches])
-             for p in range(3))
-    return n_batches * batch / dt
+    dts = []
+    for p in range(3):
+        dts.append(one_pass(host_feeds[p * n_batches : (p + 1) * n_batches]))
+        _phase(f"encode: pass {p + 1}/3 done")
+    return n_batches * batch / min(dts)
 
 
 def _bench_train(jax, sz):
@@ -143,10 +165,12 @@ def _bench_train(jax, sz):
         "row_valid": jax.device_put(jnp.ones(tb, jnp.float32)),
     }
     key = jax.random.PRNGKey(2)
+    _phase("train: compiling + warmup")
     for i in range(sz["train_warmup"]):
         key, sub = jax.random.split(key)
         params, opt_state, metrics = step(params, opt_state, sub, batch)
     jax.block_until_ready(metrics)
+    _phase("train: warm")
 
     t0 = time.perf_counter()
     for i in range(sz["train_steps"]):
@@ -197,16 +221,20 @@ def _bench_train_stream(jax, sz):
             params, opt_state, metrics = step(params, opt_state, sub, b)
         jax.block_until_ready(metrics)
 
+    _phase("fit-stream: compiling + warm epoch")
     one_epoch()  # compile + warm caches
+    _phase("fit-stream: warm")
     t0 = time.perf_counter()
     epochs = sz["stream_epochs"]
-    for _ in range(epochs):
+    for i in range(epochs):
         one_epoch()
+        _phase(f"fit-stream: epoch {i + 1}/{epochs} done")
     dt = time.perf_counter() - t0
     return epochs * n_rows / dt
 
 
 def child_main():
+    _phase("child started; initializing backend")
     import jax
 
     # honor a parent-requested CPU fallback even under the axon site hook,
@@ -219,6 +247,7 @@ def child_main():
     from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
 
     platform = jax.devices()[0].platform
+    _phase(f"backend up: {platform}")
     sz = SIZES.get(platform, SIZES["cpu"])
 
     config = DAEConfig(
@@ -262,6 +291,84 @@ def _diag(attempt, note):
           file=sys.stderr, flush=True)
 
 
+def _run_child(argv, env, overall_timeout, noprogress_timeout=NOPROGRESS_TIMEOUT):
+    """Run a child under two clocks: an overall cap and a no-progress watchdog fed
+    by the child's output (_phase heartbeats — any stdout/stderr bytes count).
+    Returns (rc_or_None, stdout, stderr_tail, killed_reason_or_None).
+
+    Bounded-wall-time guarantees: pipes are read NON-blocking in raw chunks (a
+    partial line without a newline can never block the watchdog loop); the child
+    gets its own process group so the kill reaches helper processes that inherited
+    the pipe write-ends; and after a kill the drain loop has its own short
+    deadline rather than waiting for pipe EOF."""
+    import selectors
+
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            env=env, start_new_session=True)
+
+    def _kill():
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+
+    sel = selectors.DefaultSelector()
+    for f, tag in ((proc.stdout, "out"), (proc.stderr, "err")):
+        os.set_blocking(f.fileno(), False)
+        sel.register(f, selectors.EVENT_READ, tag)
+    bufs = {"out": [], "err": []}
+    start = last = time.monotonic()
+    killed = None
+    kill_deadline = None
+    open_streams = 2
+    while open_streams:
+        now = time.monotonic()
+        if killed is None and now - start > overall_timeout:
+            killed, kill_deadline = f"overall timeout {overall_timeout}s", now + 10
+            _kill()
+        elif killed is None and now - last > noprogress_timeout:
+            killed = f"no heartbeat for {noprogress_timeout}s"
+            kill_deadline = now + 10
+            _kill()
+        elif kill_deadline is not None and now > kill_deadline:
+            break  # a surviving grandchild is holding the pipes open; stop draining
+        for key, _ in sel.select(timeout=5):
+            chunk = key.fileobj.read(65536)
+            if chunk is None:  # readable raced to not-ready; harmless under O_NONBLOCK
+                continue
+            if chunk == b"":  # EOF (child exited or was killed)
+                sel.unregister(key.fileobj)
+                open_streams -= 1
+                continue
+            last = time.monotonic()
+            bufs[key.data].append(chunk)
+    sel.close()
+    rc = None
+    try:
+        # bounded even on the clean-EOF path: a child can close its pipes yet
+        # keep running, which must not escape the overall cap
+        # post-EOF no heartbeat is possible, so the tighter of the two clocks
+        # governs how long a pipe-closing-but-running child may linger
+        remaining = overall_timeout - (time.monotonic() - start)
+        rc = proc.wait(timeout=10 if killed else
+                       max(10.0, min(noprogress_timeout, remaining)))
+    except subprocess.TimeoutExpired:
+        if killed is None:
+            killed = "exit wait timed out after pipe EOF"
+        _kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+    if killed:
+        rc = None
+    stdout = b"".join(bufs["out"]).decode(errors="replace")
+    stderr = b"".join(bufs["err"]).decode(errors="replace")
+    return rc, stdout, stderr[-4000:], killed
+
+
 def _tpu_alive(attempt):
     """Cheap backend-init probe in a throwaway subprocess: a DEAD tunnel hangs
     at init (not at compute), so a 90s probe distinguishes 'a retry is worth
@@ -284,12 +391,14 @@ def main():
     """Parent: run the bench in fresh subprocesses (fresh JAX backend init each try),
     retry with backoff on flake, fall back to cpu on the final attempt.
 
-    Attempt 0 trusts the child outright (no probe cost on a healthy tunnel).
-    Retry attempts first probe backend init in a 90s throwaway subprocess — a
-    dead tunnel hangs at init, not compute — and a failed probe SKIPS that TPU
-    attempt (it never terminally settles for CPU: a transient probe flake must
-    not forfeit the TPU headline while retries remain). Only the forced final
-    attempt runs the CPU fallback, guaranteeing a non-empty record."""
+    EVERY TPU attempt is probe-gated: backend init is tried first in a 90s
+    throwaway subprocess — a dead tunnel hangs at init, not compute, and the
+    probe is 10x cheaper than discovering the hang via the child timeout
+    (attempt 0 probes once, keeping the healthy-tunnel fast path cheap; retries
+    probe twice so one transient probe flake can't forfeit the TPU headline
+    while retries remain). A probed-alive tunnel can still die mid-run, so the
+    child runs under the no-progress watchdog (_run_child). Only the forced
+    final attempt runs the CPU fallback, guaranteeing a non-empty record."""
     for attempt in range(ATTEMPTS):
         env = dict(os.environ)
         timeout_s = CHILD_TIMEOUT
@@ -298,25 +407,22 @@ def main():
             env["JAX_PLATFORMS"] = "cpu"
             timeout_s = CPU_CHILD_TIMEOUT
             _diag(attempt, "final attempt: falling back to JAX_PLATFORMS=cpu")
-        elif attempt > 0 and not (_tpu_alive(attempt) or _tpu_alive(attempt)):
-            # two probes per retry so one transient probe flake can't forfeit
-            # the TPU attempt; the probes' own wall time is the backoff
+        elif not (_tpu_alive(attempt)
+                  or (attempt > 0 and _tpu_alive(attempt))):
             continue
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                capture_output=True, text=True, timeout=timeout_s, env=env,
-            )
-        except subprocess.TimeoutExpired:
-            _diag(attempt, f"child timed out after {timeout_s}s")
+        rc, stdout, stderr_tail, killed = _run_child(
+            [sys.executable, os.path.abspath(__file__), "--child"], env, timeout_s)
+        if killed:
+            # the last phase heartbeat pinpoints WHERE the child hung
+            _diag(attempt, f"child killed: {killed}; stderr: {stderr_tail[-400:]}")
             continue
         line = next(
-            (ln for ln in reversed(proc.stdout.splitlines())
+            (ln for ln in reversed(stdout.splitlines())
              if ln.startswith('{"metric"')), None)
-        if proc.returncode == 0 and line:
+        if rc == 0 and line:
             print(line, flush=True)
             return 0
-        _diag(attempt, f"rc={proc.returncode} stderr: {proc.stderr[-400:]}")
+        _diag(attempt, f"rc={rc} stderr: {stderr_tail[-400:]}")
         if attempt < ATTEMPTS - 2:
             # backoff only when the NEXT attempt retries the tunnel; the final
             # CPU fallback doesn't depend on tunnel recovery

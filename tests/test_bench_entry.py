@@ -53,6 +53,50 @@ def test_bench_size_tables_consistent(bench):
     assert keys["tpu"] == keys["cpu"] == set(TINY)
 
 
+def test_run_child_kills_silent_child_fast(bench):
+    """A child that hangs without heartbeating (the dead-tunnel failure mode:
+    mute at backend init) must be killed by the no-progress watchdog in
+    ~noprogress_timeout, not the overall timeout."""
+    import time
+
+    t0 = time.monotonic()
+    rc, out, err, killed = bench._run_child(
+        [sys.executable, "-c", "import time; time.sleep(600)"],
+        dict(os.environ), overall_timeout=500, noprogress_timeout=3)
+    assert killed and "no heartbeat" in killed
+    assert rc is None
+    assert time.monotonic() - t0 < 60
+
+
+def test_run_child_passes_through_healthy_child(bench):
+    """Heartbeating children run to completion; stdout is captured in full."""
+    prog = ("import sys, time\n"
+            "for i in range(3):\n"
+            "    print('hb', file=sys.stderr, flush=True); time.sleep(0.2)\n"
+            "print('{\"metric\": \"x\", \"value\": 1}')\n")
+    rc, out, err, killed = bench._run_child(
+        [sys.executable, "-c", prog], dict(os.environ),
+        overall_timeout=60, noprogress_timeout=30)
+    assert killed is None and rc == 0
+    assert '{"metric"' in out
+    assert "hb" in err
+
+
+def test_run_child_overall_timeout(bench):
+    """A child that heartbeats forever still dies at the overall cap."""
+    import time
+
+    prog = ("import sys, time\n"
+            "while True:\n"
+            "    print('hb', file=sys.stderr, flush=True); time.sleep(0.5)\n")
+    t0 = time.monotonic()
+    rc, out, err, killed = bench._run_child(
+        [sys.executable, "-c", prog], dict(os.environ),
+        overall_timeout=4, noprogress_timeout=30)
+    assert killed and "overall timeout" in killed
+    assert time.monotonic() - t0 < 60
+
+
 def test_graft_entry_compiles():
     """entry() must return (jittable fn, example args) that actually compile
     and produce the flagship forward pass shapes."""
