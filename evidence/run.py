@@ -135,6 +135,60 @@ def _staged(name, fn):
     return out
 
 
+# the reference commits its evidence figures (the AUROC-comparison notebook's
+# persisted outputs); these are ours — a small committed subset of the driver's
+# ROC/boxplot PNGs, refreshed by every full evidence run
+FIGURES = ("similarity_boxplot_encoded(Category)",
+           "similarity_boxplot_encoded_validate(Category)",
+           "similarity_boxplot_tfidf_validate(Category)")
+
+
+def _export_figures(plot_dir, stage, platform):
+    """Copy the stage's headline ROC/boxplot figures into evidence/figures/
+    (tracked), with a provenance sidecar naming the run that produced them.
+    Stale figures from earlier runs of the same stage are pruned so the tracked
+    set never mixes runs; a missing source PNG is logged, not silently skipped."""
+    import shutil
+
+    fig_dir = os.path.join(HERE, "figures")
+    os.makedirs(fig_dir, exist_ok=True)
+    copied = []
+    for name in FIGURES:
+        src = os.path.join(plot_dir, name + ".png")
+        if not os.path.exists(src):
+            print(f"figures: WARNING — {stage} produced no {name}.png; "
+                  "not exported")
+            continue
+        dst = f"{stage}_{name}.png"
+        shutil.copyfile(src, os.path.join(fig_dir, dst))
+        copied.append(dst)
+    for f in os.listdir(fig_dir):
+        if (f.startswith(stage + "_") and f.endswith(".png")
+                and f not in copied):
+            os.remove(os.path.join(fig_dir, f))
+            print(f"figures: pruned stale {f} (not produced by this run)")
+    if copied:
+        stamp = datetime.datetime.now(datetime.timezone.utc).isoformat()
+        with open(os.path.join(fig_dir, f"{stage}.provenance.txt"), "w") as f:
+            print(f"stage={stage} platform={platform} seed={SEED} "
+                  f"generated={stamp}", file=f)
+            for c in copied:
+                print(c, file=f)
+    return copied
+
+
+def _check_figures(stage, names):
+    """A stage resumed from cache exports nothing — verify its previously
+    exported figures are still on disk, so RESULTS.md can't claim figures that
+    a clean wiped."""
+    fig_dir = os.path.join(HERE, "figures")
+    missing = [n for n in names if not os.path.exists(os.path.join(fig_dir, n))]
+    if missing:
+        print(f"figures: WARNING — {stage} resumed from cache but its "
+              f"exported figures are missing from evidence/figures/: {missing}."
+              " Delete evidence/.stage_cache.json and rerun to regenerate.")
+
+
 def main():
     t0 = time.time()
     import jax
@@ -153,8 +207,15 @@ def main():
     cwd = os.getcwd()
     os.chdir(scratch)
     try:
-        aurocs = _staged("online-mining driver",
-                         lambda: main_autoencoder(MAIN_ARGS)[1])
+        def _main_stage():
+            model, out = main_autoencoder(MAIN_ARGS)
+            return {"aurocs": out,
+                    "figures": _export_figures(model.plot_dir, "online",
+                                               platform)}
+
+        main_out = _staged("online-mining driver", _main_stage)
+        aurocs = main_out["aurocs"]
+        _check_figures("online-mining driver", main_out.get("figures", []))
         tri_aurocs = _staged("precomputed-triplet driver",
                              lambda: main_triplet(TRIPLET_ARGS)[1])
 
@@ -171,12 +232,15 @@ def main():
 
         def _ref():
             t_ref = time.time()
-            out = main_autoencoder(REFSCALE_ARGS)[1]
-            return {"aurocs": out, "wall": time.time() - t_ref}
+            model, out = main_autoencoder(REFSCALE_ARGS)
+            return {"aurocs": out, "wall": time.time() - t_ref,
+                    "figures": _export_figures(model.plot_dir, "refscale",
+                                               platform)}
 
         ref = _staged("reference-scale run (8000 x 10000 -> 500, bf16, "
                       "streaming eval)", _ref)
         ref_aurocs, t_ref = ref["aurocs"], ref["wall"]
+        _check_figures("reference-scale run", ref.get("figures", []))
     finally:
         os.chdir(cwd)
 
@@ -265,7 +329,9 @@ def _write_md(p):
         "The real UCI parquet is stripped from this environment "
         "(`/root/reference/.MISSING_LARGE_BLOBS`), so this is the seeded "
         "synthetic-corpus record — the same shape of evidence the reference "
-        "commits in `starspace/train.log` and its AUROC-comparison notebook.",
+        "commits in `starspace/train.log` and its AUROC-comparison notebook. "
+        "Headline ROC/boxplot figures from the runs are committed under "
+        "`evidence/figures/` (provenance sidecars name the producing run).",
         "",
         "## Online-mining driver: 12 AUROCs",
         "",
