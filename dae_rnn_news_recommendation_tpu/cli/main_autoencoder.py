@@ -157,7 +157,8 @@ def main(argv=None):
         alpha=FLAGS.alpha, triplet_strategy=FLAGS.triplet_strategy,
         n_devices=FLAGS.n_devices, mining_scope=FLAGS.mining_scope,
         compute_dtype=FLAGS.compute_dtype, checkpoint_every=FLAGS.checkpoint_every,
-        profile=FLAGS.profile, sparse_feed=bool(FLAGS.sparse_feed))
+        profile=FLAGS.profile, sparse_feed=bool(FLAGS.sparse_feed),
+        weight_update_sharding=FLAGS.weight_update_sharding)
 
     (article_contents, X, X_validate, X_tfidf, X_tfidf_validate,
      labels) = prepare_or_restore_data(model, FLAGS)

@@ -42,7 +42,8 @@ def main(argv=None):
         num_epochs=FLAGS.num_epochs, batch_size=FLAGS.batch_size, alpha=FLAGS.alpha,
         n_devices=FLAGS.n_devices, compute_dtype=FLAGS.compute_dtype,
         checkpoint_every=FLAGS.checkpoint_every, profile=FLAGS.profile,
-        sparse_feed=bool(FLAGS.sparse_feed))
+        sparse_feed=bool(FLAGS.sparse_feed),
+        weight_update_sharding=FLAGS.weight_update_sharding)
 
     train_row, validate_row = FLAGS.train_row, FLAGS.validate_row
 

@@ -62,7 +62,8 @@ class DenoisingAutoencoder:
                  compute_dtype="float32", checkpoint_every=0, val_batch_size=512,
                  n_devices=1, mesh=None, mining_scope="global", results_root="results",
                  use_tensorboard=True, n_components=None, profile=False,
-                 prefetch_depth=2, keep_checkpoint_max=0, sparse_feed=True):
+                 prefetch_depth=2, keep_checkpoint_max=0, sparse_feed=True,
+                 weight_update_sharding=False):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
 
         :param n_components: explicit code size; overrides the compress_factor
@@ -117,6 +118,9 @@ class DenoisingAutoencoder:
         # densify on device (data/batcher.SparseIngestBatcher) — ~50x fewer
         # host->device bytes at news-corpus density, identical math
         self.sparse_feed = sparse_feed
+        # shard optimizer accumulators over the data axis (ZeRO-1 style,
+        # parallel/dp.py:opt_state_shardings) — 1/N optimizer memory per device
+        self.weight_update_sharding = weight_update_sharding
 
         assert isinstance(self.verbose_step, int)
         assert self.verbose >= 0
@@ -213,7 +217,8 @@ class DenoisingAutoencoder:
             self._train_step = make_parallel_train_step(
                 self.config, self.optimizer, self.mesh,
                 mining_scope=self.mining_scope, loss_fn=self._loss_fn,
-                model_axis=model_axis)
+                model_axis=model_axis,
+                weight_update_sharding=self.weight_update_sharding)
             self._eval_step = make_parallel_eval_step(
                 self.config, self.mesh, mining_scope=self.mining_scope,
                 loss_fn=self._loss_fn, model_axis=model_axis)

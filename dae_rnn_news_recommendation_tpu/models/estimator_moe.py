@@ -44,6 +44,11 @@ class MoEDenoisingAutoencoder(DenoisingAutoencoder):
         :param router_weight: weight of the Switch load-balance auxiliary loss
         Everything else: see DenoisingAutoencoder."""
         super().__init__(algo_name=algo_name, **kwargs)
+        if self.weight_update_sharding:
+            raise ValueError(
+                "weight_update_sharding applies to the data-parallel estimator "
+                "(parallel/dp.py); the expert-parallel mixture already shards "
+                "its optimizer state with the per-device expert params")
         assert int(n_experts) >= 1
         self.n_experts = int(n_experts)
         self.capacity_factor = float(capacity_factor)

@@ -47,6 +47,29 @@ def param_shardings(mesh, model_axis=None):
     }
 
 
+def opt_state_shardings(opt_state, mesh, data_axis="data"):
+    """Cross-replica weight-update sharding (the XLA data-parallel optimization
+    of arXiv:2004.13336, ZeRO-1 style): optimizer accumulators shard their
+    leading axis over the DATA axis, so per-device optimizer memory scales 1/N
+    and XLA lowers the gradient all-reduce + update into reduce_scatter ->
+    sharded update -> all_gather (same bytes on the wire as the all-reduce, the
+    update math computed once per shard instead of N times).
+
+    Leaves whose leading dim doesn't divide by the axis size (scalars like
+    optax counts, small biases on awkward meshes) stay replicated — sharding is
+    per-leaf, purely a layout annotation, and changes no math."""
+    n = mesh.shape[data_axis]
+
+    def leaf_sharding(leaf):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] % n == 0 and \
+                leaf.shape[0] > 0:
+            return NamedSharding(mesh, P(data_axis,
+                                         *([None] * (leaf.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(leaf_sharding, opt_state)
+
+
 def _key_spec(k, data_axis="data", model_axis=None):
     """PartitionSpec for one batch key."""
     if k in _ROW_MATRICES:
@@ -66,20 +89,35 @@ def batch_shardings(mesh, keys, data_axis="data", model_axis=None):
 
 def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
                              loss_fn=loss_and_metrics, data_axis="data",
-                             model_axis=None, donate=True):
+                             model_axis=None, donate=True,
+                             weight_update_sharding=False):
     """Returns step(params, opt_state, key, batch) -> (params, opt_state, metrics).
 
     Inputs may be ordinary host arrays; jit's in_shardings place them on the mesh.
+
+    :param weight_update_sharding: shard optimizer state over the data axis
+        (opt_state_shardings) — 'global' mining scope on a 1-D data mesh only
+        (with a model axis the state follows W's own sharding instead).
     """
     if mining_scope == "global":
+        if weight_update_sharding and model_axis is not None:
+            raise ValueError("weight_update_sharding shards opt state over the "
+                             "data axis; with a model axis the state already "
+                             "shards with W — use one or the other")
         return _make_global_step(config, optimizer, mesh, loss_fn, data_axis,
-                                 model_axis, donate)
+                                 model_axis, donate,
+                                 weight_update_sharding=weight_update_sharding)
     if mining_scope == "shard":
+        if weight_update_sharding:
+            raise ValueError("weight_update_sharding requires the jit/global "
+                             "path (XLA derives the reduce_scatter); "
+                             "mining_scope='shard' runs inside shard_map")
         return _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate)
     raise ValueError(f"unknown mining_scope: {mining_scope!r}")
 
 
-def _make_global_step(config, optimizer, mesh, loss_fn, data_axis, model_axis, donate):
+def _make_global_step(config, optimizer, mesh, loss_fn, data_axis, model_axis,
+                      donate, weight_update_sharding=False):
     def step(params, opt_state, key, batch):
         (cost, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, batch, key, config)
@@ -95,7 +133,10 @@ def _make_global_step(config, optimizer, mesh, loss_fn, data_axis, model_axis, d
         sig = tuple(sorted(batch.keys()))
         if sig not in cache:
             b_sh = batch_shardings(mesh, sig, data_axis, model_axis)
-            o_sh = jax.tree_util.tree_map(lambda _: rep, opt_state)
+            if weight_update_sharding:
+                o_sh = opt_state_shardings(opt_state, mesh, data_axis)
+            else:
+                o_sh = jax.tree_util.tree_map(lambda _: rep, opt_state)
             cache[sig] = jax.jit(
                 step,
                 in_shardings=(p_sh, o_sh, rep, b_sh),

@@ -101,6 +101,12 @@ def build_parser(triplet_mode=False):
                         "this size (the max_features=50k layout); must divide "
                         "--n_devices, and requires mining_scope=global")
     p.add_argument("--mining_scope", default="global", choices=["global", "shard"])
+    p.add_argument("--weight_update_sharding", action="store_true", default=False,
+                   help="shard optimizer accumulators over the data axis "
+                        "(ZeRO-1-style cross-replica weight-update sharding, "
+                        "arXiv:2004.13336) — 1/n_devices optimizer memory per "
+                        "device, identical math; requires mining_scope=global "
+                        "on a 1-D data mesh")
     p.add_argument("--compute_dtype", default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--checkpoint_every", type=int, default=0)
