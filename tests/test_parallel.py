@@ -127,6 +127,35 @@ def test_weight_update_sharding_matches_replicated():
         assert leaf.addressable_shards[0].data.shape[0] == F // 8
 
 
+def test_weight_update_sharding_checkpoint_resume(tmp_path, monkeypatch):
+    """Mid-run checkpoints gather the 1/N-sharded accumulators to host and a
+    resumed fit reshards them on entry — the full estimator save/restore loop
+    must work under weight_update_sharding, continuing the epoch schedule."""
+    import os
+
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.default_rng(0)
+    X = (rng.uniform(size=(64, 40)) < 0.2).astype(np.float32)
+    kwargs = dict(model_name="wus", main_dir="wus", compress_factor=10,
+                  batch_size=16, verbose=False, triplet_strategy="none",
+                  loss_func="mean_squared", dec_act_func="none",
+                  enc_act_func="tanh", opt="ada_grad", learning_rate=0.1,
+                  n_devices=8, weight_update_sharding=True, seed=0)
+    m1 = DenoisingAutoencoder(num_epochs=3, checkpoint_every=1, **kwargs)
+    m1.fit(X)
+    assert os.path.isdir(m1.model_path)
+
+    m2 = DenoisingAutoencoder(num_epochs=5, checkpoint_every=0, **kwargs)
+    m2.fit(X, restore_previous_model=True)
+    assert m2._epoch0 == 3
+    # the resumed opt state is sharded again after the first resumed step
+    leaves = [l for l in jax.tree_util.tree_leaves(m2.opt_state)
+              if getattr(l, "ndim", 0) >= 1 and l.shape[0] % 8 == 0]
+    assert leaves and all(l.sharding.spec[0] == "data" for l in leaves)
+
+
 def test_weight_update_sharding_rejects_bad_combos():
     cfg, params, optimizer, opt_state, batch = _setup("none")
     mesh2d = get_mesh_2d(2, 4)
