@@ -183,6 +183,45 @@ def test_batch_hard_padding_masks_rows(num_classes, rng):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.parametrize("case", range(12))
+def test_fuzz_mining_vs_oracle(case):
+    """Seeded fuzz across random (B, D, n_classes, padding) extremes — minimal
+    batches, D=1, all-unique labels, heavy padding — each checked against the
+    brute-force oracles (padding by comparing to the oracle on the real rows,
+    with padded embeddings zero as in the real model, encode(0) == 0)."""
+    r = np.random.default_rng(1000 + case)
+    b = int(r.integers(3, 25))
+    d = int(r.integers(1, 17))
+    n_classes = int(r.integers(1, b + 1))
+    pad = int(r.integers(0, b // 2 + 1))
+    labels = r.integers(0, n_classes, size=b).astype(np.int32)
+    embed = r.normal(size=(b, d)).astype(np.float32)
+
+    labels_p = np.concatenate([labels, np.full(pad, -1, np.int32)])
+    embed_p = np.concatenate([embed, np.zeros((pad, d), np.float32)])
+    valid = np.concatenate([np.ones(b), np.zeros(pad)]).astype(np.float32)
+
+    pos_only = bool(case % 2)
+    e_loss, e_w, e_frac, e_num = _oracle_batch_all(labels, embed, pos_only)
+    loss, w, frac, num, _ = T.batch_all_triplet_loss(
+        jnp.asarray(labels_p), jnp.asarray(embed_p),
+        pos_triplets_only=pos_only, row_valid=jnp.asarray(valid))
+    np.testing.assert_allclose(float(loss), e_loss, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w)[:b], e_w, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w)[b:], 0.0)
+    np.testing.assert_allclose(float(frac), e_frac, rtol=1e-4, atol=1e-7)
+    assert int(num) == e_num
+
+    e_loss, e_w, e_frac, e_num = _oracle_batch_hard(labels, embed)
+    loss, w, frac, num, _ = T.batch_hard_triplet_loss(
+        jnp.asarray(labels_p), jnp.asarray(embed_p), row_valid=jnp.asarray(valid))
+    np.testing.assert_allclose(float(loss), e_loss, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w)[:b], e_w, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w)[b:], 0.0)
+    np.testing.assert_allclose(float(frac), e_frac, rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(float(num), e_num, rtol=1e-5)
+
+
 def test_precomputed_triplet_loss(rng):
     a = rng.normal(size=(B, D)).astype(np.float32)
     p = rng.normal(size=(B, D)).astype(np.float32)
