@@ -41,9 +41,10 @@ F, D = 10_000, 500
 NNZ_PER_ROW = 200  # ~2% density, UCI-news-like
 
 # Workload sizes per platform: the TPU sizes are the headline measurement; the
-# CPU fallback keeps the same metric definitions but must FINISH inside
-# CPU_CHILD_TIMEOUT (measured 2026-07: ~50s; the TPU sizes run >15 min on this
-# host's CPU, which would zero the round record whenever the tunnel is down).
+# CPU fallback keeps the same metric definitions (and the 10k->500 shape) but
+# must FINISH inside CPU_CHILD_TIMEOUT (observed: 390-415s, dominated by the
+# three XLA compiles; the TPU sizes run >15 min on this host's CPU, which
+# would zero the round record whenever the tunnel is down).
 SIZES = {
     "tpu": dict(batch=8192, n_batches=24, warmup=3, prefetch=4,
                 train_batch=800, train_steps=30, train_warmup=3,
@@ -56,7 +57,10 @@ SIZES = {
 ATTEMPTS = 3          # last attempt forces the CPU fallback
 BACKOFFS = (5, 15)
 CHILD_TIMEOUT = 900   # per TPU attempt (healthy tunnel runs need the headroom)
-CPU_CHILD_TIMEOUT = 420
+CPU_CHILD_TIMEOUT = 600  # observed CPU child wall: 390-415s (3 XLA compiles
+                         # at the 10k-feature shape dominate); 420 left a
+                         # 5-30s margin — one slow compile away from an empty
+                         # round record on the forced final attempt
 PROBE_TIMEOUT = 90    # backend-init probe before each TPU attempt
 # kill a child that stops heartbeating: the largest legitimate silent gap is one
 # backend init or one XLA compile (~30-120s observed); a mid-run tunnel death is
