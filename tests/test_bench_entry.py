@@ -3,6 +3,7 @@ functions and __graft_entry__.entry() are exercised here on CPU with tiny
 workloads (round 1 lost its headline record to exactly this kind of rot)."""
 
 import importlib.util
+import json
 import os
 import sys
 
@@ -95,6 +96,83 @@ def test_run_child_overall_timeout(bench):
         overall_timeout=4, noprogress_timeout=30)
     assert killed and "overall timeout" in killed
     assert time.monotonic() - t0 < 60
+
+
+def _scripted_main(bench, monkeypatch, probe_script, child_script):
+    """Run bench.main() with _tpu_alive/_run_child replaced by scripted fakes.
+    Returns (rc, printed_metric_lines, child_call_envs). Script lengths are
+    exact: an extra probe or child call raises StopIteration and fails the
+    test, so the attempt sequencing is enforced, not just observed."""
+    probes = iter(probe_script)
+    children = iter(child_script)
+    envs = []
+
+    monkeypatch.setattr(bench, "_tpu_alive", lambda attempt: next(probes))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    def fake_run_child(argv, env, overall_timeout, noprogress_timeout=None):
+        envs.append(dict(env))
+        return next(children)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    printed = []
+    monkeypatch.setattr("builtins.print",
+                        lambda *a, **k: printed.append(" ".join(map(str, a))))
+    rc = bench.main()
+    metric_lines = [ln for ln in printed if ln.startswith('{"metric"')]
+    return rc, metric_lines, envs
+
+
+METRIC = '{"metric": "encode_articles_per_sec", "value": 1.0}'
+
+
+def test_main_dead_tunnel_falls_back_to_cpu(bench, monkeypatch):
+    """All probes fail -> no TPU child ever runs; the forced final attempt runs
+    the CPU child and its metric line is the result."""
+    rc, lines, envs = _scripted_main(
+        bench, monkeypatch,
+        probe_script=[False, False, False],       # attempt0: 1 probe; attempt1: 2
+        child_script=[(0, METRIC + "\n", "", None)])
+    assert rc == 0 and lines == [METRIC]
+    assert len(envs) == 1 and envs[0].get("JAX_PLATFORMS") == "cpu"
+
+
+def test_main_healthy_tunnel_first_try(bench, monkeypatch):
+    """Probe passes -> one TPU child, its metric is printed, no fallback."""
+    rc, lines, envs = _scripted_main(
+        bench, monkeypatch,
+        probe_script=[True],
+        child_script=[(0, "noise\n" + METRIC + "\n", "", None)])
+    assert rc == 0 and lines == [METRIC]
+    # exactly one child ran, and it was not the forced CPU fallback (which
+    # SETS JAX_PLATFORMS=cpu; the ambient test env may already carry it)
+    assert len(envs) == 1
+    assert envs[0].get("JAX_PLATFORMS") == os.environ.get("JAX_PLATFORMS")
+
+
+def test_main_killed_child_retries_then_falls_back(bench, monkeypatch):
+    """Attempt 0's child is killed by the watchdog; attempt 1's probes fail;
+    the final CPU attempt still lands a number."""
+    rc, lines, envs = _scripted_main(
+        bench, monkeypatch,
+        probe_script=[True, False, False],
+        child_script=[(None, "", "phase: train", "no heartbeat for 300s"),
+                      (0, METRIC + "\n", "", None)])
+    assert rc == 0 and lines == [METRIC]
+    assert len(envs) == 2 and envs[1].get("JAX_PLATFORMS") == "cpu"
+
+
+def test_main_total_failure_emits_zero_record(bench, monkeypatch):
+    """Even when every attempt fails, ONE parseable zero-value record is
+    emitted and rc is nonzero — the round record is never empty."""
+    rc, lines, envs = _scripted_main(
+        bench, monkeypatch,
+        probe_script=[True, True, True],
+        child_script=[(1, "", "boom", None), (1, "", "boom", None),
+                      (1, "", "boom", None)])
+    assert rc == 1 and len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["value"] == 0.0 and "metric" in rec
 
 
 def test_graft_entry_compiles():
