@@ -18,6 +18,8 @@ Rows are padded to K nonzeros (multiple of `k_multiple` for stable XLA shapes);
 padding entries point at index 0 with value 0, so they contribute nothing.
 """
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -152,6 +154,15 @@ def sparse_encode_matmul(w, indices, values=None, chunk=256,
         return jnp.einsum("ckd,ck->cd", g, c_vals, precision=precision)
 
     if b % chunk != 0:  # single ragged tail chunk: fall back to one unchunked pass
+        # chunk was clamped to min(chunk, b), so a non-divisible b means
+        # b > chunk: the fallback materializes the full [B, K, D] gather at
+        # once, losing the chunked [chunk, K, D] memory bound — loud at trace
+        # time so a frequently-ragged B doesn't silently regress memory
+        warnings.warn(
+            f"sparse_encode_matmul: batch {b} not divisible by chunk "
+            f"{chunk}; running unchunked (peak gather memory ~"
+            f"{b / chunk:.1f}x the chunked bound). Pad B or pick a "
+            "divisor chunk.", stacklevel=2)
         return contract(idx, vals)
 
     idx_c = idx.reshape(b // chunk, chunk, -1)

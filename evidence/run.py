@@ -180,13 +180,20 @@ def _export_figures(plot_dir, stage, platform):
                 and f not in copied):
             os.remove(os.path.join(fig_dir, f))
             print(f"figures: pruned stale {f} (not produced by this run)")
+    prov = os.path.join(fig_dir, f"{stage}.provenance.txt")
     if copied:
         stamp = datetime.datetime.now(datetime.timezone.utc).isoformat()
-        with open(os.path.join(fig_dir, f"{stage}.provenance.txt"), "w") as f:
+        with open(prov, "w") as f:
             print(f"stage={stage} platform={platform} seed={SEED} "
                   f"generated={stamp}", file=f)
             for c in copied:
                 print(c, file=f)
+    elif os.path.exists(prov):
+        # this run produced no figures and the pruning above removed the old
+        # ones — a surviving sidecar would list files that no longer exist
+        os.remove(prov)
+        print(f"figures: removed stale {stage}.provenance.txt "
+              "(no figures produced by this run)")
     return copied
 
 

@@ -177,3 +177,25 @@ def test_sparse_encode_via_dense_matches_gather(csr, binary):
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(dense), np.asarray(gather),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_ragged_tail_fallback_warns_loudly():
+    """A batch not divisible by chunk silently lost the chunked [c, K, D]
+    memory bound; the unchunked fallback must announce itself at trace time
+    (VERDICT r2 item 10) — while a batch smaller than one chunk stays quiet
+    (chunk clamps to b, so the batch is divisible and never hits the
+    fallback)."""
+    import warnings
+
+    w = jnp.ones((50, 8), jnp.float32)
+    ragged = jnp.zeros((7, 3), jnp.int32)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        SI.sparse_encode_matmul(w, ragged, jnp.ones((7, 3)), chunk=2)
+    assert any("not divisible by chunk" in str(r.message) for r in rec)
+
+    small = jnp.zeros((3, 3), jnp.int32)  # b < chunk: chunk clamps to b
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        SI.sparse_encode_matmul(w, small, jnp.ones((3, 3)), chunk=8)
+    assert not any("not divisible" in str(r.message) for r in rec)
