@@ -225,11 +225,26 @@ class DenoisingAutoencoder:
             # rows shard over the data axis only — pad batches to that extent
             self._batch_multiple = int(self.mesh.shape.get("data",
                                                            self.mesh.devices.size))
+            self._model_axis = model_axis
+            # under jax.distributed each process batches ITS OWN rows and the
+            # feed stitches them into one global jax.Array (parallel/feed.py)
+            # — jit can't place plain host arrays across processes; params /
+            # opt state become explicitly replicated global arrays the same way
+            self._multiprocess = jax.process_count() > 1
+            if self._multiprocess:
+                from ..parallel.feed import put_replicated
+
+                host = jax.tree_util.tree_map(np.asarray,
+                                              (self.params, self.opt_state))
+                self.params = put_replicated(host[0], self.mesh)
+                self.opt_state = put_replicated(host[1], self.mesh)
         else:
             self._train_step = make_train_step(self.config, self.optimizer,
                                                loss_fn=self._loss_fn)
             self._eval_step = make_eval_step(self.config, loss_fn=self._loss_fn)
             self._batch_multiple = 1
+            self._model_axis = None
+            self._multiprocess = False
         self._encode_fn = make_encode_fn(self.config)
         self._sparse_encode_fn = None  # built lazily per config in transform()
 
@@ -263,13 +278,21 @@ class DenoisingAutoencoder:
         # sparse rows are densified into padded shards by the batcher either way
         self.sparse_input = not isinstance(train_set, np.ndarray)
         self._build(n_features, restore_previous_model)
-        write_parameter_file(self.parameter_file, self._parameter_dict(),
-                             append=restore_previous_model)
+        # multi-process: metrics are replicated, so process 0 owns the shared
+        # log/parameter files; other processes log under a proc{i}/ subdir
+        # (debuggable, never racing on one file)
+        proc_sub = ("" if not self._multiprocess or jax.process_index() == 0
+                    else f"proc{jax.process_index()}/")
+        if not proc_sub:
+            write_parameter_file(self.parameter_file, self._parameter_dict(),
+                                 append=restore_previous_model)
 
-        train_writer = MetricsWriter(os.path.join(self.tf_summary_dir, "train/"),
-                                     self.use_tensorboard)
-        val_writer = MetricsWriter(os.path.join(self.tf_summary_dir, "validation/"),
-                                   self.use_tensorboard)
+        train_writer = MetricsWriter(
+            os.path.join(self.tf_summary_dir, proc_sub + "train/"),
+            self.use_tensorboard)
+        val_writer = MetricsWriter(
+            os.path.join(self.tf_summary_dir, proc_sub + "validation/"),
+            self.use_tensorboard)
         extremes = self._data_extremes(train_set)
         seed = self.seed if self.seed is not None and self.seed >= 0 else None
         batcher = self._feed_batcher(train_set)(
@@ -377,6 +400,7 @@ class DenoisingAutoencoder:
             for batch in prefetch(batcher.epoch(train_set, labels),
                                   self.prefetch_depth):
                 batch.update(extremes)
+                batch = self._place_batch(batch)
                 self._key, sub = jax.random.split(self._key)
                 self.params, self.opt_state, metrics = self._train_step(
                     self.params, self.opt_state, sub, batch)
@@ -434,6 +458,18 @@ class DenoisingAutoencoder:
             return TripletSparseIngestBatcher
         return self._batcher_cls
 
+    def _place_batch(self, batch):
+        """Single process: hand the host batch straight to jit (its
+        in_shardings own the transfer — measured faster over the TPU tunnel
+        than an explicit device_put, see bench.py). Multi-process: every
+        process holds only its local rows, so stitch them into the global
+        row-sharded jax.Array via parallel/feed.py."""
+        if not self._multiprocess:
+            return batch
+        from ..parallel.feed import put_sharded_batch
+
+        return put_sharded_batch(batch, self.mesh, model_axis=self._model_axis)
+
     def _validation_batches(self, validation_set, validation_set_label):
         n = (validation_set["org"] if isinstance(validation_set, dict) else validation_set).shape[0]
         b = min(self.val_batch_size, n)
@@ -465,6 +501,7 @@ class DenoisingAutoencoder:
 
         sums, rows = {}, 0.0
         for batch in self._validation_batches(validation_set, validation_set_label):
+            batch = self._place_batch(batch)
             metrics = self._eval_step(self.params, batch)
             n = float(batch["row_valid"].sum())
             for k, v in metrics.items():
@@ -487,6 +524,14 @@ class DenoisingAutoencoder:
         restore wait for in-flight writes first."""
         state = {"params": self.params, "opt_state": self.opt_state,
                  "epoch": np.asarray(epoch)}
+        if getattr(self, "_multiprocess", False):
+            # pod path: one SHARED checkpoint dir, every process participates
+            # in the collective orbax save of the global arrays (blocking —
+            # a background thread must not issue collectives out of order)
+            if getattr(self, "_async_ckpt", None) is not None:
+                self._async_ckpt.wait()
+            save_checkpoint(self.model_path, state, epoch, multiprocess=True)
+            return
         if getattr(self, "_async_ckpt", None) is None:
             self._async_ckpt = AsyncCheckpointer()
         if not blocking:

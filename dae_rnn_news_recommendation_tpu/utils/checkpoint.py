@@ -26,24 +26,38 @@ except Exception:  # pragma: no cover
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
-def save_checkpoint(ckpt_dir, state, step, use_orbax=True):
-    """Save {'params':…, 'opt_state':…, 'epoch':…} at `step`; returns the path."""
+def save_checkpoint(ckpt_dir, state, step, use_orbax=True, multiprocess=False):
+    """Save {'params':…, 'opt_state':…, 'epoch':…} at `step`; returns the path.
+
+    `multiprocess=True` is the pod path: EVERY process calls this with the same
+    shared `ckpt_dir` and its (replicated or sharded) global jax.Arrays; orbax
+    coordinates the collective save (the primary host finalizes — per-process
+    private dirs would never commit on non-primary hosts), and the numpy
+    sidecars are written by process 0 only."""
     base = os.path.abspath(os.path.join(ckpt_dir, f"step_{step}"))
     os.makedirs(base, exist_ok=True)
+    primary = not multiprocess or jax.process_index() == 0
 
     params_path = os.path.join(base, "params")
     if use_orbax and ocp is not None:
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(params_path, state["params"], force=True)
         ckptr.wait_until_finished()
-    else:
+    elif primary:
         leaves, _ = jax.tree_util.tree_flatten(state["params"])
         np.savez(params_path + ".npz", *[np.asarray(x) for x in leaves])
 
-    opt_leaves, _ = jax.tree_util.tree_flatten(state.get("opt_state"))
-    np.savez(os.path.join(base, "aux.npz"),
-             *[np.asarray(x) for x in opt_leaves],
-             epoch=np.asarray(int(state.get("epoch", 0))))
+    if primary:
+        opt_leaves, _ = jax.tree_util.tree_flatten(state.get("opt_state"))
+        np.savez(os.path.join(base, "aux.npz"),
+                 *[np.asarray(x) for x in opt_leaves],
+                 epoch=np.asarray(int(state.get("epoch", 0))))
+    if multiprocess:
+        from jax.experimental import multihost_utils
+
+        # no process may return (and possibly restore) before the sidecars
+        # and the orbax commit are durable everywhere
+        multihost_utils.sync_global_devices(f"ckpt_{ckpt_dir}_{step}")
     return base
 
 

@@ -114,6 +114,86 @@ _WORKER = textwrap.dedent("""
 """)
 
 
+# end-to-end pod path (VERDICT r2 item 7): a 2-process DenoisingAutoencoder
+# .fit() — each process batches its LOCAL rows, the estimator stitches them
+# into global arrays via parallel/feed.py, trains collectively, checkpoints
+# with orbax per process, restores ACROSS processes, and resumes training
+_FIT_WORKER = textwrap.dedent("""
+    import os, sys
+    pid, port, repo, workdir = (int(sys.argv[1]), sys.argv[2], sys.argv[3],
+                                sys.argv[4])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from dae_rnn_news_recommendation_tpu.parallel import (
+        get_mesh, initialize_multihost)
+
+    initialize_multihost(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=2, process_id=pid)
+    assert len(jax.devices()) == 4
+    os.chdir(workdir)
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+    from dae_rnn_news_recommendation_tpu.utils.checkpoint import (
+        latest_checkpoint, load_checkpoint)
+
+    b, f = 32, 20  # global rows; each process owns half
+    rng = np.random.default_rng(0)  # same stream both processes
+    X = (rng.uniform(size=(b, f)) < 0.3).astype(np.float32)
+    y = rng.integers(0, 4, b).astype(np.int32)
+    lo, hi = pid * (b // 2), (pid + 1) * (b // 2)
+
+    def make_model(num_epochs):
+        # ONE shared artifact tree: orbax checkpoints are saved collectively
+        # (every process calls save on the same dir; the primary finalizes),
+        # process 0 owns the shared logs, others log under proc{i}/
+        return DenoisingAutoencoder(
+            model_name="mh", main_dir="mh/", results_root="results_shared",
+            num_epochs=num_epochs, batch_size=8, opt="ada_grad",
+            learning_rate=0.1, corr_type="masking", corr_frac=0.3,
+            triplet_strategy="batch_all", alpha=1.0, seed=0,
+            verbose=False, verbose_step=10, checkpoint_every=1,
+            mesh=get_mesh(4), mining_scope="global")
+
+    model = make_model(num_epochs=2)
+    model.fit(X[lo:hi], train_set_label=y[lo:hi])
+    own = jax.tree_util.tree_map(np.asarray, model.params)
+
+    # both processes' replicated params must agree bit-for-bit: training was
+    # one collective computation
+    gathered = multihost_utils.process_allgather(own["W"])
+    np.testing.assert_array_equal(gathered[0], gathered[1])
+
+    # every process restores the collectively written checkpoint and must
+    # find the identical replicated state
+    ckpt_dir = os.path.join("results_shared", "dae", "mh", "models", "mh")
+    path, step = latest_checkpoint(ckpt_dir)
+    assert path is not None and step == 2, (ckpt_dir, path, step)
+    like = {"params": own,
+            "opt_state": jax.tree_util.tree_map(np.asarray, model.opt_state),
+            "epoch": np.asarray(0)}
+    restored = load_checkpoint(path, like)
+    np.testing.assert_allclose(restored["params"]["W"], own["W"], atol=0)
+    assert int(restored["epoch"]) == 2
+
+    # resume through the same multi-process feed: epoch counter continues
+    model2 = make_model(num_epochs=1)
+    model2.fit(X[lo:hi], train_set_label=y[lo:hi],
+               restore_previous_model=True)
+    assert model2._epoch0 == 2, model2._epoch0
+    _, step2 = latest_checkpoint(ckpt_dir)
+    assert step2 == 3, step2
+    print("MULTIHOST_FIT_OK", pid, flush=True)
+""")
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -155,3 +235,45 @@ def test_two_process_distributed_psum(tmp_path):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
     assert "MULTIHOST_OK 0" in joined and "MULTIHOST_OK 1" in joined
+
+
+def test_two_process_end_to_end_fit(tmp_path):
+    """The exact pod path: fit() with process-local feeding, collective
+    training, per-process orbax checkpoints, cross-process restore, resume."""
+    try:
+        port = _free_port()
+    except OSError:
+        pytest.skip("sandbox forbids sockets")
+    worker = tmp_path / "fit_worker.py"
+    worker.write_text(_FIT_WORKER)
+    workdir = tmp_path / "run"
+    workdir.mkdir()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen([sys.executable, str(worker), str(pid), str(port),
+                          repo, str(workdir)],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost fit workers timed out; partial output: "
+                    + " | ".join(outs))
+
+    joined = "\n".join(outs)
+    if any(p.returncode != 0 for p in procs) and (
+            "gloo" in joined.lower() and "unavailable" in joined.lower()):
+        pytest.skip("gloo collectives backend unavailable")
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    assert "MULTIHOST_FIT_OK 0" in joined and "MULTIHOST_FIT_OK 1" in joined
