@@ -51,7 +51,8 @@ def prepare_or_restore_data(model, FLAGS):
         return article_contents, X, X_validate, X_tfidf, X_tfidf_validate, labels
 
     if FLAGS.synthetic:
-        n = train_row + validate_row
+        n = int((train_row + validate_row)
+                * max(getattr(FLAGS, "synthetic_oversample", 1.0), 1.0))
         article_contents = articles.synthetic_articles(
             n_articles=max(n, 100), vocab_size=FLAGS.synthetic_vocab,
             seed=max(FLAGS.seed, 0))
@@ -81,6 +82,13 @@ def prepare_or_restore_data(model, FLAGS):
     article_contents = (article_contents.iloc[: train_row + validate_row]
                         .sample(frac=1, random_state=max(FLAGS.seed, 0)))
     article_contents = article_contents.sort_values("article_id")
+    if FLAGS.validation and len(article_contents) <= train_row:
+        raise ValueError(
+            f"only {len(article_contents)} rows remain after filtering to "
+            f"label_{FLAGS.label}_valid rows but --train_row {train_row} "
+            "+ --validation needs more; lower the split sizes or raise "
+            "--synthetic_oversample (the story label keeps ~35% of "
+            "synthetic rows)")
     train_row = min(train_row, len(article_contents))
 
     count_vectorizer, X, _, _ = articles.count_vectorize(

@@ -43,6 +43,14 @@ def parse_flags(argv=None):
     p.add_argument("--neg", type=int, default=10)           # train.log:11
     p.add_argument("--threads", type=int, default=20)       # train.log:13
     p.add_argument("--patience", type=int, default=10)      # train.log:21
+    p.add_argument("--from_artifacts", default="",
+                   help="data dir of a main_autoencoder run: train on the "
+                        "EXACT article split it saved (article.snappy.parquet"
+                        " / article_validate.snappy.parquet), the way the "
+                        "reference notebook exports the DAE run's own split "
+                        "(prepare_starspace_formatted_data.ipynb cells 3-5) — "
+                        "makes three-way DAE/tfidf/StarSpace AUROCs "
+                        "same-corpus by construction")
     return p.parse_args(argv)
 
 
@@ -53,19 +61,38 @@ def main(argv=None):
                            FLAGS.main_dir or FLAGS.model_name) + os.sep
     os.makedirs(out_dir, exist_ok=True)
 
-    n = FLAGS.train_row + FLAGS.validate_row
-    if FLAGS.synthetic:
-        contents = articles.synthetic_articles(n_articles=max(n, 100),
-                                               seed=FLAGS.seed)
+    if FLAGS.from_artifacts:
+        # the reference notebook doesn't build its own corpus — it exports the
+        # DAE run's saved split and trains StarSpace on that, so the AUROC
+        # comparison is one corpus by construction; mirror that here
+        d = FLAGS.from_artifacts.rstrip(os.sep) + os.sep
+        tr = hio.read_file(d + "article.snappy.parquet", data_type="pandas_df")
+        vl = hio.read_file(d + "article_validate.snappy.parquet",
+                           data_type="pandas_df")
+        contents = pd.concat([tr, vl])
+        contents = contents[contents.category_publish_name.notna()].copy()
+        # one factorization over both splits keeps label ids consistent
+        contents["label_category"] = pd.factorize(
+            contents.category_publish_name)[0]
+        n_tr = len(tr[tr.category_publish_name.notna()])
+        tr = contents.iloc[:n_tr]
+        vl = contents.iloc[n_tr:]
+        print(f"from_artifacts: {len(tr)} train / {len(vl)} validate rows "
+              f"from {d}")
     else:
-        contents = articles.read_articles(path=FLAGS.data_path)
-    # factorize gives -1 for missing categories, which the trainer rejects
-    contents = contents[contents.category_publish_name.notna()].iloc[:n]
-    contents = contents.copy()
-    contents["label_category"] = pd.factorize(
-        contents.category_publish_name)[0]
-    tr = contents.iloc[: FLAGS.train_row]
-    vl = contents.iloc[FLAGS.train_row : n]
+        n = FLAGS.train_row + FLAGS.validate_row
+        if FLAGS.synthetic:
+            contents = articles.synthetic_articles(n_articles=max(n, 100),
+                                                   seed=FLAGS.seed)
+        else:
+            contents = articles.read_articles(path=FLAGS.data_path)
+        # factorize gives -1 for missing categories, which the trainer rejects
+        contents = contents[contents.category_publish_name.notna()].iloc[:n]
+        contents = contents.copy()
+        contents["label_category"] = pd.factorize(
+            contents.category_publish_name)[0]
+        tr = contents.iloc[: FLAGS.train_row]
+        vl = contents.iloc[FLAGS.train_row : n]
 
     vec, X, _, _ = articles.count_vectorize(
         tr.main_content, tokenizer=None, stop_words="english",

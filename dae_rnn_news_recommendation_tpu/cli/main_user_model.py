@@ -174,6 +174,10 @@ def main(argv=None):
     s_pos = np.sum(states * pos_e[te], axis=-1)
     s_neg = np.sum(states * neg_e[te], axis=-1)
     rank_acc = float((s_pos > s_neg).mean())
+    # CI over per-user accuracies (decisions within a user share its state
+    # trajectory, so user is the independent unit, not the [U, T] decision)
+    per_user = (s_pos > s_neg).mean(axis=1)
+    rank_ci95 = float(1.96 * per_user.std(ddof=1) / np.sqrt(len(per_user)))
 
     # one candidate article per category; does the user's state rank their
     # interest category first?
@@ -201,7 +205,8 @@ def main(argv=None):
                                    atol=1e-4)
         print(f"sequence-parallel({n_dev}) user states: parity ok")
 
-    metrics = {"rank_accuracy": rank_acc, "category_top1_accuracy": cat_acc,
+    metrics = {"rank_accuracy": rank_acc, "rank_accuracy_ci95": rank_ci95,
+               "category_top1_accuracy": cat_acc,
                "n_users_eval": int(n_hold), "seq_len": FLAGS.seq_len,
                "d_embed": int(emb.shape[1])}
     print(json.dumps(metrics))

@@ -90,6 +90,13 @@ def build_parser(triplet_mode=False):
                    help="vocabulary size of the synthetic corpus; raise it to "
                         "reach reference-scale feature counts (the UCI workload "
                         "is 10k features, main_autoencoder.py:50)")
+    p.add_argument("--synthetic_oversample", type=float, default=1.0,
+                   help="generate this multiple of train_row+validate_row "
+                        "synthetic articles BEFORE label-validity filtering "
+                        "(reference main_autoencoder.py:193-198 shrinks the "
+                        "set the same way): ~35%% of synthetic articles carry "
+                        "a story, so --label story needs ~3-4x oversampling "
+                        "to fill the requested splits")
     p.add_argument("--n_devices", type=int, default=1)
     p.add_argument("--n_experts", type=int, default=1,
                    help="train a Switch-style mixture of N expert DAEs "

@@ -45,12 +45,26 @@ TRIPLET_ARGS = [
     "--opt", "ada_grad", "--learning_rate", "0.5",
     "--corr_type", "masking", "--corr_frac", "0.3", "--seed", str(SEED),
 ]
+# trains on the EXACT split the online-mining stage saved (--from_artifacts is
+# appended at run time with that stage's data dir), the way the reference
+# notebook exports the DAE run's own split — so the three-way
+# DAE/tfidf/StarSpace table is one corpus by construction
 STARSPACE_ARGS = [
-    "--model_name", "evidence_ss", "--synthetic",
-    "--train_row", "800", "--validate_row", "300",
+    "--model_name", "evidence_ss",
     "--max_features", "2000", "--dim", "50", "--epochs", "30",
     "--threads", "4", "--seed", str(SEED),
 ]
+# the reference driver's other mining label (main_autoencoder.py:180-198
+# exposes label=story|category_publish_name): same generator/schedule as
+# MAIN_ARGS but mined on `story`, 1000/300 splits with 4x oversampling (only
+# ~35% of synthetic articles carry a story, and the driver filters to
+# story-valid rows exactly like the reference) — documents the
+# Category/Story trade-off
+STORY_ARGS = [a for a in MAIN_ARGS]
+STORY_ARGS[STORY_ARGS.index("evidence")] = "evidence_story"
+STORY_ARGS[STORY_ARGS.index("--train_row") + 1] = "1000"
+STORY_ARGS[STORY_ARGS.index("--validate_row") + 1] = "300"
+STORY_ARGS += ["--label", "story", "--synthetic_oversample", "4.0"]
 # same corpus as MAIN_ARGS by construction (the evidence check claims it);
 # the routed mixture gets a longer schedule — each expert sees ~1/E of the
 # rows per epoch, and 25 epochs leaves the mixture at 0.58 AUROC (measured)
@@ -80,7 +94,7 @@ USER_ARGS = [
     "--model_name", "evidence_user", "--seed", str(SEED),
     "--n_articles", "1200", "--max_features", "1500",
     "--stacked_layers", "128,64", "--finetune_epochs", "2", "--dae_epochs", "5",
-    "--n_users", "300", "--seq_len", "12", "--gru_epochs", "15",
+    "--n_users", "2500", "--seq_len", "20", "--gru_epochs", "15",
 ]
 
 
@@ -110,7 +124,8 @@ def _fingerprint():
     except OSError:
         head, code = "nogit", "nogit"
     return json.dumps([head, code, SEED, MAIN_ARGS, TRIPLET_ARGS,
-                       STARSPACE_ARGS, MOE_ARGS, REFSCALE_ARGS, USER_ARGS])
+                       STARSPACE_ARGS, STORY_ARGS, MOE_ARGS, REFSCALE_ARGS,
+                       USER_ARGS])
 
 
 def _load_cache():
@@ -125,21 +140,36 @@ def _load_cache():
     return cache
 
 
-def _staged(name, fn):
+STAGE_PROVENANCE = {}  # name -> {platform, run_id}; collected per main() run
+
+
+def _staged(name, fn, platform="?", run_id="?"):
     """Stage-level resume: each completed stage's outputs persist to
     evidence/.stage_cache.json, so a mid-run TPU-tunnel hang (observed: the
     tunnel can die for hours mid-stage) only costs the stage in flight — rerun
     and the finished stages reload. Stages are seed-deterministic, so cached
     results are the same numbers a fresh run would commit. Delete the cache
-    file (or let a successful run do it) to force everything fresh."""
+    file (or let a successful run do it) to force everything fresh.
+
+    Every stage records WHICH platform and run produced it; the committed
+    record reports per-stage provenance, and a record whose stages span
+    platforms/runs says so instead of claiming the header platform for all
+    (the round-2 record spliced CPU stages into a TPU header — never again)."""
     cache = _load_cache()
     stages = cache.setdefault("stages", {})
     if name in stages:
-        print(f"== {name} == (cached from a previous partial run)")
-        return stages[name]
+        entry = stages[name]
+        prov = entry.get("provenance", {"platform": "unknown",
+                                        "run_id": "unknown"})
+        print(f"== {name} == (cached: platform={prov['platform']} "
+              f"run={prov['run_id']})")
+        STAGE_PROVENANCE[name] = prov
+        return entry["out"]
     print(f"== {name} ==")
     out = fn()
-    stages[name] = out
+    prov = {"platform": platform, "run_id": run_id}
+    stages[name] = {"out": out, "provenance": prov}
+    STAGE_PROVENANCE[name] = prov
     cache["fingerprint"] = _fingerprint()
     tmp = CACHE + ".tmp"
     with open(tmp, "w") as f:
@@ -211,10 +241,13 @@ def _check_figures(stage, names):
 
 def main():
     t0 = time.time()
+    import uuid
+
     import jax
 
     platform = jax.devices()[0].platform
-    print(f"evidence run on platform={platform}")
+    run_id = uuid.uuid4().hex[:12]
+    print(f"evidence run on platform={platform} run_id={run_id}")
 
     from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import (
         main as main_autoencoder)
@@ -229,28 +262,45 @@ def main():
     cwd = os.getcwd()
     os.chdir(scratch)
     try:
+        def staged(name, fn):
+            return _staged(name, fn, platform=platform, run_id=run_id)
+
         def _main_stage():
             model, out = main_autoencoder(MAIN_ARGS)
             return {"aurocs": out,
+                    "data_dir": os.path.abspath(model.data_dir),
                     "figures": _export_figures(model.plot_dir, "online",
                                                platform)}
 
-        main_out = _staged("online-mining driver", _main_stage)
+        main_out = staged("online-mining driver", _main_stage)
         aurocs = main_out["aurocs"]
         _check_figures("online-mining driver", main_out.get("figures", []))
-        tri_aurocs = _staged("precomputed-triplet driver",
-                             lambda: main_triplet(TRIPLET_ARGS)[1])
+        story_aurocs = staged("online-mining driver (story label)",
+                              lambda: main_autoencoder(STORY_ARGS)[1])
+        tri_aurocs = staged("precomputed-triplet driver",
+                            lambda: main_triplet(TRIPLET_ARGS)[1])
 
         def _ss():
-            result, ss_aurocs = main_starspace(STARSPACE_ARGS)
+            # the cached online-mining stage may reference a scratch dir a
+            # previous run created; if the OS wiped it, the split can't be
+            # reproduced piecemeal — force a uniform rerun
+            art = main_out["data_dir"]
+            if not os.path.exists(os.path.join(art, "article.snappy.parquet")):
+                raise RuntimeError(
+                    f"online-mining artifacts missing from {art} (stage cache "
+                    "references a wiped scratch dir); delete "
+                    "evidence/.stage_cache.json and rerun for a uniform record")
+            result, ss_aurocs = main_starspace(
+                STARSPACE_ARGS + ["--from_artifacts", art])
             return {"best_val_error": float(result["best_val_error"]),
                     "epoch_errors": [float(v) for v in result["epoch_errors"]],
                     "aurocs": ss_aurocs}
 
-        ss = _staged("native StarSpace baseline", _ss)
+        ss = _staged("native StarSpace baseline (same split as online-mining)",
+                     _ss, platform=platform, run_id=run_id)
         ss_result, ss_aurocs = ss, ss["aurocs"]
-        moe_aurocs = _staged("mixture-of-denoisers (4 experts, net-new family)",
-                             lambda: main_autoencoder(MOE_ARGS)[1])
+        moe_aurocs = staged("mixture-of-denoisers (4 experts, net-new family)",
+                            lambda: main_autoencoder(MOE_ARGS)[1])
 
         def _ref():
             t_ref = time.time()
@@ -259,15 +309,23 @@ def main():
                     "figures": _export_figures(model.plot_dir, "refscale",
                                                platform)}
 
-        ref = _staged("reference-scale run (8000 x 10000 -> 500, bf16, "
-                      "streaming eval)", _ref)
+        ref = staged("reference-scale run (8000 x 10000 -> 500, bf16, "
+                     "streaming eval)", _ref)
         ref_aurocs, t_ref = ref["aurocs"], ref["wall"]
         _check_figures("reference-scale run", ref.get("figures", []))
 
-        user = _staged("user model (stacked DAE -> GRU, config 5)",
-                       lambda: main_user_model(USER_ARGS)[1])
+        user = staged("user model (stacked DAE -> GRU, config 5)",
+                      lambda: main_user_model(USER_ARGS)[1])
     finally:
         os.chdir(cwd)
+
+    # provenance honesty: the committed record claims ONE platform only when
+    # every stage was actually produced by one platform (and ideally one run)
+    stage_platforms = {p["platform"] for p in STAGE_PROVENANCE.values()}
+    stage_runs = {p["run_id"] for p in STAGE_PROVENANCE.values()}
+    uniform = len(stage_platforms) == 1 and len(stage_runs) == 1
+    platform_claim = (stage_platforms.pop() if len(stage_platforms) == 1
+                      else "mixed(" + ",".join(sorted(stage_platforms)) + ")")
 
     # ------------------------------------------------------------ assertions
     checks = {}
@@ -290,6 +348,26 @@ def main():
           f"encoded {enc_vl:.4f} > tfidf {tfidf_vl:.4f} (Category, validate)")
     check("triplet_encoded_above_chance", tri_aurocs["encoded"] > 0.5,
           f"triplet encoded AUROC {tri_aurocs['encoded']:.4f} > 0.5")
+    # the reference driver's OTHER label (main_autoencoder.py:180-198): mining
+    # on story must lift the story-label AUROC the category-mined run trades
+    # away (VERDICT r2 weak-4: story quality was unchecked)
+    sto_enc_vl = story_aurocs["similarity_boxplot_encoded_validate(Story)"]
+    sto_tfidf_vl = story_aurocs["similarity_boxplot_tfidf_validate(Story)"]
+    cat_run_story_vl = aurocs["similarity_boxplot_encoded_validate(Story)"]
+    check("story_mined_encoded_beats_category_mined_on_story",
+          sto_enc_vl > cat_run_story_vl,
+          f"story-mined encoded(Story) validate {sto_enc_vl:.4f} > "
+          f"category-mined run's {cat_run_story_vl:.4f} (the mining label "
+          "steers which similarity the embedding learns)")
+    check("story_mined_encoded_above_chance", sto_enc_vl > 0.55,
+          f"story-mined encoded(Story) validate {sto_enc_vl:.4f} > 0.55 "
+          f"(tfidf on the same label: {sto_tfidf_vl:.4f})")
+    # three-way on ONE split (StarSpace trains on the online-mining stage's
+    # saved artifacts): the reference notebook's cells 9-13 comparison
+    ss_vl = ss_aurocs["starspace_validate"]
+    check("threeway_encoded_vs_starspace_validate", enc_vl >= ss_vl,
+          f"DAE encoded {enc_vl:.4f} >= StarSpace {ss_vl:.4f} "
+          "(Category, validate, same split by construction)")
     moe_vl = moe_aurocs["similarity_boxplot_encoded_validate(Category)"]
     check("moe_encoded_beats_tfidf_validate",
           moe_vl > 0.65 and moe_vl > tfidf_vl,
@@ -308,27 +386,36 @@ def main():
     ss_epoch = int(np.argmin(ss_result["epoch_errors"]))
     check("starspace_converged", np.isfinite(ss_loss),
           f"early stopping loss {ss_loss:.6f} @ epoch {ss_epoch}")
-    check("user_rank_above_chance", user["rank_accuracy"] > 0.6,
-          f"held-out pairwise rank accuracy {user['rank_accuracy']:.4f} > 0.6 "
-          "(chance 0.5)")
+    u_ci = user.get("rank_accuracy_ci95", 0.0)
+    check("user_rank_above_chance", user["rank_accuracy"] - u_ci > 0.6,
+          f"held-out pairwise rank accuracy {user['rank_accuracy']:.4f} "
+          f"± {u_ci:.4f} (95% CI over {user['n_users_eval']} users) "
+          "lower bound > 0.6 (chance 0.5)")
     check("user_category_top1", user["category_top1_accuracy"] > 0.3,
           f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.3 "
           "(chance ~1/7)")
 
     payload = {
         "generated": datetime.datetime.now(datetime.timezone.utc).isoformat(),
-        "platform": platform,
+        "platform": platform_claim,
+        "run_id": run_id,
+        "uniform_provenance": uniform,
+        "stage_provenance": dict(sorted(STAGE_PROVENANCE.items())),
         "seed": SEED,
         "wall_seconds": round(time.time() - t0, 1),
         "commands": {
             "main_autoencoder": MAIN_ARGS,
+            "main_autoencoder_story": STORY_ARGS,
             "main_autoencoder_triplet": TRIPLET_ARGS,
-            "main_starspace": STARSPACE_ARGS,
+            "main_starspace": STARSPACE_ARGS + ["--from_artifacts",
+                                                "<online-mining data_dir>"],
             "main_autoencoder_moe": MOE_ARGS,
             "main_autoencoder_refscale": REFSCALE_ARGS,
             "main_user_model": USER_ARGS,
         },
         "aurocs_online_mining": {k: float(v) for k, v in sorted(aurocs.items())},
+        "aurocs_story_mined": {k: float(v)
+                               for k, v in sorted(story_aurocs.items())},
         "aurocs_refscale": {k: float(v) for k, v in sorted(ref_aurocs.items())},
         "refscale_wall_seconds": round(t_ref, 1),
         "aurocs_triplet": {k: float(v) for k, v in sorted(tri_aurocs.items())},
@@ -355,7 +442,15 @@ def _write_md(p):
         "# Quality evidence (seeded synthetic corpus)",
         "",
         f"Generated {p['generated']} on platform `{p['platform']}`, "
-        f"seed {p['seed']}, {p['wall_seconds']}s wall.",
+        f"seed {p['seed']}, {p['wall_seconds']}s wall, run `{p['run_id']}`.",
+        "",
+        ("**Every stage below was produced by this single run on this single "
+         "platform** (per-stage provenance in results.json)."
+         if p.get("uniform_provenance") else
+         "**WARNING: stages in this record come from different runs or "
+         "platforms** — see `stage_provenance` in results.json for which; "
+         "rerun `python evidence/run.py` after deleting "
+         "`evidence/.stage_cache.json` for a uniform record."),
         "",
         "Reproduce: `JAX_PLATFORMS= python evidence/run.py` "
         "(exact driver flags recorded in results.json).",
@@ -384,6 +479,67 @@ def _write_md(p):
         "label; the claim under test (reference notebook cells 9-13) is that "
         "the learned 100-dim embedding beats the 2000-dim tf-idf "
         "representation on that label's related-vs-unrelated AUROC.",
+        "",
+        "## Three-way comparison: tfidf vs DAE vs StarSpace (one split)",
+        "",
+        "StarSpace trains on the online-mining run's saved article split "
+        "(`--from_artifacts`), the way the reference notebook exports the DAE "
+        "run's own split (prepare_starspace_formatted_data.ipynb cells 3-13) "
+        "— all four rows below score the same 1500-train/400-validate "
+        "articles on the Category label:",
+        "",
+        "| representation | train AUROC | validate AUROC |",
+        "|---|---|---|",
+    ]
+    s = p["aurocs_starspace"]
+    for label, tr_v, vl_v in (
+        ("tf-idf (2000-dim)",
+         a["similarity_boxplot_tfidf(Category)"],
+         a["similarity_boxplot_tfidf_validate(Category)"]),
+        ("binary counts (2000-dim)",
+         a["similarity_boxplot_binary_count(Category)"],
+         a["similarity_boxplot_binary_count_validate(Category)"]),
+        ("DAE encoded (100-dim, batch_all)",
+         a["similarity_boxplot_encoded(Category)"],
+         a["similarity_boxplot_encoded_validate(Category)"]),
+        ("StarSpace (50-dim, native trainer)",
+         s["starspace_train"], s["starspace_validate"]),
+    ):
+        lines.append(f"| {label} | {tr_v:.4f} | {vl_v:.4f} |")
+    st = p["aurocs_story_mined"]
+    lines += [
+        "",
+        "(The StarSpace stage's independently computed tf-idf AUROCs on its "
+        f"binary counts — train {s['tfidf_train']:.4f} / validate "
+        f"{s['tfidf_validate']:.4f} — anchor the two drivers to the same "
+        "split.)",
+        "",
+        "## Story-mined run (`--label story`)",
+        "",
+        "Same generator and schedule, mined on the reference driver's other "
+        "label (main_autoencoder.py:180-198): the driver filters to "
+        "story-valid rows exactly like the reference, so this run trains on "
+        "the story-carrying subset (1000 train / 300 validate, 4x "
+        "oversampled generation). "
+        "Mining steers the embedding geometry: the category-mined run above "
+        f"scores {a['similarity_boxplot_encoded_validate(Story)']:.4f} on "
+        "Story validate where this story-mined run reaches "
+        f"{st['similarity_boxplot_encoded_validate(Story)']:.4f}; conversely "
+        "this run's Category validate "
+        f"({st['similarity_boxplot_encoded_validate(Category)']:.4f}) gives "
+        "back some of the category-mined run's "
+        f"{a['similarity_boxplot_encoded_validate(Category)']:.4f} — the "
+        "mining label is the knob, and the framework exposes both.",
+        "",
+        "| representation | split | Category | Story |",
+        "|---|---|---|---|",
+    ]
+    for rep in ("tfidf", "binary_count", "encoded"):
+        for split, sfx in (("train", ""), ("validate", "_validate")):
+            cat = st[f"similarity_boxplot_{rep}{sfx}(Category)"]
+            sto = st[f"similarity_boxplot_{rep}{sfx}(Story)"]
+            lines.append(f"| {rep} | {split} | {cat:.4f} | {sto:.4f} |")
+    lines += [
         "",
         "## Reference-scale run (8000 x 10000 -> 500, bf16, streaming eval)",
         "",
@@ -448,7 +604,9 @@ def _write_md(p):
         "DAE pretraining (128,64) + joint fine-tune, GRU user states over "
         "simulated browse sessions, held-out users:",
         "",
-        f"- pairwise rank accuracy **{u['rank_accuracy']:.4f}** (chance 0.5)",
+        f"- pairwise rank accuracy **{u['rank_accuracy']:.4f} ± "
+        f"{u.get('rank_accuracy_ci95', 0.0):.4f}** (95% CI over held-out "
+        "users; chance 0.5)",
         f"- interest-category top-1 **{u['category_top1_accuracy']:.4f}** "
         "(chance ~1/7)",
         f"- {u['n_users_eval']} held-out users, seq_len {u['seq_len']}, "
