@@ -136,6 +136,10 @@ class DenoisingAutoencoder:
         self.n_components_override = n_components
         self.n_components = None
         self.config = None
+        # _build() upgrades these; subclasses overriding _build inherit the
+        # safe single-process defaults
+        self._multiprocess = False
+        self._model_axis = None
         self.params = None
         self.opt_state = None
         self._epoch0 = 0
