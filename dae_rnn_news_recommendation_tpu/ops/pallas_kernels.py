@@ -12,25 +12,23 @@
    (pltpu.prng_seed / prng_random_bits): one read-mask-write pass with on-chip
    randomness instead of counter-based threefry bit generation.
 
-MEASURED on a real v5e-1 (2026-07, jax 0.9): XLA wins batch_all — its fusion also
-never materializes the cube (runs B=4096 where the cube would be 256 GiB) and is
+STATUS: VALIDATED INFRASTRUCTURE, NOT PRODUCTION (final as of round 3). Measured
+on a real v5e-1 (2026-07, jax 0.9): XLA wins batch_all — its fusion also never
+materializes the cube (runs B=4096 where the cube would be 256 GiB) and is
 ~1.4-1.8x faster than this kernel (14 vs 19 ms at B=1024/D=500; 431 vs 781 ms at
 B=4096, best tiles (16,128,128)). Masking is sub-millisecond in both forms at
-[8192, 10000] — below reliable timing resolution over the axon tunnel. Per the
-"let XLA fuse" rule the XLA paths stay the production default; these kernels are
-kept as validated, hardware-tested alternatives and as the repo's Pallas
-infrastructure (grid accumulation, Mosaic layout constraints, hardware PRNG are all
-exercised and unit-tested against the XLA oracles).
-
-Round-2 re-measurement attempt (tile sweep (8..128, 128..512, 128..512) plus a
-fused-mask variant): ABANDONED as unmeasurable — the TPU tunnel now memoizes
-(executable, inputs) dispatches (identical repeats return in ~0.05 ms regardless
-of volume) and charges a ~200 ms first-execution cost per program, so kernel
-microbenchmarks neither scale with cube volume nor reproduce run to run in either
-direction. The round-1 hardware numbers above remain the best available data and
-the XLA default stands. Any future re-tune must feed DISTINCT input contents per
-dispatch (see bench.py) and should re-verify volume scaling before trusting a
-number.
+[8192, 10000] — below reliable timing resolution over the axon tunnel. A round-2
+re-tune (tile sweep + fused-mask variant) was abandoned as unmeasurable: the
+tunnel memoizes (executable, inputs) dispatches, so microbenchmarks neither scale
+with volume nor reproduce (any future attempt must feed DISTINCT inputs per
+dispatch, bench.py-style). Per the "let XLA fuse" rule the XLA paths
+(ops/triplet.py, ops/corruption.py) are the production default on every driver
+and training path, and no re-tune TODO is carried: these kernels are kept
+because they exercise and document the repo's Pallas layer (3-D grid
+accumulation, Mosaic layout constraints, hardware PRNG) with oracle tests, and
+as the starting point if a future chip/shape shifts the balance — the evidence
+bar for promotion is a measured end-to-end win on hardware with distinct-input
+timing, volume scaling verified.
 
 Mosaic layout rules discovered on hardware (encoded in the kernels/asserts below):
 3D reductions need keepdims (or drop axis 0 only); [n,1,1]->(n,1) reshape lowers but
@@ -157,6 +155,10 @@ def batch_all_triplet_loss_pallas(labels, encode, pos_triplets_only=False,
                                   row_valid=None, tiles=(8, 128, 128),
                                   interpret=None):
     """Drop-in for ops.triplet.batch_all_triplet_loss with O(tile^3) working set.
+
+    Validated infrastructure, NOT a production path (see module docstring):
+    forward-only (no VJP), and measured slower than XLA's fusion at every
+    tested shape — training and eval use ops/triplet.py.
 
     Same return tuple: (loss, data_weight[B], fraction_positive, num_positive, {}).
     The dot-product matrix is computed by XLA (MXU); the kernel owns everything cubic.
