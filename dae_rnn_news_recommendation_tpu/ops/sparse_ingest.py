@@ -153,17 +153,24 @@ def sparse_encode_matmul(w, indices, values=None, chunk=256,
             return jnp.sum(g, axis=1)
         return jnp.einsum("ckd,ck->cd", g, c_vals, precision=precision)
 
-    if b % chunk != 0:  # single ragged tail chunk: fall back to one unchunked pass
-        # chunk was clamped to min(chunk, b), so a non-divisible b means
-        # b > chunk: the fallback materializes the full [B, K, D] gather at
-        # once, losing the chunked [chunk, K, D] memory bound — loud at trace
-        # time so a frequently-ragged B doesn't silently regress memory
-        warnings.warn(
-            f"sparse_encode_matmul: batch {b} not divisible by chunk "
-            f"{chunk}; running unchunked (peak gather memory ~"
-            f"{b / chunk:.1f}x the chunked bound). Pad B or pick a "
-            "divisor chunk.", stacklevel=2)
-        return contract(idx, vals)
+    if b % chunk != 0:
+        # ragged tail (chunk was clamped to min(chunk, b), so here b > chunk):
+        # adapt to the largest divisor of b that still fits the requested
+        # working set — the memory bound survives without caller padding
+        div = next(c for c in range(chunk, 0, -1) if b % c == 0)
+        if div >= max(32, chunk // 8):
+            chunk = div
+        else:
+            # no usable divisor (e.g. prime b): one unchunked pass, loud at
+            # trace time — the full [B, K, D] gather loses the chunked
+            # [chunk, K, D] memory bound and a frequently-ragged B must not
+            # silently regress memory
+            warnings.warn(
+                f"sparse_encode_matmul: batch {b} has no usable divisor <= "
+                f"chunk {chunk}; running unchunked (peak gather memory ~"
+                f"{b / chunk:.1f}x the chunked bound). Pad B or pick a "
+                "divisor chunk.", stacklevel=2)
+            return contract(idx, vals)
 
     idx_c = idx.reshape(b // chunk, chunk, -1)
     if vals is None:
