@@ -253,6 +253,17 @@ def test_roofline_accounting(bench):
     cpu_roof = bench._roofline("cpu", "cpu", 1.0, 1.0, 64)
     assert "train_mfu" not in cpu_roof and "peak_bf16_tflops" not in cpu_roof
 
+    # the via_dense strategy sits on the MXU axis: 2*F*D real FLOPs against
+    # ~4*F HBM bytes per article
+    droof = bench._roofline("tpu", "TPU v5 lite", encode_aps=1.0e7,
+                            train_aps=None, train_batch=800,
+                            encode_strategy="via_dense (MXU)")
+    assert droof["encode_eff_flops_per_article"] == 2 * bench.F * bench.D
+    assert droof["encode_hbm_bytes_per_article"] == 4 * bench.F + 4 * bench.D
+    assert "MXU" in droof["bound"]["encode"]
+    # 1e7 aps * 10M FLOPs = 100 TFLOP/s of 197 -> ~0.51 MFU
+    assert 0.4 < droof["encode_mfu"] < 0.6
+
 
 def test_graft_entry_compiles():
     """entry() must return (jittable fn, example args) that actually compile
