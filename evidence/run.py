@@ -14,7 +14,11 @@ native StarSpace baseline, with the quality claims asserted, not just printed:
     headline comparison);
   * the StarSpace baseline must converge to a finite early-stopping loss.
 
-Reproduce:  JAX_PLATFORMS= python evidence/run.py
+Reproduce:  python evidence/run.py          (TPU when the tunnel is alive)
+            python evidence/run.py --cpu    (force CPU: sets the platform
+                                             before jax import AND via
+                                             jax.config — the env var alone is
+                                             ignored by the axon site hook)
 (runs the drivers in a scratch dir; rewrites evidence/{results.json,RESULTS.md})
 """
 
@@ -239,12 +243,17 @@ def _check_figures(stage, names):
               " Delete evidence/.stage_cache.json and rerun to regenerate.")
 
 
-def main():
+def main(argv=None):
     t0 = time.time()
+    argv = sys.argv[1:] if argv is None else argv
+    if "--cpu" in argv:
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import uuid
 
     import jax
 
+    if "--cpu" in argv:
+        jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
     run_id = uuid.uuid4().hex[:12]
     print(f"evidence run on platform={platform} run_id={run_id}")
