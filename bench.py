@@ -619,7 +619,13 @@ def _attempt_child(attempt, env, timeout_s, noprogress=NOPROGRESS_TIMEOUT):
     line = next((ln for ln in reversed(stdout.splitlines())
                  if ln.startswith('{"metric"')), None)
     if rc == 0 and line:
-        return json.loads(line)
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError as e:
+            # a truncated/interleaved final flush must cost one attempt, not
+            # the whole record (the never-empty-record contract)
+            _diag(attempt, f"unparseable metric line ({e}): {line[:200]}")
+            return None
     _diag(attempt, f"rc={rc} stderr: {stderr_tail[-400:]}")
     return None
 
@@ -630,17 +636,18 @@ def capture_tpu_main():
     the round-end record never depends on tunnel luck. rc 0 iff captured."""
     attempts = 2
     for attempt in range(attempts):
-        if not _tpu_alive(attempt):
-            if attempt < attempts - 1:  # no retry follows the last probe
-                time.sleep(BACKOFFS[min(attempt, len(BACKOFFS) - 1)])
-            continue
-        rec = _attempt_child(attempt, dict(os.environ), CHILD_TIMEOUT)
-        if rec is not None:
-            if rec.get("extra", {}).get("platform") == "tpu":
+        if _tpu_alive(attempt):
+            rec = _attempt_child(attempt, dict(os.environ), CHILD_TIMEOUT)
+            if rec is not None and rec.get("extra", {}).get("platform") == "tpu":
                 _write_sidecar(rec)
                 print(json.dumps(rec), flush=True)
                 return 0
-            _diag(attempt, "child record is not TPU; not captured")
+            if rec is not None:
+                _diag(attempt, "child record is not TPU; not captured")
+        # probe failed OR the child failed mid-run (tunnel died): either way
+        # give the tunnel the backoff before the final retry
+        if attempt < attempts - 1:
+            time.sleep(BACKOFFS[min(attempt, len(BACKOFFS) - 1)])
     return 1
 
 

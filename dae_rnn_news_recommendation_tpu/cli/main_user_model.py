@@ -175,9 +175,11 @@ def main(argv=None):
     s_neg = np.sum(states * neg_e[te], axis=-1)
     rank_acc = float((s_pos > s_neg).mean())
     # CI over per-user accuracies (decisions within a user share its state
-    # trajectory, so user is the independent unit, not the [U, T] decision)
+    # trajectory, so user is the independent unit, not the [U, T] decision);
+    # undefined at n=1 — report 0.0, not NaN (NaN breaks strict JSON parsers)
     per_user = (s_pos > s_neg).mean(axis=1)
-    rank_ci95 = float(1.96 * per_user.std(ddof=1) / np.sqrt(len(per_user)))
+    rank_ci95 = (float(1.96 * per_user.std(ddof=1) / np.sqrt(len(per_user)))
+                 if len(per_user) > 1 else 0.0)
 
     # one candidate article per category; does the user's state rank their
     # interest category first?

@@ -407,6 +407,8 @@ def main(argv=None):
     payload = {
         "generated": datetime.datetime.now(datetime.timezone.utc).isoformat(),
         "platform": platform_claim,
+        "repro_command": ("python evidence/run.py --cpu" if "--cpu" in argv
+                          or platform == "cpu" else "python evidence/run.py"),
         "run_id": run_id,
         "uniform_provenance": uniform,
         "stage_provenance": dict(sorted(STAGE_PROVENANCE.items())),
@@ -461,8 +463,8 @@ def _write_md(p):
          "rerun `python evidence/run.py` after deleting "
          "`evidence/.stage_cache.json` for a uniform record."),
         "",
-        "Reproduce: `JAX_PLATFORMS= python evidence/run.py` "
-        "(exact driver flags recorded in results.json).",
+        "Reproduce: `" + p.get("repro_command", "python evidence/run.py")
+        + "` (exact driver flags recorded in results.json).",
         "",
         "The real UCI parquet is stripped from this environment "
         "(`/root/reference/.MISSING_LARGE_BLOBS`), so this is the seeded "
