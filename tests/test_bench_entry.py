@@ -232,6 +232,70 @@ def test_main_total_failure_with_sidecar_still_lands_tpu(bench, monkeypatch,
     assert rec["extra"]["live_fallback"]["value"] == 0.0
 
 
+def _scripted_capture(bench, monkeypatch, tmp_path, probe_script, child_script):
+    """Like _scripted_main but driving capture_tpu_main (TPU-only, no CPU
+    fallback). Returns (rc, printed_metric_lines, sleeps)."""
+    probes = iter(probe_script)
+    children = iter(child_script)
+    sleeps = []
+
+    monkeypatch.setattr(bench, "SIDECAR_PATH", str(tmp_path / "bench_tpu.json"))
+    monkeypatch.setattr(bench, "_tpu_alive", lambda attempt: next(probes))
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    monkeypatch.setattr(bench, "_run_child",
+                        lambda *a, **k: next(children))
+    printed = []
+    monkeypatch.setattr("builtins.print",
+                        lambda *a, **k: printed.append(" ".join(map(str, a))))
+    rc = bench.capture_tpu_main()
+    return rc, [ln for ln in printed if ln.startswith('{"metric"')], sleeps
+
+
+def test_capture_tpu_success_writes_sidecar(bench, monkeypatch, tmp_path):
+    rc, lines, sleeps = _scripted_capture(
+        bench, monkeypatch, tmp_path,
+        probe_script=[True],
+        child_script=[(0, TPU_METRIC + "\n", "", None)])
+    assert rc == 0 and len(lines) == 1
+    assert json.loads(lines[0])["value"] == 2_000_000.0
+    with open(tmp_path / "bench_tpu.json") as f:
+        assert json.load(f)["record"]["extra"]["platform"] == "tpu"
+    assert sleeps == []  # success: no backoff burned
+
+
+def test_capture_tpu_failed_child_backs_off_then_retries(bench, monkeypatch,
+                                                         tmp_path):
+    """A probed-alive tunnel whose child dies mid-run (watchdog kill) must
+    back off before the final attempt — not burn it seconds later."""
+    rc, lines, sleeps = _scripted_capture(
+        bench, monkeypatch, tmp_path,
+        probe_script=[True, True],
+        child_script=[(None, "", "mute", "no heartbeat for 300s"),
+                      (0, TPU_METRIC + "\n", "", None)])
+    assert rc == 0 and len(lines) == 1
+    assert len(sleeps) == 1  # exactly one backoff between the two attempts
+    assert os.path.exists(tmp_path / "bench_tpu.json")
+
+
+def test_capture_tpu_dead_tunnel_gives_up_quietly(bench, monkeypatch, tmp_path):
+    rc, lines, sleeps = _scripted_capture(
+        bench, monkeypatch, tmp_path,
+        probe_script=[False, False],
+        child_script=[])
+    assert rc == 1 and lines == []
+    assert len(sleeps) == 1  # backoff before the second probe, none after
+    assert not os.path.exists(tmp_path / "bench_tpu.json")
+
+
+def test_attempt_child_tolerates_malformed_metric_line(bench, monkeypatch):
+    monkeypatch.setattr(bench, "_run_child",
+                        lambda *a, **k: (0, '{"metric" garbage\n', "", None))
+    diags = []
+    monkeypatch.setattr(bench, "_diag", lambda a, n: diags.append(n))
+    assert bench._attempt_child(0, {}, 10) is None
+    assert any("unparseable" in d for d in diags)
+
+
 def test_roofline_accounting(bench):
     """Analytic FLOPs/bytes and TPU utilization figures: encode intensity ~1
     FLOP/byte (HBM-bound), train MFU computed against the chip peak."""
