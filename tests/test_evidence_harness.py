@@ -1,0 +1,73 @@
+"""Unit tests for the evidence harness's provenance machinery (evidence/run.py):
+the round-2 record spliced CPU stages into a TPU-labeled header, and these pin
+the guards that prevent a recurrence — per-stage provenance through the stage
+cache, fingerprint invalidation, and the mixed-record warning in RESULTS.md.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def evrun(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "evrun_under_test", os.path.join(REPO, "evidence", "run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "CACHE", str(tmp_path / "stage_cache.json"))
+    mod.STAGE_PROVENANCE.clear()
+    return mod
+
+
+def test_staged_records_and_replays_provenance(evrun):
+    calls = []
+    out1 = evrun._staged("s1", lambda: (calls.append(1), {"x": 1})[1],
+                         platform="tpu", run_id="run_a")
+    assert out1 == {"x": 1} and calls == [1]
+    assert evrun.STAGE_PROVENANCE["s1"] == {"platform": "tpu",
+                                            "run_id": "run_a"}
+
+    # a later run (different platform/run id) reuses the cache but must
+    # surface the ORIGINAL provenance, not claim its own
+    evrun.STAGE_PROVENANCE.clear()
+    out2 = evrun._staged("s1", lambda: pytest.fail("must not re-run"),
+                         platform="cpu", run_id="run_b")
+    assert out2 == {"x": 1}
+    assert evrun.STAGE_PROVENANCE["s1"] == {"platform": "tpu",
+                                            "run_id": "run_a"}
+    # a new stage in the second run carries the second run's provenance ->
+    # the aggregate is visibly mixed
+    evrun._staged("s2", lambda: {"y": 2}, platform="cpu", run_id="run_b")
+    platforms = {p["platform"] for p in evrun.STAGE_PROVENANCE.values()}
+    assert platforms == {"tpu", "cpu"}
+
+
+def test_stage_cache_invalidates_on_fingerprint_change(evrun, monkeypatch):
+    evrun._staged("s1", lambda: {"x": 1}, platform="cpu", run_id="r")
+    monkeypatch.setattr(evrun, "_fingerprint", lambda: "different-config")
+    calls = []
+    out = evrun._staged("s1", lambda: (calls.append(1), {"x": 99})[1],
+                        platform="cpu", run_id="r2")
+    assert out == {"x": 99} and calls == [1]  # stale cache was NOT reused
+
+
+def test_results_md_flags_mixed_provenance(evrun, monkeypatch, tmp_path):
+    """The committed record is the template; flipping uniform_provenance must
+    produce the explicit mixed-record warning instead of the uniform claim."""
+    with open(os.path.join(REPO, "evidence", "results.json")) as f:
+        payload = json.load(f)
+    monkeypatch.setattr(evrun, "HERE", str(tmp_path))
+
+    evrun._write_md(dict(payload, uniform_provenance=True))
+    uniform_md = (tmp_path / "RESULTS.md").read_text()
+    assert "single run on this single platform" in uniform_md
+    assert "WARNING" not in uniform_md
+
+    evrun._write_md(dict(payload, uniform_provenance=False))
+    mixed_md = (tmp_path / "RESULTS.md").read_text()
+    assert "WARNING" in mixed_md and "different runs or platforms" in mixed_md
