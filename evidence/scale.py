@@ -13,7 +13,10 @@ from the AUROC sweep via --eval_reps: their pair sweeps cost ~F/D times the
 encoded one (~5e14 FLOPs each), which is not an eval any framework runs at
 this size; the learned embedding is the representation under test.
 
-Reproduce:  JAX_PLATFORMS= python evidence/scale.py   (~30 min single chip)
+Reproduce:  python evidence/scale.py          (~30 min on one TPU chip;
+            python evidence/scale.py --cpu    forces CPU — hours, not
+            recommended; the flag sets the platform before jax import AND via
+            jax.config, since the env var alone is ignored by the axon hook)
 """
 
 import datetime
@@ -41,10 +44,15 @@ ARGS = [
 ]
 
 
-def main():
+def main(argv=None):
     t0 = time.time()
+    argv = sys.argv[1:] if argv is None else argv
+    if "--cpu" in argv:
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
+    if "--cpu" in argv:
+        jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
     print(f"scale evidence on platform={platform}")
 
@@ -91,7 +99,8 @@ def main():
         f"Generated {payload['generated']} on platform `{platform}`, seed "
         f"{SEED}, **{wall:.0f}s end to end** on one chip.",
         "",
-        "Reproduce: `JAX_PLATFORMS= python evidence/scale.py`.",
+        "Reproduce: `python evidence/scale.py`"
+        + (" --cpu" if "--cpu" in argv else "") + ".",
         "",
         "Pipeline: 105k synthetic docs -> CountVectorizer (50k features) -> "
         "DAE with batch_hard mining (10k-row batches, sparse-ingest feed, "
