@@ -180,3 +180,32 @@ def test_main_autoencoder_eval_reps_filter(workdir):
         "similarity_boxplot_encoded_validate(Story)"}
     _, aurocs_s = main(["--model_name", "er2"] + args + ["--streaming_eval"])
     assert set(aurocs_s) == set(aurocs)
+
+
+def test_main_starspace_from_artifacts(workdir):
+    """--from_artifacts trains StarSpace on the EXACT split a main_autoencoder
+    run saved (the reference notebook's export-the-DAE-split flow, cells 3-5):
+    row counts must match the saved parquets and the label flag pair
+    (--train_row/--validate_row) must be ignored entirely."""
+    from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import main as m_ae
+    from dae_rnn_news_recommendation_tpu.cli.main_starspace import main as m_ss
+
+    model, _ = m_ae([
+        "--model_name", "src", "--synthetic", "--validation",
+        "--num_epochs", "1", "--train_row", "120", "--validate_row", "40",
+        "--max_features", "300", "--batch_size", "0.5",
+    ])
+    result, aurocs = m_ss([
+        "--model_name", "ss_art", "--epochs", "3", "--threads", "2",
+        "--dim", "16", "--max_features", "300",
+        "--train_row", "9999", "--validate_row", "9999",  # must be ignored
+        "--from_artifacts", os.path.abspath(model.data_dir),
+    ])
+    assert np.isfinite(result["best_val_error"])
+    emb = np.loadtxt("results/starspace/ss_art/uci_train_starspace_embed.txt")
+    assert emb.shape == (120, 16)  # the DAE run's split, not the flags
+    emb_vl = np.loadtxt(
+        "results/starspace/ss_art/uci_validate_starspace_embed.txt")
+    assert emb_vl.shape == (40, 16)
+    assert set(aurocs) == {"starspace_train", "starspace_validate",
+                           "tfidf_train", "tfidf_validate"}
