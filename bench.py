@@ -5,8 +5,10 @@ default workload shape — 10000-feature bag-of-words articles -> 500-dim codes
 Two figures:
   * encode: streamed host-csr -> device encode (ops/sparse_ingest.py). Articles cross
     the host->device boundary as padded uint16 indices (~50x fewer bytes than dense
-    f32 at ~2% density); x@W runs as an on-device weighted gather-accumulate over W's
-    rows; transfers are double-buffered ahead of compute.
+    f32 at ~2% density); on TPU the bench races the two equivalent x@W strategies —
+    weighted gather-accumulate over W's rows (HBM-bound, ~nnz*D*2 B/article) vs
+    densify+MXU matmul (~4*F B/article at ~250 FLOPs/byte) — and headlines the max;
+    transfers are double-buffered ahead of compute.
   * train: steady-state jitted train step (corrupt+encode+decode+batch_all mining+
     grad+adagrad update, train/step.py) at the reference's default batch — 10% of
     8000 rows (main_autoencoder.py:60) — the hot loop of autoencoder.py:206-246.
