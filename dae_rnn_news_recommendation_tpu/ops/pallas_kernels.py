@@ -4,9 +4,10 @@
    ops/triplet.py:78 / reference triplet_loss_utils.py:79-131). Every [B, B, B]
    quantity (distance cube, masks, softplus) is derived tile-by-tile in VMEM with an
    explicit (B/ti, B/tj, B/tk) grid; the cube never exists in HBM, and the three
-   axis-reductions composing `data_weight` accumulate across grid steps. Forward
-   only (no VJP) — use it for eval/metrics or as the template for sizes where the
-   guaranteed O(ti*tj*tk) working set matters.
+   axis-reductions composing `data_weight` accumulate across grid steps. Trainable:
+   a custom VJP (a second kernel over the same grid accumulating dL/d(dp) tiles,
+   then dE = (G+G^T)E on the MXU) matches XLA autodiff of the oracle to float
+   roundoff — the cube stays out of HBM in the backward pass too.
 
 2. `masking_noise_pallas` — fused masking corruption from the TPU's hardware PRNG
    (pltpu.prng_seed / prng_random_bits): one read-mask-write pass with on-chip
@@ -151,34 +152,90 @@ def _batch_all_pallas(dp, a, b, pos_triplets_only, tiles, interpret):
     )(dp, dp, a, b)
 
 
-def batch_all_triplet_loss_pallas(labels, encode, pos_triplets_only=False,
-                                  row_valid=None, tiles=(8, 128, 128),
-                                  interpret=None):
-    """Drop-in for ops.triplet.batch_all_triplet_loss with O(tile^3) working set.
+def _batch_all_bwd_kernel(dp_ij_ref, dp_ik_ref, a_ref, b_ref,
+                          gij_ref, gik_ref, *, ti, tj, tk, pos_only):
+    """dL/d(dp) tiles for the batch_all loss, same grid as the forward.
 
-    Validated infrastructure, NOT a production path (see module docstring):
-    forward-only (no VJP), and measured slower than XLA's fusion at every
-    tested shape — training and eval use ops/triplet.py.
+    Per triplet, s = sigmoid(dist) * mask (mask is comparison-derived, so its
+    gradient is exactly zero — identical to XLA autodiff through the
+    indicator): dL/ddp[i,k] += s and dL/ddp[i,j] -= s, scaled by 1/num_sel in
+    the wrapper. gij blocks are revisited across k (init at k==0), gik blocks
+    across j (init at j==0)."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    k = pl.program_id(2)
 
-    Same return tuple: (loss, data_weight[B], fraction_positive, num_positive, {}).
-    The dot-product matrix is computed by XLA (MXU); the kernel owns everything cubic.
+    dp_ij = dp_ij_ref[:]
+    dp_ik = dp_ik_ref[:]
+    a = a_ref[:]
+    b = b_ref[:]
+    jj = jax.lax.broadcasted_iota(jnp.int32, (tj, tk), 0) + j * tj
+    kk = jax.lax.broadcasted_iota(jnp.int32, (tj, tk), 1) + k * tk
+    neq_jk = (jj != kk).astype(jnp.float32)
 
-    :param tiles: (ti, tj, tk) VMEM tile sizes; B is padded to their lcm with
-        invalid rows, which mine nothing by construction.
-    :param interpret: force interpreter mode (defaults to True off-TPU).
-    """
-    if interpret is None:
-        interpret = not _on_tpu()
+    valid3 = a[:, :, None] * b[:, None, :] * neq_jk[None, :, :]
+    dist = dp_ik[:, None, :] - dp_ij[:, :, None]
+    if pos_only:
+        mask = (valid3 * dist > _EPS).astype(jnp.float32)
+    else:
+        mask = valid3
+    s = jax.nn.sigmoid(dist) * mask                       # [ti, tj, tk]
+
+    @pl.when(k == 0)
+    def _():
+        gij_ref[:] = jnp.zeros_like(gij_ref)
+
+    @pl.when(j == 0)
+    def _():
+        gik_ref[:] = jnp.zeros_like(gik_ref)
+
+    gij_ref[:] += -jnp.sum(s, axis=2)                     # [ti, tj]
+    gik_ref[:] += jnp.sum(s, axis=1)                      # [ti, tk]
+
+
+@functools.partial(jax.jit, static_argnames=("pos_triplets_only", "tiles",
+                                             "interpret"))
+def _batch_all_pallas_bwd(dp, a, b, pos_triplets_only, tiles, interpret):
+    bp = dp.shape[0]
+    ti, tj, tk = tiles
+    grid = (bp // ti, bp // tj, bp // tk)
+    kernel = functools.partial(_batch_all_bwd_kernel, ti=ti, tj=tj, tk=tk,
+                               pos_only=pos_triplets_only)
+    gij, gik = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ti, tj), lambda i, j, k: (i, j)),
+            pl.BlockSpec((ti, tk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((ti, tj), lambda i, j, k: (i, j)),
+            pl.BlockSpec((ti, tk), lambda i, j, k: (i, k)),
+        ],
+        out_specs=[
+            pl.BlockSpec((ti, tj), lambda i, j, k: (i, j)),
+            pl.BlockSpec((ti, tk), lambda i, j, k: (i, k)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, bp), jnp.float32),
+            jax.ShapeDtypeStruct((bp, bp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(dp, dp, a, b)
+    return gij + gik
+
+
+def _prep_masks(labels, encode, row_valid, tiles, interpret):
+    """Shared forward/backward prep: dp + validity masks, padded to the tile
+    step (padded rows mine nothing by construction)."""
     b = labels.shape[0]
-    valid = jnp.ones(b, bool) if row_valid is None else row_valid.astype(bool)
-
+    valid = (jnp.ones(b, bool) if row_valid is None
+             else row_valid.astype(bool))
     dp = jnp.matmul(encode, encode.T, precision=jax.lax.Precision.HIGHEST)
     dp = dp.astype(jnp.float32)
     eq = labels[:, None] == labels[None, :]
     vv = valid[:, None] & valid[None, :]
     eye = jnp.eye(b, dtype=bool)
     a = (eq & ~eye & vv).astype(jnp.float32)   # anchor/positive validity
-    bm = (~eq & vv).astype(jnp.float32)        # anchor/negative validity (i!=k implied)
+    bm = (~eq & vv).astype(jnp.float32)        # anchor/negative (i!=k implied)
 
     ti, tj, tk = tiles
     step = max(ti, tj, tk)
@@ -194,15 +251,76 @@ def batch_all_triplet_loss_pallas(labels, encode, pos_triplets_only=False,
         dp = jnp.pad(dp, pad)
         a = jnp.pad(a, pad)
         bm = jnp.pad(bm, pad)
+    return dp, a, bm
 
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 4, 5))
+def _batch_all_loss_vjp(labels, encode, pos_triplets_only, row_valid, tiles,
+                        interpret):
+    """Differentiable core: returns the full tuple; only `loss` carries a
+    gradient (data_weight/fraction/num are indicator counts whose true
+    gradient is zero, matching XLA autodiff of the oracle)."""
+    out, _ = _batch_all_fwd(labels, encode, pos_triplets_only, row_valid,
+                            tiles, interpret)
+    return out
+
+
+def _batch_all_fwd(labels, encode, pos_triplets_only, row_valid, tiles,
+                   interpret):
+    b = labels.shape[0]
+    dp, a, bm = _prep_masks(labels, encode, row_valid, tiles, interpret)
     stats, aw, pw, nw = _batch_all_pallas(dp, a, bm, bool(pos_triplets_only),
-                                          (ti, tj, tk), bool(interpret))
+                                          tuple(tiles), bool(interpret))
     sum_loss, num_pos, num_valid = stats[0, 0], stats[0, 1], stats[0, 2]
     num_sel = num_pos if pos_triplets_only else num_valid
     loss = sum_loss / jnp.maximum(num_sel, _EPS)
     data_weight = (aw[:, 0] + pw[:, 0] + nw[0])[:b]
     fraction = num_pos / jnp.maximum(num_valid, _EPS)
-    return loss, data_weight, fraction, num_pos, {}
+    out = (loss, data_weight, fraction, num_pos, {})
+    residuals = (dp, a, bm, num_sel, encode)
+    return out, residuals
+
+
+def _batch_all_bwd(pos_triplets_only, tiles, interpret, residuals, cotangents):
+    dp, a, bm, num_sel, encode = residuals
+    loss_bar = cotangents[0]
+    b = encode.shape[0]
+    # G[bp, bp] = dL/d(dp) * num_sel; the cube never exists in HBM here either
+    g = _batch_all_pallas_bwd(dp, a, bm, bool(pos_triplets_only),
+                              tuple(tiles), bool(interpret))
+    g = (g[:b, :b] * (loss_bar / jnp.maximum(num_sel, _EPS)))
+    # dp = E E^T  =>  dL/dE = (G + G^T) E
+    de = jnp.matmul(g + g.T, encode.astype(jnp.float32),
+                    precision=jax.lax.Precision.HIGHEST)
+    return None, de.astype(encode.dtype), None
+
+
+_batch_all_loss_vjp.defvjp(_batch_all_fwd, _batch_all_bwd)
+
+
+def batch_all_triplet_loss_pallas(labels, encode, pos_triplets_only=False,
+                                  row_valid=None, tiles=(8, 128, 128),
+                                  interpret=None):
+    """Drop-in for ops.triplet.batch_all_triplet_loss with O(tile^3) working set.
+
+    Validated infrastructure, NOT a production path (see module docstring):
+    measured slower than XLA's fusion at every tested shape — training and
+    eval use ops/triplet.py. Trainable nonetheless: a custom VJP (a second
+    Pallas kernel over the same grid) gives d(loss)/d(encode) with the same
+    never-materialize-the-cube bound, gradient-parity-tested against XLA
+    autodiff of the oracle.
+
+    Same return tuple: (loss, data_weight[B], fraction_positive, num_positive, {}).
+    The dot-product matrix is computed by XLA (MXU); the kernel owns everything cubic.
+
+    :param tiles: (ti, tj, tk) VMEM tile sizes; B is padded to their lcm with
+        invalid rows, which mine nothing by construction.
+    :param interpret: force interpreter mode (defaults to True off-TPU).
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _batch_all_loss_vjp(labels, encode, bool(pos_triplets_only),
+                               row_valid, tuple(tiles), bool(interpret))
 
 
 # ------------------------------------------------------------------ masking noise
