@@ -63,6 +63,23 @@ def _on_tpu():
 
 # --------------------------------------------------------------------- batch_all
 
+def _tile_terms(dp_ij, dp_ik, a, b, j, k, tj, tk, pos_only):
+    """One VMEM tile of the [B, B, B] quantities, shared by the forward and
+    BOTH backward kernels so the loss definition lives in exactly one place:
+    returns (valid3, dist, pos3, mask) for logical block coords (j, k)."""
+    # j != k is the only distinctness not implied by the label masks
+    jj = jax.lax.broadcasted_iota(jnp.int32, (tj, tk), 0) + j * tj
+    kk = jax.lax.broadcasted_iota(jnp.int32, (tj, tk), 1) + k * tk
+    neq_jk = (jj != kk).astype(jnp.float32)
+
+    # the [ti, tj, tk] cube exists only as this VMEM tile
+    valid3 = a[:, :, None] * b[:, None, :] * neq_jk[None, :, :]
+    dist = dp_ik[:, None, :] - dp_ij[:, :, None]   # reference :96-106
+    pos3 = (valid3 * dist > _EPS).astype(jnp.float32)  # reference :114
+    mask = pos3 if pos_only else valid3
+    return valid3, dist, pos3, mask
+
+
 def _batch_all_kernel(dp_ij_ref, dp_ik_ref, a_ref, b_ref,
                       stats_ref, aw_ref, pw_ref, nw_ref,
                       *, ti, tj, tk, pos_only):
@@ -82,16 +99,8 @@ def _batch_all_kernel(dp_ij_ref, dp_ik_ref, a_ref, b_ref,
     a = a_ref[:]                  # [ti, tj] anchor/positive validity (labels eq, i!=j, rows valid)
     b = b_ref[:]                  # [ti, tk] anchor/negative validity (labels neq => i!=k free)
 
-    # j != k is the only distinctness not implied by the label masks
-    jj = jax.lax.broadcasted_iota(jnp.int32, (tj, tk), 0) + j * tj
-    kk = jax.lax.broadcasted_iota(jnp.int32, (tj, tk), 1) + k * tk
-    neq_jk = (jj != kk).astype(jnp.float32)
-
-    # the [ti, tj, tk] cube exists only as this VMEM tile
-    valid3 = a[:, :, None] * b[:, None, :] * neq_jk[None, :, :]
-    dist = dp_ik[:, None, :] - dp_ij[:, :, None]   # reference :96-106
-    pos3 = (valid3 * dist > _EPS).astype(jnp.float32)  # reference :114
-    mask = pos3 if pos_only else valid3
+    valid3, dist, pos3, mask = _tile_terms(dp_ij, dp_ik, a, b, j, k, tj, tk,
+                                           pos_only)
 
     sp = jax.nn.softplus(dist)                      # reference :126
     s_loss = jnp.sum(sp * mask)
@@ -152,72 +161,79 @@ def _batch_all_pallas(dp, a, b, pos_triplets_only, tiles, interpret):
     )(dp, dp, a, b)
 
 
-def _batch_all_bwd_kernel(dp_ij_ref, dp_ik_ref, a_ref, b_ref,
-                          gij_ref, gik_ref, *, ti, tj, tk, pos_only):
-    """dL/d(dp) tiles for the batch_all loss, same grid as the forward.
-
-    Per triplet, s = sigmoid(dist) * mask (mask is comparison-derived, so its
-    gradient is exactly zero — identical to XLA autodiff through the
-    indicator): dL/ddp[i,k] += s and dL/ddp[i,j] -= s, scaled by 1/num_sel in
-    the wrapper. gij blocks are revisited across k (init at k==0), gik blocks
-    across j (init at j==0)."""
-    i = pl.program_id(0)
+def _batch_all_bwd_gij_kernel(dp_ij_ref, dp_ik_ref, a_ref, b_ref, gij_ref,
+                              *, ti, tj, tk, pos_only):
+    """-dL/d(dp[i,j]) * num_sel: grid (I, J, K) — the k-reduction is the
+    INNERMOST grid axis, so the gij[i,j] output block is revisited on
+    consecutive steps only. Compiled Pallas TPU preserves an output buffer
+    across consecutive same-index steps and does not re-read flushed blocks;
+    a middle-axis reduction would silently drop partial sums on hardware
+    (interpret mode can't catch that — hence one kernel per reduction)."""
     j = pl.program_id(1)
     k = pl.program_id(2)
-
-    dp_ij = dp_ij_ref[:]
-    dp_ik = dp_ik_ref[:]
-    a = a_ref[:]
-    b = b_ref[:]
-    jj = jax.lax.broadcasted_iota(jnp.int32, (tj, tk), 0) + j * tj
-    kk = jax.lax.broadcasted_iota(jnp.int32, (tj, tk), 1) + k * tk
-    neq_jk = (jj != kk).astype(jnp.float32)
-
-    valid3 = a[:, :, None] * b[:, None, :] * neq_jk[None, :, :]
-    dist = dp_ik[:, None, :] - dp_ij[:, :, None]
-    if pos_only:
-        mask = (valid3 * dist > _EPS).astype(jnp.float32)
-    else:
-        mask = valid3
+    _, dist, _, mask = _tile_terms(dp_ij_ref[:], dp_ik_ref[:], a_ref[:],
+                                   b_ref[:], j, k, tj, tk, pos_only)
     s = jax.nn.sigmoid(dist) * mask                       # [ti, tj, tk]
 
     @pl.when(k == 0)
     def _():
         gij_ref[:] = jnp.zeros_like(gij_ref)
 
+    gij_ref[:] += -jnp.sum(s, axis=2)                     # [ti, tj]
+
+
+def _batch_all_bwd_gik_kernel(dp_ij_ref, dp_ik_ref, a_ref, b_ref, gik_ref,
+                              *, ti, tj, tk, pos_only):
+    """dL/d(dp[i,k]) * num_sel: grid (I, K, J) — program_id(1) is the k-block
+    and program_id(2) the j-block, putting the j-reduction innermost so the
+    gik[i,k] output block sees only consecutive revisits (see gij twin)."""
+    k = pl.program_id(1)
+    j = pl.program_id(2)
+    _, dist, _, mask = _tile_terms(dp_ij_ref[:], dp_ik_ref[:], a_ref[:],
+                                   b_ref[:], j, k, tj, tk, pos_only)
+    s = jax.nn.sigmoid(dist) * mask                       # [ti, tj, tk]
+
     @pl.when(j == 0)
     def _():
         gik_ref[:] = jnp.zeros_like(gik_ref)
 
-    gij_ref[:] += -jnp.sum(s, axis=2)                     # [ti, tj]
     gik_ref[:] += jnp.sum(s, axis=1)                      # [ti, tk]
 
 
 @functools.partial(jax.jit, static_argnames=("pos_triplets_only", "tiles",
                                              "interpret"))
 def _batch_all_pallas_bwd(dp, a, b, pos_triplets_only, tiles, interpret):
+    """Two passes over the cube, one per reduction axis — each pallas_call
+    keeps its accumulated output block on the innermost grid axis (the only
+    revisit pattern compiled Mosaic guarantees to accumulate correctly)."""
     bp = dp.shape[0]
     ti, tj, tk = tiles
-    grid = (bp // ti, bp // tj, bp // tk)
-    kernel = functools.partial(_batch_all_bwd_kernel, ti=ti, tj=tj, tk=tk,
-                               pos_only=pos_triplets_only)
-    gij, gik = pl.pallas_call(
-        kernel,
-        grid=grid,
+    gij = pl.pallas_call(
+        functools.partial(_batch_all_bwd_gij_kernel, ti=ti, tj=tj, tk=tk,
+                          pos_only=pos_triplets_only),
+        grid=(bp // ti, bp // tj, bp // tk),
         in_specs=[
             pl.BlockSpec((ti, tj), lambda i, j, k: (i, j)),
             pl.BlockSpec((ti, tk), lambda i, j, k: (i, k)),
             pl.BlockSpec((ti, tj), lambda i, j, k: (i, j)),
             pl.BlockSpec((ti, tk), lambda i, j, k: (i, k)),
         ],
-        out_specs=[
-            pl.BlockSpec((ti, tj), lambda i, j, k: (i, j)),
-            pl.BlockSpec((ti, tk), lambda i, j, k: (i, k)),
+        out_specs=pl.BlockSpec((ti, tj), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((bp, bp), jnp.float32),
+        interpret=interpret,
+    )(dp, dp, a, b)
+    gik = pl.pallas_call(
+        functools.partial(_batch_all_bwd_gik_kernel, ti=ti, tj=tj, tk=tk,
+                          pos_only=pos_triplets_only),
+        grid=(bp // ti, bp // tk, bp // tj),   # (I, K, J): j innermost
+        in_specs=[
+            pl.BlockSpec((ti, tj), lambda i, k, j: (i, j)),
+            pl.BlockSpec((ti, tk), lambda i, k, j: (i, k)),
+            pl.BlockSpec((ti, tj), lambda i, k, j: (i, j)),
+            pl.BlockSpec((ti, tk), lambda i, k, j: (i, k)),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bp, bp), jnp.float32),
-            jax.ShapeDtypeStruct((bp, bp), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec((ti, tk), lambda i, k, j: (i, k)),
+        out_shape=jax.ShapeDtypeStruct((bp, bp), jnp.float32),
         interpret=interpret,
     )(dp, dp, a, b)
     return gij + gik

@@ -99,8 +99,8 @@ def main(argv=None):
         f"Generated {payload['generated']} on platform `{platform}`, seed "
         f"{SEED}, **{wall:.0f}s end to end** on one chip.",
         "",
-        "Reproduce: `python evidence/scale.py`"
-        + (" --cpu" if "--cpu" in argv else "") + ".",
+        "Reproduce: `python evidence/scale.py"
+        + (" --cpu" if "--cpu" in argv else "") + "`.",
         "",
         "Pipeline: 105k synthetic docs -> CountVectorizer (50k features) -> "
         "DAE with batch_hard mining (10k-row batches, sparse-ingest feed, "
