@@ -173,3 +173,19 @@ def test_batch_all_vjp_trains_one_step(rng):
     l1, _, _ = step(enc1)
     assert float(l1) < float(l0)
     assert aux[0].shape == (b,)  # data_weight rides along untouched
+
+
+@pytest.mark.skipif(not ON_TPU, reason="block-revisit semantics are a "
+                    "compiled-Mosaic property the interpreter can't exercise")
+def test_batch_all_vjp_multiblock_grid_tpu(rng):
+    """COMPILED backward with J = K = 2 (b=256 at the default (8,128,128)
+    tiles): the gradient accumulators see genuine block revisits, the case
+    where a middle-axis reduction silently drops partial sums on hardware."""
+    b, d = 256, 32
+    labels = jnp.asarray(rng.integers(0, 6, b), jnp.int32)
+    enc = jnp.asarray(rng.normal(size=(b, d)).astype(np.float32))
+    gp = jax.grad(lambda e: batch_all_triplet_loss_pallas(
+        labels, e, tiles=(8, 128, 128), interpret=False)[0])(enc)
+    go = jax.grad(lambda e: triplet.batch_all_triplet_loss(labels, e)[0])(enc)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(go),
+                               rtol=1e-4, atol=1e-5)
