@@ -215,3 +215,25 @@ def test_ragged_tail_adapts_or_warns():
         warnings.simplefilter("always")
         SI.sparse_encode_matmul(w, small, jnp.ones((3, 3)), chunk=8)
     assert not any("divisor" in str(r.message) for r in rec)
+
+
+def test_ragged_divisor_adaptation_fuzz():
+    """Any (b, chunk) pair must produce oracle-exact results — adapted chunk,
+    clamped chunk, or warned unchunked fallback alike."""
+    import warnings
+
+    rng = np.random.default_rng(7)
+    w = jnp.asarray(rng.normal(size=(60, 6)).astype(np.float32))
+    wd = np.asarray(w)
+    for b in (1, 2, 7, 30, 96, 97, 120):
+        for chunk in (1, 3, 8, 32, 256):
+            idx = rng.integers(0, 60, (b, 4))
+            vals = rng.uniform(size=(b, 4)).astype(np.float32)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                got = SI.sparse_encode_matmul(w, jnp.asarray(idx, jnp.int32),
+                                              jnp.asarray(vals), chunk=chunk)
+            dense = np.zeros((b, 60), np.float32)
+            np.add.at(dense, (np.arange(b)[:, None], idx), vals)
+            np.testing.assert_allclose(np.asarray(got), dense @ wd,
+                                       rtol=2e-5, atol=1e-5)
