@@ -120,8 +120,9 @@ _WORKER = textwrap.dedent("""
 # with orbax per process, restores ACROSS processes, and resumes training
 _FIT_WORKER = textwrap.dedent("""
     import os, sys
-    pid, port, repo, workdir = (int(sys.argv[1]), sys.argv[2], sys.argv[3],
-                                sys.argv[4])
+    pid, port, repo, workdir, nproc = (int(sys.argv[1]), sys.argv[2],
+                                       sys.argv[3], sys.argv[4],
+                                       int(sys.argv[5]))
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     sys.path.insert(0, repo)
@@ -133,8 +134,9 @@ _FIT_WORKER = textwrap.dedent("""
         get_mesh, initialize_multihost)
 
     initialize_multihost(coordinator_address=f"127.0.0.1:{port}",
-                         num_processes=2, process_id=pid)
-    assert len(jax.devices()) == 4
+                         num_processes=nproc, process_id=pid)
+    n_dev = 2 * nproc
+    assert len(jax.devices()) == n_dev
     os.chdir(workdir)
 
     import numpy as np
@@ -144,11 +146,11 @@ _FIT_WORKER = textwrap.dedent("""
     from dae_rnn_news_recommendation_tpu.utils.checkpoint import (
         latest_checkpoint, load_checkpoint)
 
-    b, f = 32, 20  # global rows; each process owns half
-    rng = np.random.default_rng(0)  # same stream both processes
+    b, f = 32 * (nproc // 2), 20  # global rows, split evenly by process
+    rng = np.random.default_rng(0)  # same stream on every process
     X = (rng.uniform(size=(b, f)) < 0.3).astype(np.float32)
     y = rng.integers(0, 4, b).astype(np.int32)
-    lo, hi = pid * (b // 2), (pid + 1) * (b // 2)
+    lo, hi = pid * (b // nproc), (pid + 1) * (b // nproc)
 
     def make_model(num_epochs):
         # ONE shared artifact tree: orbax checkpoints are saved collectively
@@ -160,7 +162,7 @@ _FIT_WORKER = textwrap.dedent("""
             learning_rate=0.1, corr_type="masking", corr_frac=0.3,
             triplet_strategy="batch_all", alpha=1.0, seed=0,
             verbose=False, verbose_step=10, checkpoint_every=1,
-            mesh=get_mesh(4), mining_scope="global")
+            mesh=get_mesh(n_dev), mining_scope="global")
 
     model = make_model(num_epochs=2)
     model.fit(X[lo:hi], train_set_label=y[lo:hi])
@@ -169,7 +171,8 @@ _FIT_WORKER = textwrap.dedent("""
     # both processes' replicated params must agree bit-for-bit: training was
     # one collective computation
     gathered = multihost_utils.process_allgather(own["W"])
-    np.testing.assert_array_equal(gathered[0], gathered[1])
+    for g in gathered[1:]:
+        np.testing.assert_array_equal(gathered[0], g)
 
     # every process restores the collectively written checkpoint and must
     # find the identical replicated state
@@ -237,9 +240,7 @@ def test_two_process_distributed_psum(tmp_path):
     assert "MULTIHOST_OK 0" in joined and "MULTIHOST_OK 1" in joined
 
 
-def test_two_process_end_to_end_fit(tmp_path):
-    """The exact pod path: fit() with process-local feeding, collective
-    training, per-process orbax checkpoints, cross-process restore, resume."""
+def _run_fit_workers(tmp_path, nproc, timeout=420):
     try:
         port = _free_port()
     except OSError:
@@ -254,15 +255,15 @@ def test_two_process_end_to_end_fit(tmp_path):
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     procs = [
         subprocess.Popen([sys.executable, str(worker), str(pid), str(port),
-                          repo, str(workdir)],
+                          repo, str(workdir), str(nproc)],
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                          text=True, env=env)
-        for pid in (0, 1)
+        for pid in range(nproc)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -276,4 +277,19 @@ def test_two_process_end_to_end_fit(tmp_path):
         pytest.skip("gloo collectives backend unavailable")
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
-    assert "MULTIHOST_FIT_OK 0" in joined and "MULTIHOST_FIT_OK 1" in joined
+    for pid in range(nproc):
+        assert f"MULTIHOST_FIT_OK {pid}" in joined
+
+
+def test_two_process_end_to_end_fit(tmp_path):
+    """The exact pod path: fit() with process-local feeding, collective
+    training, shared collective orbax checkpoints, cross-process restore,
+    resume."""
+    _run_fit_workers(tmp_path, nproc=2)
+
+
+def test_four_process_end_to_end_fit(tmp_path):
+    """Same pod path at 4 processes x 2 devices: multiple NON-primary hosts
+    participate in the collective checkpoint (the orbax primary-commit
+    semantics that made per-process dirs silently uncommitted)."""
+    _run_fit_workers(tmp_path, nproc=4)
