@@ -98,6 +98,19 @@ def test_run_child_overall_timeout(bench):
     assert time.monotonic() - t0 < 60
 
 
+def _fake_time(sleep_fn):
+    """A time-module stand-in swapped in for bench's module-global `time`
+    binding. NEVER patch time.sleep on the real module: bench.time IS the
+    global time module, and background threads from other tests (orbax
+    writers, prefetchers) call time.sleep concurrently — patching the global
+    pollutes sleep recordings and makes those threads spin."""
+    import time as _real
+    from types import SimpleNamespace
+
+    return SimpleNamespace(sleep=sleep_fn, monotonic=_real.monotonic,
+                           perf_counter=_real.perf_counter, time=_real.time)
+
+
 def _scripted_main(bench, monkeypatch, tmp_path, probe_script, child_script,
                    sidecar=None):
     """Run bench.main() with _tpu_alive/_run_child replaced by scripted fakes
@@ -116,7 +129,7 @@ def _scripted_main(bench, monkeypatch, tmp_path, probe_script, child_script,
             json.dump(sidecar, f)
     monkeypatch.setattr(bench, "SIDECAR_PATH", side_path)
     monkeypatch.setattr(bench, "_tpu_alive", lambda attempt: next(probes))
-    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "time", _fake_time(lambda s: None))
 
     def fake_run_child(argv, env, overall_timeout, noprogress_timeout=None):
         envs.append(dict(env))
@@ -241,7 +254,7 @@ def _scripted_capture(bench, monkeypatch, tmp_path, probe_script, child_script):
 
     monkeypatch.setattr(bench, "SIDECAR_PATH", str(tmp_path / "bench_tpu.json"))
     monkeypatch.setattr(bench, "_tpu_alive", lambda attempt: next(probes))
-    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    monkeypatch.setattr(bench, "time", _fake_time(sleeps.append))
     monkeypatch.setattr(bench, "_run_child",
                         lambda *a, **k: next(children))
     printed = []
