@@ -183,16 +183,12 @@ def _make_pool(n_rows, rng):
     return sp.csr_matrix((data, idx.ravel(), indptr), shape=(n_rows, F))
 
 
-def _bench_encode(jax, params, config, sz, via_dense=False):
-    import jax.numpy as jnp  # noqa: F401  (device path)
+def _pack_encode_feeds(sz):
+    """Host-side packed inputs for the encode stream, shared across strategy
+    races (packing ~600k rows dominates host prep; pay it once)."""
+    from dae_rnn_news_recommendation_tpu.ops.sparse_ingest import pad_csr_batch
 
-    from dae_rnn_news_recommendation_tpu.ops.sparse_ingest import (
-        pad_csr_batch, sparse_encode)
-
-    enc_fn = jax.jit(lambda p, i: sparse_encode(p, i, None, config, chunk=512,
-                                                via_dense=via_dense))
     batch, n_batches = sz["batch"], sz["n_batches"]
-
     rng = np.random.default_rng(0)
     # EVERY timed dispatch gets distinct input contents: the TPU tunnel in this
     # environment memoizes (executable, inputs) pairs, so repeating a pool slice
@@ -213,6 +209,18 @@ def _bench_encode(jax, params, config, sz, via_dense=False):
                       binary=True)["indices"]
         for i in range(sz["warmup"])
     ]
+    return host_feeds, warmup_feeds
+
+
+def _bench_encode(jax, params, config, sz, via_dense=False, feeds=None):
+    import jax.numpy as jnp  # noqa: F401  (device path)
+
+    from dae_rnn_news_recommendation_tpu.ops.sparse_ingest import sparse_encode
+
+    enc_fn = jax.jit(lambda p, i: sparse_encode(p, i, None, config, chunk=512,
+                                                via_dense=via_dense))
+    batch, n_batches = sz["batch"], sz["n_batches"]
+    host_feeds, warmup_feeds = feeds if feeds is not None else _pack_encode_feeds(sz)
 
     _phase("encode: inputs packed; compiling + warmup")
     for i in range(sz["warmup"]):
@@ -365,7 +373,8 @@ def child_main():
     )
     params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
 
-    encode_aps = _bench_encode(jax, params, config, sz)
+    feeds = _pack_encode_feeds(sz)
+    encode_aps = _bench_encode(jax, params, config, sz, feeds=feeds)
 
     extra = {"platform": platform, "jax_version": jax.__version__,
              "device_kind": dev.device_kind}
@@ -374,10 +383,12 @@ def child_main():
         # race the two equivalent x@W strategies (ops/sparse_ingest.py):
         # gather-accumulate (VPU/HBM) vs densify+matmul (MXU, 2x [B,F] HBM
         # traffic) — which wins depends on density and chip generation, so
-        # the headline takes the measured max and records both
+        # the headline takes the measured max and records both (same packed
+        # feeds: host prep is paid once)
         try:
             _phase("encode: via_dense strategy")
-            dense_aps = _bench_encode(jax, params, config, sz, via_dense=True)
+            dense_aps = _bench_encode(jax, params, config, sz, via_dense=True,
+                                      feeds=feeds)
             extra["encode_via_dense_articles_per_sec"] = round(dense_aps, 1)
             if dense_aps > encode_aps:
                 encode_aps = dense_aps
