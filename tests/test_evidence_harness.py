@@ -71,3 +71,19 @@ def test_results_md_flags_mixed_provenance(evrun, monkeypatch, tmp_path):
     evrun._write_md(dict(payload, uniform_provenance=False))
     mixed_md = (tmp_path / "RESULTS.md").read_text()
     assert "WARNING" in mixed_md and "different runs or platforms" in mixed_md
+
+
+def test_evidence_arg_lists_parse(evrun):
+    """Flag renames must not silently rot the committed evidence scripts: every
+    stage's arg list parses against the live config schema."""
+    from dae_rnn_news_recommendation_tpu.utils.config import parse_flags
+
+    for name in ("MAIN_ARGS", "STORY_ARGS", "MOE_ARGS", "REFSCALE_ARGS"):
+        parse_flags(getattr(evrun, name))
+
+    spec = importlib.util.spec_from_file_location(
+        "scale_under_test", os.path.join(REPO, "evidence", "scale.py"))
+    scale = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(scale)
+    flags = parse_flags(scale.ARGS)
+    assert flags.max_features == 50000 and flags.train_row == 100000
