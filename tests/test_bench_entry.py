@@ -32,6 +32,10 @@ TINY = dict(batch=64, n_batches=2, warmup=1, prefetch=1,
 
 
 def test_bench_functions_produce_finite_rates(bench):
+    """Every measurement the child can run — including the TPU-only branches
+    (via_dense race on shared feeds, large-batch train override) — must
+    execute: a bug in a TPU-only path would otherwise surface only on
+    hardware, burning a scarce tunnel window."""
     from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
 
     config = DAEConfig(
@@ -40,10 +44,14 @@ def test_bench_functions_produce_finite_rates(bench):
         corr_frac=0.0, triplet_strategy="none", compute_dtype="bfloat16")
     params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
 
-    r_enc = bench._bench_encode(jax, params, config, TINY)
+    feeds = bench._pack_encode_feeds(TINY)
+    r_enc = bench._bench_encode(jax, params, config, TINY, feeds=feeds)
+    r_dense = bench._bench_encode(jax, params, config, TINY, via_dense=True,
+                                  feeds=feeds)
     r_train = bench._bench_train(jax, TINY)
+    r_big = bench._bench_train(jax, TINY, batch_override=48, steps_override=2)
     r_stream = bench._bench_train_stream(jax, TINY)
-    for r in (r_enc, r_train, r_stream):
+    for r in (r_enc, r_dense, r_train, r_big, r_stream):
         assert np.isfinite(r) and r > 0.0
 
 
