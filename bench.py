@@ -597,25 +597,36 @@ def _read_sidecar():
     return None
 
 
+def _emit_live(record):
+    """Print a this-run measurement with the top-level live=true marker
+    (counterpart of the substituted records' live=false, ADVICE r3)."""
+    out = {**record, "live": True}
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def _emit(live_record):
     """The single stdout JSON line. A live TPU record is emitted as-is (and
     refreshes the sidecar). A CPU/failed record is upgraded to the last-good
     TPU sidecar headline when one exists — clearly labeled with capture time
     and git rev — with the live measurement preserved in extra."""
     if live_record.get("extra", {}).get("platform") == "tpu":
-        _write_sidecar(live_record)
-        print(json.dumps(live_record), flush=True)
-        return live_record
+        _write_sidecar(live_record)  # sidecar stores the raw record, no flag
+        return _emit_live(live_record)
     side = _read_sidecar()
     if side is None:
-        print(json.dumps(live_record), flush=True)
-        return live_record
+        return _emit_live(live_record)
     try:
         # tolerate schema drift in a committed artifact: a malformed sidecar
         # must never cost a successfully measured live record
         tpu_rec = side["record"]
         merged = {
             "metric": tpu_rec.get("metric", "encode_articles_per_sec"),
+            # top-level marker so automation can mechanically distinguish a
+            # sidecar-substituted headline from a this-run measurement
+            # (ADVICE r3): the headline's rev/time live in `unit` and
+            # extra.tpu_sidecar, the live measurement in extra.live_fallback
+            "live": False,
             "value": tpu_rec["value"],
             "unit": (str(tpu_rec.get("unit", "articles/sec (tpu)"))
                      + " — last-good TPU sidecar, captured "
@@ -634,8 +645,7 @@ def _emit(live_record):
         }
     except Exception as e:
         _diag(-1, f"sidecar merge failed ({e!r}); emitting live record")
-        print(json.dumps(live_record), flush=True)
-        return live_record
+        return _emit_live(live_record)
     print(json.dumps(merged), flush=True)
     return merged
 
@@ -673,7 +683,7 @@ def capture_tpu_main():
             rec = _attempt_child(attempt, dict(os.environ), CHILD_TIMEOUT)
             if rec is not None and rec.get("extra", {}).get("platform") == "tpu":
                 _write_sidecar(rec)
-                print(json.dumps(rec), flush=True)
+                _emit_live(rec)
                 return 0
             if rec is not None:
                 _diag(attempt, "child record is not TPU; not captured")
@@ -739,8 +749,12 @@ def main():
         "vs_baseline": 0.0,
         "extra": {"platform": "none"},
     })
-    # a sidecar-substituted headline is still a valid round record
-    return 0 if emitted.get("value") else 1
+    if not emitted.get("value"):
+        return 1
+    # a sidecar-substituted headline is still a valid round record, but every
+    # live attempt (including the CPU fallback) failed — rc 2 lets automation
+    # keyed on the exit code detect the broken live bench (ADVICE r3)
+    return 2
 
 
 if __name__ == "__main__":

@@ -166,7 +166,21 @@ class DenoisingAutoencoder:
     def _root_key(self):
         from ..utils.seeding import resolve_seed
 
-        return jax.random.PRNGKey(resolve_seed(self.seed))
+        unseeded = self.seed is None or self.seed < 0
+        seed = resolve_seed(self.seed)
+        if unseeded and jax.process_count() > 1:
+            # An unseeded run resolves per-process OS entropy, but the pod
+            # path replicates params/opt_state via put_replicated, whose
+            # contract requires identical host values on every process — so
+            # every process must adopt process 0's resolved seed before any
+            # param init or per-step PRNG key derives from it. (Explicit
+            # seeds are already identical everywhere; broadcasting them would
+            # be a needless collective and uint32 would truncate seeds>=2**32.)
+            from jax.experimental import multihost_utils
+
+            seed = int(multihost_utils.broadcast_one_to_all(np.uint32(seed)))
+        self._resolved_seed = seed
+        return jax.random.PRNGKey(seed)
 
     def _make_config(self, n_features):
         if self.n_components_override is not None:

@@ -48,6 +48,7 @@ each — the measured-first rule says leave it to XLA.
 """
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -254,9 +255,10 @@ def _prep_masks(labels, encode, row_valid, tiles, interpret):
     bm = (~eq & vv).astype(jnp.float32)        # anchor/negative (i!=k implied)
 
     ti, tj, tk = tiles
-    step = max(ti, tj, tk)
-    assert step % ti == 0 and step % tj == 0 and step % tk == 0, (
-        "tiles must divide their max so one padded size fits all three")
+    # one padded size must be divisible by every tile or the bp//tile grid
+    # dims would truncate and silently drop the trailing blocks — the lcm is
+    # the smallest such size (== max for the usual power-of-two tiles)
+    step = math.lcm(ti, tj, tk)
     if not interpret:
         # compiled Mosaic alignment: sublane slices 8-aligned, lane slices 128-aligned
         assert ti % 8 == 0 and tj % 8 == 0 and tk % 128 == 0, (

@@ -38,6 +38,17 @@ def save_checkpoint(ckpt_dir, state, step, use_orbax=True, multiprocess=False):
     os.makedirs(base, exist_ok=True)
     primary = not multiprocess or jax.process_index() == 0
 
+    if multiprocess and not (use_orbax and ocp is not None):
+        # the npz fallback writes params on process 0 only; unless ckpt_dir is
+        # a shared filesystem, non-primary hosts would pass the barrier with an
+        # empty step dir and any later restore on them would fail
+        import warnings
+
+        warnings.warn(
+            "multiprocess checkpoint without orbax: params are written by "
+            "process 0 only — restore on other hosts requires ckpt_dir to be "
+            "a shared filesystem", RuntimeWarning, stacklevel=2)
+
     params_path = os.path.join(base, "params")
     if use_orbax and ocp is not None:
         ckptr = ocp.StandardCheckpointer()

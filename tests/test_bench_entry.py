@@ -169,7 +169,8 @@ def test_main_dead_tunnel_falls_back_to_cpu(bench, monkeypatch, tmp_path):
         bench, monkeypatch, tmp_path,
         probe_script=[False, False, False],       # attempt0: 1 probe; attempt1: 2
         child_script=[(0, METRIC + "\n", "", None)])
-    assert rc == 0 and lines == [METRIC]
+    assert rc == 0 and len(lines) == 1
+    assert json.loads(lines[0]) == {**json.loads(METRIC), "live": True}
     assert len(envs) == 1 and envs[0].get("JAX_PLATFORMS") == "cpu"
 
 
@@ -180,7 +181,8 @@ def test_main_healthy_tunnel_first_try(bench, monkeypatch, tmp_path):
         bench, monkeypatch, tmp_path,
         probe_script=[True],
         child_script=[(0, "noise\n" + TPU_METRIC + "\n", "", None)])
-    assert rc == 0 and lines == [TPU_METRIC]
+    assert rc == 0 and len(lines) == 1
+    assert json.loads(lines[0]) == {**json.loads(TPU_METRIC), "live": True}
     # exactly one child ran, and it was not the forced CPU fallback (which
     # SETS JAX_PLATFORMS=cpu; the ambient test env may already carry it)
     assert len(envs) == 1
@@ -199,7 +201,8 @@ def test_main_killed_child_retries_then_falls_back(bench, monkeypatch, tmp_path)
         probe_script=[True, False, False],
         child_script=[(None, "", "phase: train", "no heartbeat for 300s"),
                       (0, METRIC + "\n", "", None)])
-    assert rc == 0 and lines == [METRIC]
+    assert rc == 0 and len(lines) == 1
+    assert json.loads(lines[0]) == {**json.loads(METRIC), "live": True}
     assert len(envs) == 2 and envs[1].get("JAX_PLATFORMS") == "cpu"
 
 
@@ -218,6 +221,7 @@ def test_main_cpu_fallback_upgraded_by_sidecar(bench, monkeypatch, tmp_path):
     assert rc == 0 and len(lines) == 1
     rec = json.loads(lines[0])
     assert rec["value"] == 2_000_000.0 and rec["vs_baseline"] == 10.0
+    assert rec["live"] is False  # mechanically marked as substituted
     assert "last-good TPU sidecar" in rec["unit"]
     assert "2026-07-31" in rec["unit"] and "cafecafec" in rec["unit"]
     assert rec["extra"]["live_fallback"] == json.loads(cpu_rec)
@@ -240,16 +244,18 @@ def test_main_total_failure_emits_zero_record(bench, monkeypatch, tmp_path):
 def test_main_total_failure_with_sidecar_still_lands_tpu(bench, monkeypatch,
                                                          tmp_path):
     """Total live failure + existing sidecar -> the TPU headline is still the
-    round record and rc is 0 (a valid figure was emitted)."""
+    round record, but rc is 2 and the record carries live=false so automation
+    can detect that the live bench is broken (ADVICE r3)."""
     rc, lines, envs = _scripted_main(
         bench, monkeypatch, tmp_path,
         probe_script=[True, True, True],
         child_script=[(1, "", "boom", None), (1, "", "boom", None),
                       (1, "", "boom", None)],
         sidecar=SIDE)
-    assert rc == 0 and len(lines) == 1
+    assert rc == 2 and len(lines) == 1
     rec = json.loads(lines[0])
     assert rec["value"] == 2_000_000.0
+    assert rec["live"] is False
     assert rec["extra"]["live_fallback"]["value"] == 0.0
 
 

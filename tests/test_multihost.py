@@ -197,33 +197,87 @@ _FIT_WORKER = textwrap.dedent("""
 """)
 
 
+# unseeded (seed=-1) pod runs: resolve_seed draws per-process OS entropy, so
+# without the broadcast in _root_key every process would init different params
+# and put_replicated would assemble a silently inconsistent "replicated" array
+_UNSEEDED_WORKER = textwrap.dedent("""
+    import os, sys
+    pid, port, repo, workdir = (int(sys.argv[1]), sys.argv[2], sys.argv[3],
+                                sys.argv[4])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from dae_rnn_news_recommendation_tpu.parallel import (
+        get_mesh, initialize_multihost)
+
+    initialize_multihost(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=2, process_id=pid)
+    os.chdir(workdir)
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+
+    rng = np.random.default_rng(100 + pid)  # deliberately DIFFERENT data rng
+    X = (rng.uniform(size=(8, 12)) < 0.3).astype(np.float32)
+    model = DenoisingAutoencoder(
+        model_name="mh_unseeded", main_dir="mh_unseeded/",
+        results_root="results_shared", num_epochs=1, batch_size=8,
+        opt="ada_grad", learning_rate=0.1, corr_type="masking", corr_frac=0.3,
+        triplet_strategy="none", seed=-1, verbose=False, checkpoint_every=0,
+        mesh=get_mesh(4), mining_scope="global")
+    model.fit(X)
+
+    # every process must have adopted process 0's resolved seed...
+    seeds = multihost_utils.process_allgather(
+        np.asarray(model._resolved_seed, np.uint32))
+    assert (seeds == seeds[0]).all(), seeds
+    # ...and the trained replicated params must agree bit-for-bit
+    gathered = multihost_utils.process_allgather(
+        np.asarray(model.params["W"]))
+    for g in gathered[1:]:
+        np.testing.assert_array_equal(gathered[0], g)
+    print("MULTIHOST_UNSEEDED_OK", pid, flush=True)
+""")
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_psum(tmp_path):
+def _run_workers(tmp_path, worker_src, ok_marker, nproc, extra_argv=(),
+                 timeout=240):
+    """Launch nproc copies of `worker_src` (argv: pid, port, repo, *extra_argv),
+    join them, skip on missing sockets/gloo, and assert every worker printed
+    `ok_marker <pid>`. Returns the joined output."""
     try:
         port = _free_port()
     except OSError:
         pytest.skip("sandbox forbids sockets")
     worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+    worker.write_text(worker_src)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     procs = [
-        subprocess.Popen([sys.executable, str(worker), str(pid), str(port), repo],
+        subprocess.Popen([sys.executable, str(worker), str(pid), str(port),
+                          repo, *map(str, extra_argv)],
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                          text=True, env=env)
-        for pid in (0, 1)
+        for pid in range(nproc)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -237,48 +291,20 @@ def test_two_process_distributed_psum(tmp_path):
         pytest.skip("gloo collectives backend unavailable")
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
-    assert "MULTIHOST_OK 0" in joined and "MULTIHOST_OK 1" in joined
+    for pid in range(nproc):
+        assert f"{ok_marker} {pid}" in joined
+    return joined
+
+
+def test_two_process_distributed_psum(tmp_path):
+    _run_workers(tmp_path, _WORKER, "MULTIHOST_OK", nproc=2, timeout=180)
 
 
 def _run_fit_workers(tmp_path, nproc, timeout=420):
-    try:
-        port = _free_port()
-    except OSError:
-        pytest.skip("sandbox forbids sockets")
-    worker = tmp_path / "fit_worker.py"
-    worker.write_text(_FIT_WORKER)
     workdir = tmp_path / "run"
     workdir.mkdir()
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    procs = [
-        subprocess.Popen([sys.executable, str(worker), str(pid), str(port),
-                          repo, str(workdir), str(nproc)],
-                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                         text=True, env=env)
-        for pid in range(nproc)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=timeout)
-            outs.append(out)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.fail("multihost fit workers timed out; partial output: "
-                    + " | ".join(outs))
-
-    joined = "\n".join(outs)
-    if any(p.returncode != 0 for p in procs) and (
-            "gloo" in joined.lower() and "unavailable" in joined.lower()):
-        pytest.skip("gloo collectives backend unavailable")
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out[-2000:]
-    for pid in range(nproc):
-        assert f"MULTIHOST_FIT_OK {pid}" in joined
+    _run_workers(tmp_path, _FIT_WORKER, "MULTIHOST_FIT_OK", nproc=nproc,
+                 extra_argv=(workdir, nproc), timeout=timeout)
 
 
 def test_two_process_end_to_end_fit(tmp_path):
@@ -286,6 +312,16 @@ def test_two_process_end_to_end_fit(tmp_path):
     training, shared collective orbax checkpoints, cross-process restore,
     resume."""
     _run_fit_workers(tmp_path, nproc=2)
+
+
+def test_two_process_unseeded_fit_agrees(tmp_path):
+    """seed=-1 on the pod path: _root_key must broadcast process 0's resolved
+    seed so replicated init/corruption PRNG streams are identical (ADVICE r3
+    medium)."""
+    workdir = tmp_path / "run"
+    workdir.mkdir()
+    _run_workers(tmp_path, _UNSEEDED_WORKER, "MULTIHOST_UNSEEDED_OK", nproc=2,
+                 extra_argv=(workdir,))
 
 
 def test_four_process_end_to_end_fit(tmp_path):

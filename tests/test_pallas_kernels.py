@@ -69,6 +69,22 @@ def test_batch_all_tile_shapes(rng):
                                    rtol=1e-6)
 
 
+def test_batch_all_mixed_tiles_lcm_padding(rng):
+    """Tiles where no single tile divides the max (lcm > max): the padded
+    extent must be the lcm or the bp//tile grids would silently drop trailing
+    blocks (ADVICE r3). Interpreter takes arbitrary tiles; on TPU use
+    Mosaic-aligned tiles with the same property."""
+    b = 26
+    labels = jnp.asarray(rng.integers(0, 3, b))
+    enc = jnp.asarray(rng.normal(size=(b, 5)).astype(np.float32))
+    rv = jnp.asarray((rng.uniform(size=b) < 0.8).astype(np.float32))
+    tile_sets = ([(24, 128, 128), (40, 128, 128)] if ON_TPU
+                 else [(6, 8, 8), (4, 6, 12), (10, 4, 8)])
+    for tiles in tile_sets:
+        for pos_only in (False, True):
+            _compare(labels, enc, pos_only, rv, tiles=tiles)
+
+
 def test_batch_all_no_valid_triplets(rng):
     """Single class -> no negatives -> loss 0, weights 0 (reference class=1 edge,
     test_triplet_loss_utils.py:11)."""
