@@ -1,0 +1,116 @@
+"""Shared similarity-eval tail for the experiment drivers: representations x
+splits x label kinds -> AUROCs + boxplot PNGs + nearest-neighbor printout.
+
+One implementation of the reference's duplicated eval blocks
+(main_autoencoder.py:303-360 and main_autoencoder_triplet.py:249-321 repeat the
+same pairwise-similarity/plot/NN code driver by driver), with the memory-safe
+streaming variant selected by the caller: above the full-matrix threshold the
+[N, N] similarity matrices never materialize (eval/streaming_auroc.py)."""
+
+import numpy as np
+
+LABEL_KINDS = (("label_category_publish_name", "(Category)"),
+               ("label_story", "(Story)"))
+REP_TITLES = {"tfidf": "TFIDF Vectorized",
+              "binary_count": "Binary Count Vectorized",
+              "encoded": "Encoded"}
+
+
+def similarity_eval(reps, labels, plot_dir, streaming, sim_cache=None):
+    """AUROCs for every representation x split x label kind.
+
+    reps:   {kind: (train_matrix, validate_matrix_or_None)}
+    labels: {label_kind: {"train": 1-D labels, "validate": labels_or_None}}
+            with label kinds named as in LABEL_KINDS
+    Returns {key: auroc} under the reference's artifact naming
+    (`similarity_boxplot_{kind}[_validate]{suffix}`); degenerate label/split
+    combinations yield nan and skip their plot.
+
+    `sim_cache` (non-streaming only): a dict the TRAIN-split [N, N] similarity
+    matrices are stashed into by kind, so nn_printout can reuse instead of
+    recompute them — they are the eval tail's memory high-water mark.
+    """
+    aurocs = {}
+    if streaming:
+        from ..eval import streaming_auroc, visualize_similarity_from_histograms
+
+        for kind, (tr_rep, vl_rep) in reps.items():
+            for split, rep in (("train", tr_rep), ("validate", vl_rep)):
+                if rep is None:
+                    continue
+                # both label kinds share one pair sweep (similarity blocks
+                # are label-independent)
+                kinds_here = [(lab, sfx) for lab, sfx in LABEL_KINDS
+                              if labels.get(lab, {}).get(split) is not None]
+                if not kinds_here:
+                    continue
+                lab_mat = np.stack([np.asarray(labels[lab][split])
+                                    for lab, _ in kinds_here])
+                _, h_rel, h_unrel, edges = streaming_auroc(
+                    rep, lab_mat, return_histograms=True)
+                for l, (lab, suffix) in enumerate(kinds_here):
+                    key = (f"similarity_boxplot_{kind}"
+                           f"{'_validate' if split == 'validate' else ''}"
+                           f"{suffix}")
+                    aurocs[key] = visualize_similarity_from_histograms(
+                        h_rel[l], h_unrel[l], edges,
+                        title=(f"Cosine Similarity ({REP_TITLES[kind]}) "
+                               f"({split.title()} Data){suffix}"),
+                        save_path=plot_dir + key + ".png")
+        return aurocs
+
+    from ..eval import pairwise_similarity, visualize_pairwise_similarity
+
+    for kind, (tr_rep, vl_rep) in reps.items():
+        metric = "linear kernel" if kind == "tfidf" else "cosine"
+        for split, rep in (("train", tr_rep), ("validate", vl_rep)):
+            if rep is None:
+                continue
+            sim = pairwise_similarity(rep, metric=metric)
+            if split == "train" and sim_cache is not None:
+                sim_cache[kind] = sim
+            for lab, suffix in LABEL_KINDS:
+                lab_vals = labels.get(lab, {}).get(split)
+                if lab_vals is None:
+                    continue
+                key = (f"similarity_boxplot_{kind}"
+                       f"{'_validate' if split == 'validate' else ''}{suffix}")
+                aurocs[key] = visualize_pairwise_similarity(
+                    np.asarray(lab_vals), sim, plot="boxplot",
+                    title=(f"Cosine Similarity ({REP_TITLES[kind]}) "
+                           f"({split.title()} Data){suffix}"),
+                    save_path=plot_dir + key + ".png")
+    return aurocs
+
+
+def nn_printout(article_rows, enc_rep, count_rep, streaming, sim_cache=None):
+    """Print the reference's 5-article nearest-neighbor comparison (encoded vs
+    count representation); article_rows must align with the matrices' rows.
+    `sim_cache` reuses train-split similarity matrices a preceding
+    similarity_eval already built (missing kinds are computed here)."""
+    if streaming:
+        from ..eval import nearest_neighbor_report_from_top1, streaming_top1
+
+        rows = nearest_neighbor_report_from_top1(
+            article_rows,
+            streaming_top1(enc_rep, metric="cosine"),
+            streaming_top1(count_rep, metric="cosine"))
+    else:
+        from ..eval import nearest_neighbor_report, pairwise_similarity
+
+        cache = sim_cache or {}
+        enc_sim = cache.get("encoded")
+        if enc_sim is None:
+            enc_sim = pairwise_similarity(enc_rep, metric="cosine")
+        count_sim = cache.get("binary_count")
+        if count_sim is None:
+            count_sim = pairwise_similarity(count_rep, metric="cosine")
+        rows = nearest_neighbor_report(article_rows, enc_sim, count_sim)
+    for row in rows:
+        print(row["article"])
+        print("most similar article using count vectorizer")
+        print(row["most_similar_by_count"])
+        print("most similar article using DAE")
+        print(row["most_similar_by_embedding"])
+        print(f"score: {row['score']}")
+        print()

@@ -18,7 +18,6 @@ import numpy as np
 import pandas as pd
 
 from ..data import articles, io as hio
-from ..eval import nearest_neighbor_report, pairwise_similarity, visualize_pairwise_similarity
 from ..models import DenoisingAutoencoder
 from ..ops.corruption import decay_noise
 from ..utils.config import parse_flags
@@ -226,112 +225,36 @@ def main(argv=None):
 
     # the default eval tail holds six full [N, N] float32 matrices on host; above
     # the threshold that's the memory wall, so the streaming path takes over
+    # (tfidf rows are l2-normalized, so cosine == the reference's linear kernel)
     n_eval_max = max(X.shape[0], X_validate.shape[0])
-    if FLAGS.streaming_eval or n_eval_max > FLAGS.streaming_eval_threshold:
-        if not FLAGS.streaming_eval:
-            print(f"eval: {n_eval_max} rows > streaming_eval_threshold="
-                  f"{FLAGS.streaming_eval_threshold}, using streaming path")
-        # blockwise streaming AUROCs: no N x N matrices; the reference's
-        # ROC/boxplot figures are derived from the score histograms
-        # (tfidf rows are l2-normalized, so cosine == the reference's linear kernel)
-        from ..eval import (
-            nearest_neighbor_report_from_top1,
-            streaming_auroc,
-            streaming_top1,
-            visualize_similarity_from_histograms,
-        )
+    streaming = FLAGS.streaming_eval or n_eval_max > FLAGS.streaming_eval_threshold
+    if streaming and not FLAGS.streaming_eval:
+        print(f"eval: {n_eval_max} rows > streaming_eval_threshold="
+              f"{FLAGS.streaming_eval_threshold}, using streaming path")
 
-        wanted = [r.strip() for r in FLAGS.eval_reps.split(",") if r.strip()]
-        reps = {"tfidf": (X_tfidf, X_tfidf_validate),
-                "binary_count": (X, X_validate),
-                "encoded": (X_encoded, X_encoded_validate)}
-        reps = {k: v for k, v in reps.items() if k in wanted}
-        label_kinds = (("label_category_publish_name", "(Category)"),
-                       ("label_story", "(Story)"))
-        names = {"tfidf": "TFIDF Vectorized",
-                 "binary_count": "Binary Count Vectorized", "encoded": "Encoded"}
-        aurocs = {}
-        for kind, (tr_rep, vl_rep) in reps.items():
-            for split, rep in (("train", tr_rep), ("validate", vl_rep)):
-                # both label kinds share one pair sweep (similarity blocks are
-                # label-independent)
-                lab_mat = np.stack([np.asarray(data_dict[lab][split])
-                                    for lab, _ in label_kinds])
-                _, h_rel, h_unrel, edges = streaming_auroc(
-                    rep, lab_mat, return_histograms=True)
-                for l, (lab, suffix) in enumerate(label_kinds):
-                    key = (f"similarity_boxplot_{kind}"
-                           f"{'_validate' if split == 'validate' else ''}{suffix}")
-                    aurocs[key] = visualize_similarity_from_histograms(
-                        h_rel[l], h_unrel[l], edges,
-                        title=(f"Cosine Similarity ({names[kind]}) "
-                               f"({split.title()} Data){suffix}"),
-                        save_path=model.plot_dir + key + ".png")
-        for k, v in sorted(aurocs.items()):
-            print(f"AUROC {k}: {v:.4f}")
+    from .eval_tail import nn_printout, similarity_eval
 
-        n_train = len(labels[("category_publish_name", "train")])
-        for row in nearest_neighbor_report_from_top1(
-                article_contents.iloc[:n_train],
-                streaming_top1(X_encoded, metric="cosine"),
-                streaming_top1(X, metric="cosine")):
-            print(row["article"])
-            print("most similar article using count vectorizer")
-            print(row["most_similar_by_count"])
-            print("most similar article using DAE")
-            print(row["most_similar_by_embedding"])
-            print(f"score: {row['score']}")
-            print()
-        print(__file__ + ": End")
-        return model, aurocs
-
-    print("calculate similarity")
     wanted = [r.strip() for r in FLAGS.eval_reps.split(",") if r.strip()]
-    sim_sources = {
-        "binary_count": (X, X_validate, "cosine"),
-        "tfidf": (X_tfidf, X_tfidf_validate, "linear kernel"),
-        "encoded": (X_encoded, X_encoded_validate, "cosine"),
+    reps = {"tfidf": (X_tfidf, X_tfidf_validate),
+            "binary_count": (X, X_validate),
+            "encoded": (X_encoded, X_encoded_validate)}
+    reps = {k: v for k, v in reps.items() if k in wanted}
+    label_dict = {
+        "label_category_publish_name": {
+            "train": labels[("category_publish_name", "train")],
+            "validate": labels[("category_publish_name", "validate")]},
+        "label_story": {"train": labels[("story", "train")],
+                        "validate": labels[("story", "validate")]},
     }
-    sims = {}
-    for kind, (tr_rep, vl_rep, metric) in sim_sources.items():
-        if kind not in wanted and kind != "binary_count":
-            continue  # binary_count always computed: the NN report needs it
-        sims[kind] = pairwise_similarity(tr_rep, metric=metric)
-        sims[kind + "_validate"] = pairwise_similarity(vl_rep, metric=metric)
-    print("calculate similarity done")
-
-    print("plot")
-    aurocs = {}
-    for lab in ("label_category_publish_name", "label_story"):
-        suffix = "(Category)" if lab == "label_category_publish_name" else "(Story)"
-        for kind, name in (("tfidf", "TFIDF Vectorized"),
-                           ("binary_count", "Binary Count Vectorized"),
-                           ("encoded", "Encoded")):
-            if kind not in wanted:
-                continue
-            for split in ("train", "validate"):
-                sim = sims[kind if split == "train" else kind + "_validate"]
-                key = f"similarity_boxplot_{kind}{'_validate' if split=='validate' else ''}{suffix}"
-                aurocs[key] = visualize_pairwise_similarity(
-                    np.asarray(data_dict[lab][split]), sim, plot="boxplot",
-                    title=f"Cosine Similarity ({name}) ({split.title()} Data){suffix}",
-                    save_path=model.plot_dir + key + ".png")
-    print("plot done")
+    sim_cache = {}
+    aurocs = similarity_eval(reps, label_dict, model.plot_dir, streaming,
+                             sim_cache=sim_cache)
     for k, v in sorted(aurocs.items()):
         print(f"AUROC {k}: {v:.4f}")
 
     n_train = len(labels[("category_publish_name", "train")])
-    if "encoded" not in sims:  # eval_reps excluded it; NN report compares vs it
-        sims["encoded"] = pairwise_similarity(X_encoded, metric="cosine")
-    for row in nearest_neighbor_report(article_contents.iloc[:n_train],
-                                       sims["encoded"], sims["binary_count"]):
-        print(row["article"])
-        print("most similar article using count vectorizer")
-        print(row["most_similar_by_count"])
-        print("most similar article using DAE")
-        print(row["most_similar_by_embedding"])
-        print(f"score: {row['score']}")
-        print()
+    nn_printout(article_contents.iloc[:n_train], X_encoded, X, streaming,
+                sim_cache=sim_cache)
 
     print(__file__ + ": End")
     return model, aurocs

@@ -12,7 +12,6 @@ import numpy as np
 import pandas as pd
 
 from ..data import articles, io as hio
-from ..eval import pairwise_similarity, visualize_pairwise_similarity
 from ..models import DenoisingAutoencoderTriplet
 from ..ops.corruption import decay_noise
 from ..utils.config import parse_flags
@@ -116,33 +115,49 @@ def main(argv=None):
     X_encoded = model.transform(
         decay_noise(trX, FLAGS.corr_frac),
         name="article_encoded", save=FLAGS.encode_full)
+    X_encoded_validate = None
+    if validation is not None:
+        X_encoded_validate = model.transform(
+            decay_noise(validation["org"], FLAGS.corr_frac),
+            name="article_encoded_validate", save=FLAGS.encode_full)
 
-    labels = valid["label_" + FLAGS.label][:train_row]
-    aurocs = {}
-    if FLAGS.streaming_eval or trX.shape[0] > FLAGS.streaming_eval_threshold:
-        from ..eval import streaming_auroc, visualize_similarity_from_histograms
+    # reference-parity eval tail (main_autoencoder_triplet.py:249-321): all
+    # three representations x both splits x both label kinds, shared with the
+    # online-mining driver
+    from .eval_tail import nn_printout, similarity_eval
 
-        for kind, rep in (("count", trX), ("encoded", X_encoded)):
-            _, h_rel, h_unrel, edges = streaming_auroc(
-                rep, np.asarray(labels), return_histograms=True)
-            aurocs[kind] = visualize_similarity_from_histograms(
-                h_rel, h_unrel, edges,
-                title=f"Cosine Similarity ({kind}) (Triplet)",
-                save_path=model.plot_dir + f"similarity_boxplot_{kind}_triplet.png")
-            print(f"AUROC {kind}: {aurocs[kind]:.4f}")
-        print(__file__ + ": End")
-        return model, aurocs
-
-    sims = {
-        "count": pairwise_similarity(trX, metric="cosine"),
-        "encoded": pairwise_similarity(X_encoded, metric="cosine"),
+    X_bin = binarize(X)
+    vo_tfidf = X_bin_validate = None
+    n_validate = 0
+    if validation is not None:
+        # validation['org'] already holds one of the two eval forms of vo_m —
+        # reuse it for that branch instead of re-transforming
+        if FLAGS.input_format == "binary":
+            X_bin_validate = validation["org"]
+            vo_tfidf = tfidf_transformer.transform(vo_m)
+        else:
+            vo_tfidf = validation["org"]
+            X_bin_validate = binarize(vo_m)
+        n_validate = vo_m.shape[0]
+    reps = {"tfidf": (X_tfidf, vo_tfidf),
+            "binary_count": (X_bin, X_bin_validate),
+            "encoded": (X_encoded, X_encoded_validate)}
+    has_vl = validation is not None
+    label_dict = {
+        lab: {"train": valid[lab][:train_row],
+              "validate": valid[lab][train_row:] if has_vl else None}
+        for lab in ("label_category_publish_name", "label_story")
     }
-    for kind, sim in sims.items():
-        aurocs[kind] = visualize_pairwise_similarity(
-            np.asarray(labels), sim, plot="boxplot",
-            title=f"Cosine Similarity ({kind}) (Triplet)",
-            save_path=model.plot_dir + f"similarity_boxplot_{kind}_triplet.png")
-        print(f"AUROC {kind}: {aurocs[kind]:.4f}")
+    streaming = (FLAGS.streaming_eval
+                 or max(trX.shape[0], n_validate) > FLAGS.streaming_eval_threshold)
+    sim_cache = {}
+    aurocs = similarity_eval(reps, label_dict, model.plot_dir, streaming,
+                             sim_cache=sim_cache)
+    for k, v in sorted(aurocs.items()):
+        print(f"AUROC {k}: {v:.4f}")
+
+    nn_printout(valid.iloc[:train_row], X_encoded, X_bin, streaming,
+                sim_cache=sim_cache)
 
     print(__file__ + ": End")
     return model, aurocs

@@ -127,10 +127,12 @@ def triplet_loss_and_metrics(params, batch, key, config):
         hs[name] = dae_core.encode(params, x_corr, config)
         ys[name] = dae_core.decode(params, hs[name], config)
 
-    ae_loss = sum(
-        losses.weighted_loss(batch[n], ys[n], config.loss_func, row_valid=row_valid)
+    tower_loss = {
+        n: losses.weighted_loss(batch[n], ys[n], config.loss_func,
+                                row_valid=row_valid)
         for n in ("org", "pos", "neg")
-    )
+    }
+    ae_loss = tower_loss["org"] + tower_loss["pos"] + tower_loss["neg"]
     t_loss = triplet.precomputed_triplet_loss(
         hs["org"], hs["pos"], hs["neg"], row_valid=row_valid
     )
@@ -139,6 +141,13 @@ def triplet_loss_and_metrics(params, batch, key, config):
         "cost": cost,
         "autoencoder_loss": ae_loss,
         "triplet_loss": t_loss,
+        # per-tower reconstruction trajectory (the reference only exposes the
+        # sum as a scalar + per-tower histograms, autoencoder_triplet.py:296-315;
+        # the anchor/pos/neg split makes margin-vs-reconstruction dynamics
+        # visible in the committed evidence)
+        "autoencoder_loss_anchor": tower_loss["org"],
+        "autoencoder_loss_pos": tower_loss["pos"],
+        "autoencoder_loss_neg": tower_loss["neg"],
     }
 
 

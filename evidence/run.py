@@ -43,8 +43,8 @@ MAIN_ARGS = [
     "--corr_type", "masking", "--corr_frac", "0.3", "--seed", str(SEED),
 ]
 TRIPLET_ARGS = [
-    "--model_name", "evidence_triplet", "--synthetic",
-    "--num_epochs", "15", "--train_row", "800", "--validate_row", "0",
+    "--model_name", "evidence_triplet", "--synthetic", "--validation",
+    "--num_epochs", "15", "--train_row", "800", "--validate_row", "200",
     "--max_features", "2000", "--batch_size", "0.1",
     "--opt", "ada_grad", "--learning_rate", "0.5",
     "--corr_type", "masking", "--corr_frac", "0.3", "--seed", str(SEED),
@@ -142,6 +142,19 @@ def _load_cache():
         print("stage cache is from a different configuration; ignoring it")
         return {}
     return cache
+
+
+def _read_trajectory(metrics_dir, tags):
+    """Per-TRAIN-STEP series {tag: [values]} from a MetricsWriter
+    metrics.jsonl (the estimator logs scalars once per batch,
+    models/estimator.py:442; records are ordered by step)."""
+    out = {t: [] for t in tags}
+    with open(os.path.join(metrics_dir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("tag") in out and "value" in rec:
+                out[rec["tag"]].append(round(float(rec["value"]), 6))
+    return out
 
 
 STAGE_PROVENANCE = {}  # name -> {platform, run_id}; collected per main() run
@@ -286,8 +299,21 @@ def main(argv=None):
         _check_figures("online-mining driver", main_out.get("figures", []))
         story_aurocs = staged("online-mining driver (story label)",
                               lambda: main_autoencoder(STORY_ARGS)[1])
-        tri_aurocs = staged("precomputed-triplet driver",
-                            lambda: main_triplet(TRIPLET_ARGS)[1])
+
+        def _triplet_stage():
+            # reference-parity record (main_autoencoder_triplet.py:249-321):
+            # the full 12-AUROC table plus the anchor/pos/neg reconstruction
+            # and margin loss trajectory from the train metrics stream
+            model, out = main_triplet(TRIPLET_ARGS)
+            traj = _read_trajectory(
+                os.path.join(model.tf_summary_dir, "train"),
+                ("cost", "autoencoder_loss", "triplet_loss",
+                 "autoencoder_loss_anchor", "autoencoder_loss_pos",
+                 "autoencoder_loss_neg"))
+            return {"aurocs": out, "loss_trajectory": traj}
+
+        tri = staged("precomputed-triplet driver", _triplet_stage)
+        tri_aurocs, tri_traj = tri["aurocs"], tri["loss_trajectory"]
 
         def _ss():
             # the cached online-mining stage may reference a scratch dir a
@@ -355,8 +381,26 @@ def main(argv=None):
           f"encoded {enc_tr:.4f} > tfidf {tfidf_tr:.4f} (Category, train)")
     check("encoded_beats_tfidf_validate", enc_vl > tfidf_vl,
           f"encoded {enc_vl:.4f} > tfidf {tfidf_vl:.4f} (Category, validate)")
-    check("triplet_encoded_above_chance", tri_aurocs["encoded"] > 0.5,
-          f"triplet encoded AUROC {tri_aurocs['encoded']:.4f} > 0.5")
+    tri_enc_vl = tri_aurocs["similarity_boxplot_encoded_validate(Category)"]
+    tri_bin_vl = tri_aurocs["similarity_boxplot_binary_count_validate(Category)"]
+    check("triplet_encoded_above_chance", tri_enc_vl > 0.55,
+          f"triplet encoded(Category) validate AUROC {tri_enc_vl:.4f} > 0.55")
+    check("triplet_encoded_beats_binary_validate", tri_enc_vl > tri_bin_vl,
+          f"triplet encoded {tri_enc_vl:.4f} > binary_count {tri_bin_vl:.4f} "
+          "(Category, validate — the precomputed-triplet pos/neg mapping is "
+          "built per category, reference similar_articles)")
+    tl = tri_traj.get("triplet_loss", [])
+    if len(tl) >= 2:
+        # per-step values are noisy; compare first- vs last-decile means
+        k = max(1, len(tl) // 10)
+        tl_head = sum(tl[:k]) / k
+        tl_tail = sum(tl[-k:]) / k
+        check("triplet_margin_loss_decreases", tl_tail < tl_head,
+              f"margin loss first-decile mean {tl_head:.4f} -> last-decile "
+              f"mean {tl_tail:.4f} over {len(tl)} train steps")
+    else:
+        check("triplet_margin_loss_decreases", False,
+              f"trajectory too short: {tl}")
     # the reference driver's OTHER label (main_autoencoder.py:180-198): mining
     # on story must lift the story-label AUROC the category-mined run trades
     # away (VERDICT r2 weak-4: story quality was unchecked)
@@ -430,6 +474,7 @@ def main(argv=None):
         "aurocs_refscale": {k: float(v) for k, v in sorted(ref_aurocs.items())},
         "refscale_wall_seconds": round(t_ref, 1),
         "aurocs_triplet": {k: float(v) for k, v in sorted(tri_aurocs.items())},
+        "triplet_loss_trajectory": tri_traj,
         "aurocs_moe": {k: float(v) for k, v in sorted(moe_aurocs.items())},
         "aurocs_starspace": {k: float(v) for k, v in sorted(ss_aurocs.items())},
         "starspace": {"best_loss": ss_loss, "best_epoch": ss_epoch},
@@ -586,15 +631,45 @@ def _write_md(p):
         cat = m[f"similarity_boxplot_encoded{sfx}(Category)"]
         sto = m[f"similarity_boxplot_encoded{sfx}(Story)"]
         lines.append(f"| encoded (4-expert MoE) | {split} | {cat:.4f} | {sto:.4f} |")
+    t = p["aurocs_triplet"]
     lines += [
         "",
         "## Precomputed-triplet driver",
         "",
-        "| representation | AUROC |",
-        "|---|---|",
+        "Per-category pos/neg article mapping (reference similar_articles) "
+        "-> three aligned matrices -> triplet DAE; the eval tail matches the "
+        "reference driver's full coverage "
+        "(main_autoencoder_triplet.py:249-321):",
+        "",
     ]
-    for k, v in p["aurocs_triplet"].items():
-        lines.append(f"| {k} | {v:.4f} |")
+    if "similarity_boxplot_tfidf(Category)" in t:
+        lines += ["| representation | split | Category | Story |",
+                  "|---|---|---|---|"]
+        for rep in ("tfidf", "binary_count", "encoded"):
+            for split, sfx in (("train", ""), ("validate", "_validate")):
+                cat = t[f"similarity_boxplot_{rep}{sfx}(Category)"]
+                sto = t[f"similarity_boxplot_{rep}{sfx}(Story)"]
+                lines.append(f"| {rep} | {split} | {cat:.4f} | {sto:.4f} |")
+    else:
+        # pre-round-4 record shape (train-only, mined label only): reachable
+        # only when rendering an older committed results.json (the provenance
+        # test uses the committed record as its template); a live run always
+        # produces the 12-key shape
+        lines += ["| representation | AUROC |", "|---|---|"]
+        lines += [f"| {k} | {v:.4f} |" for k, v in t.items()]
+    tj = p.get("triplet_loss_trajectory", {})
+    if tj.get("triplet_loss"):
+        first, last = tj["triplet_loss"][0], tj["triplet_loss"][-1]
+        lines += [
+            "",
+            f"Loss trajectory over {len(tj['triplet_loss'])} train steps "
+            f"(one record per batch; full per-step series in results.json): "
+            f"margin {first:.4f} -> {last:.4f}; anchor/pos/neg "
+            "reconstruction " + " / ".join(
+                f"{tj[k][0]:.2f}->{tj[k][-1]:.2f}"
+                for k in ("autoencoder_loss_anchor", "autoencoder_loss_pos",
+                          "autoencoder_loss_neg") if tj.get(k)) + ".",
+        ]
     lines += [
         "",
         "## Native StarSpace baseline",
