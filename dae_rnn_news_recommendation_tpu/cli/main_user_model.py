@@ -181,11 +181,17 @@ def main(argv=None):
     rank_ci95 = (float(1.96 * per_user.std(ddof=1) / np.sqrt(len(per_user)))
                  if len(per_user) > 1 else 0.0)
 
-    # one candidate article per category; does the user's state rank their
-    # interest category first?
+    # does the user's state rank their interest category first? Each category
+    # is represented by the mean score over up to 5 sampled candidate articles
+    # — a single candidate made the metric hostage to one draw's embedding
+    # (measured swing ~±0.1 at 500 users)
     cats = np.unique(categories)
-    cand_idx = np.array([rng.choice(np.where(categories == c)[0]) for c in cats])
-    scores = np.asarray(finals) @ emb[cand_idx].T          # [U_te, C]
+    cand_scores = []
+    for c in cats:
+        pool = np.where(categories == c)[0]
+        cand = rng.choice(pool, size=min(5, len(pool)), replace=False)
+        cand_scores.append((np.asarray(finals) @ emb[cand].T).mean(axis=1))
+    scores = np.stack(cand_scores, axis=1)                 # [U_te, C]
     top1 = cats[scores.argmax(axis=1)]
     cat_acc = float((top1 == sessions["interest"][te]).mean())
 

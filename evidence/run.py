@@ -42,12 +42,17 @@ MAIN_ARGS = [
     "--triplet_strategy", "batch_all", "--alpha", "1.0",
     "--corr_type", "masking", "--corr_frac", "0.3", "--seed", str(SEED),
 ]
+# alpha 10 / 40 epochs / corr_frac 0.1 is the round-4 sweep frontier
+# (evidence/triplet_sweep.json): the three-tower objective reconstructs
+# org/pos/neg jointly, so the heavy masking (0.3) the online-mining driver
+# prefers drowns the margin gradient here — at 0.1 the same model goes from
+# losing to binary counts to beating tfidf
 TRIPLET_ARGS = [
     "--model_name", "evidence_triplet", "--synthetic", "--validation",
-    "--num_epochs", "15", "--train_row", "800", "--validate_row", "200",
+    "--num_epochs", "40", "--train_row", "800", "--validate_row", "200",
     "--max_features", "2000", "--batch_size", "0.1",
-    "--opt", "ada_grad", "--learning_rate", "0.5",
-    "--corr_type", "masking", "--corr_frac", "0.3", "--seed", str(SEED),
+    "--opt", "ada_grad", "--learning_rate", "0.5", "--alpha", "10.0",
+    "--corr_type", "masking", "--corr_frac", "0.1", "--seed", str(SEED),
 ]
 # trains on the EXACT split the online-mining stage saved (--from_artifacts is
 # appended at run time with that stage's data dir), the way the reference
@@ -98,7 +103,7 @@ REFSCALE_ARGS = [
 # BASELINE config 5: stacked 2-layer DAE pretrain -> GRU user-state RNN over
 # per-user article-embedding sequences (the paper pipeline the reference never
 # implemented) — held-out pairwise rank accuracy vs the 0.5 chance level and
-# interest-category top-1 vs ~1/7 chance
+# interest-category top-1 vs ~1/8 chance
 USER_ARGS = [
     "--model_name", "evidence_user", "--seed", str(SEED),
     "--n_articles", "1200", "--max_features", "1500",
@@ -388,8 +393,10 @@ def main(argv=None):
           f"encoded {enc_vl:.4f} > tfidf {tfidf_vl:.4f} (Category, validate)")
     tri_enc_vl = tri_aurocs["similarity_boxplot_encoded_validate(Category)"]
     tri_bin_vl = tri_aurocs["similarity_boxplot_binary_count_validate(Category)"]
-    check("triplet_encoded_above_chance", tri_enc_vl > 0.55,
-          f"triplet encoded(Category) validate AUROC {tri_enc_vl:.4f} > 0.55")
+    check("triplet_encoded_meets_sweep_frontier", tri_enc_vl > 0.70,
+          f"triplet encoded(Category) validate AUROC {tri_enc_vl:.4f} > 0.70 "
+          "(calibrated to the round-4 sweep frontier 0.7462, "
+          "evidence/triplet_sweep.json)")
     check("triplet_encoded_beats_binary_validate", tri_enc_vl > tri_bin_vl,
           f"triplet encoded {tri_enc_vl:.4f} > binary_count {tri_bin_vl:.4f} "
           "(Category, validate — the precomputed-triplet pos/neg mapping is "
@@ -456,9 +463,11 @@ def main(argv=None):
           f"held-out pairwise rank accuracy {user['rank_accuracy']:.4f} "
           f"± {u_ci:.4f} (95% CI over {user['n_users_eval']} users) "
           "lower bound > 0.6 (chance 0.5)")
-    check("user_category_top1", user["category_top1_accuracy"] > 0.3,
-          f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.3 "
-          "(chance ~1/7)")
+    check("user_category_top1", user["category_top1_accuracy"] > 0.6,
+          f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.6 "
+          "(chance ~1/8; scored against 5-candidate category means — one "
+          "random candidate made the metric hostage to a single draw; "
+          "measured 0.884 at the round-4 calibration)")
 
     payload = {
         "generated": datetime.datetime.now(datetime.timezone.utc).isoformat(),
@@ -711,7 +720,7 @@ def _write_md(p):
         f"{u.get('rank_accuracy_ci95', 0.0):.4f}** (95% CI over held-out "
         "users; chance 0.5)",
         f"- interest-category top-1 **{u['category_top1_accuracy']:.4f}** "
-        "(chance ~1/7)",
+        "(chance ~1/8)",
         f"- {u['n_users_eval']} held-out users, seq_len {u['seq_len']}, "
         f"{u['d_embed']}-dim embeddings",
     ]
