@@ -1,0 +1,75 @@
+"""Unit tests for the shared driver eval tail (cli/eval_tail.py): streaming vs
+full-matrix agreement on the same inputs, and the sim_cache contract — the
+train-split similarity matrices built during similarity_eval are REUSED by
+nn_printout, never recomputed (they are the non-streaming eval's memory
+high-water mark; test_cli.py covers the tail end-to-end through both CLIs)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dae_rnn_news_recommendation_tpu.cli.eval_tail import (
+    nn_printout, similarity_eval)
+
+
+@pytest.fixture
+def tiny(rng):
+    n_tr, n_vl, f = 24, 10, 8
+    reps = {
+        "binary_count": ((rng.uniform(size=(n_tr, f)) < 0.4).astype(np.float32),
+                         (rng.uniform(size=(n_vl, f)) < 0.4).astype(np.float32)),
+        "encoded": (rng.normal(size=(n_tr, 4)).astype(np.float32),
+                    rng.normal(size=(n_vl, 4)).astype(np.float32)),
+    }
+    labels = {
+        "label_category_publish_name": {
+            "train": rng.integers(0, 3, n_tr),
+            "validate": rng.integers(0, 3, n_vl)},
+        "label_story": {"train": rng.integers(-1, 2, n_tr),
+                        "validate": rng.integers(-1, 2, n_vl)},
+    }
+    return reps, labels
+
+
+def test_streaming_matches_full_matrix(tiny, tmp_path):
+    reps, labels = tiny
+    full = similarity_eval(reps, labels, str(tmp_path) + "/", streaming=False)
+    stream = similarity_eval(reps, labels, str(tmp_path) + "/", streaming=True)
+    assert set(full) == set(stream)
+    for k in full:
+        if np.isfinite(full[k]) or np.isfinite(stream[k]):
+            np.testing.assert_allclose(full[k], stream[k], atol=2e-2,
+                                       err_msg=k)
+
+
+def test_missing_validate_split_skipped(tiny, tmp_path):
+    reps, labels = tiny
+    reps = {k: (tr, None) for k, (tr, vl) in reps.items()}
+    aurocs = similarity_eval(reps, labels, str(tmp_path) + "/",
+                             streaming=False)
+    assert aurocs and not any("_validate" in k for k in aurocs)
+
+
+def test_nn_printout_reuses_cached_sims(tiny, tmp_path, capsys, monkeypatch):
+    """similarity_eval stashes the train-split sims; nn_printout must consume
+    them instead of rebuilding the [N, N] matrices."""
+    reps, labels = tiny
+    cache = {}
+    similarity_eval(reps, labels, str(tmp_path) + "/", streaming=False,
+                    sim_cache=cache)
+    assert set(cache) == {"binary_count", "encoded"}
+
+    from dae_rnn_news_recommendation_tpu import eval as eval_pkg
+
+    def boom(*a, **k):
+        raise AssertionError("nn_printout recomputed a cached similarity")
+
+    monkeypatch.setattr(eval_pkg, "pairwise_similarity", boom)
+    n_tr = reps["encoded"][0].shape[0]
+    rows = pd.DataFrame({
+        "title": [f"t{i}" for i in range(n_tr)],
+        "category_publish_name": ["c"] * n_tr,
+    })
+    nn_printout(rows, reps["encoded"][0], reps["binary_count"][0],
+                streaming=False, sim_cache=cache)
+    assert "most similar article" in capsys.readouterr().out
