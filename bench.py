@@ -705,7 +705,22 @@ def main():
     probe twice so one transient probe flake can't forfeit the TPU headline
     while retries remain). A probed-alive tunnel can still die mid-run, so the
     child runs under the no-progress watchdog (_run_child). Only the forced
-    final attempt runs the CPU fallback, guaranteeing a non-empty record."""
+    final attempt runs the CPU fallback, guaranteeing a non-empty record.
+
+    Exit-code contract (ADVICE r3/r4):
+      0 — some live attempt succeeded this run. The emitted headline is
+          usually that live measurement (live: true), but when only the CPU
+          fallback succeeded and a captured TPU sidecar exists, _emit
+          substitutes the sidecar (live: false, CPU figure demoted to
+          extra.live_fallback) — so rc alone does not imply live: true;
+          read the record's `live` field.
+      1 — no valid record at all: every live attempt failed AND no sidecar
+          substitute existed (the emitted record has value 0).
+      2 — valid record, dead bench: every live attempt (incl. the CPU
+          fallback) failed, but _emit substituted the captured TPU sidecar
+          (value > 0, live: false). Automation must treat rc 2 as "record is
+          usable, investigate the live path" — NOT as "discard the record".
+          The driver only parses the JSON line; nothing in-repo keys on rc."""
     for attempt in range(ATTEMPTS):
         env = dict(os.environ)
         timeout_s = CHILD_TIMEOUT

@@ -67,7 +67,8 @@ def similarity_eval(reps, labels, plot_dir, streaming, sim_cache=None):
             if rep is None:
                 continue
             sim = pairwise_similarity(rep, metric=metric)
-            if split == "train" and sim_cache is not None:
+            if (split == "train" and sim_cache is not None
+                    and kind in ("encoded", "binary_count")):
                 sim_cache[kind] = sim
             for lab, suffix in LABEL_KINDS:
                 lab_vals = labels.get(lab, {}).get(split)

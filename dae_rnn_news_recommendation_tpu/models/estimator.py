@@ -97,6 +97,8 @@ class DenoisingAutoencoder:
         self.verbose = verbose
         self.verbose_step = verbose_step
         self.seed = seed
+        # set by _root_key() during _build; None until the first fit resolves it
+        self._resolved_seed = None
         self.alpha = alpha
         self.triplet_strategy = triplet_strategy
 
@@ -174,8 +176,9 @@ class DenoisingAutoencoder:
             # contract requires identical host values on every process — so
             # every process must adopt process 0's resolved seed before any
             # param init or per-step PRNG key derives from it. (Explicit
-            # seeds are already identical everywhere; broadcasting them would
-            # be a needless collective and uint32 would truncate seeds>=2**32.)
+            # seeds are already identical everywhere, so only the unseeded
+            # path broadcasts; resolve_seed caps unseeded draws below 2**31,
+            # so the uint32 wire format is lossless here.)
             from jax.experimental import multihost_utils
 
             seed = int(multihost_utils.broadcast_one_to_all(np.uint32(seed)))

@@ -159,11 +159,22 @@ def _read_trajectory(metrics_dir, tags):
     metrics.jsonl (the estimator logs scalars once per batch,
     models/estimator.py:442; records are ordered by step)."""
     out = {t: [] for t in tags}
+    last_step = None
     with open(os.path.join(metrics_dir, "metrics.jsonl")) as f:
         for line in f:
             rec = json.loads(line)
-            if rec.get("tag") in out and "value" in rec:
-                out[rec["tag"]].append(round(float(rec["value"]), 6))
+            if rec.get("tag") not in out or "value" not in rec:
+                continue
+            step = rec.get("step")
+            if step is not None and last_step is not None and step < last_step:
+                # MetricsWriter appends: a step reset means an earlier fit's
+                # records precede this one (e.g. a reused results dir). Keep
+                # only the final monotonic run so first-vs-last-decile checks
+                # never compare across runs.
+                out = {t: [] for t in tags}
+            if step is not None:
+                last_step = step
+            out[rec["tag"]].append(round(float(rec["value"]), 6))
     return out
 
 
