@@ -162,6 +162,7 @@ def main(argv=None):
         verbose=FLAGS.verbose, verbose_step=FLAGS.verbose_step,
         num_epochs=FLAGS.num_epochs, batch_size=FLAGS.batch_size,
         alpha=FLAGS.alpha, triplet_strategy=FLAGS.triplet_strategy,
+        label2_alpha=(FLAGS.label2_alpha if FLAGS.label2 != "none" else 0.0),
         n_devices=FLAGS.n_devices, mining_scope=FLAGS.mining_scope,
         compute_dtype=FLAGS.compute_dtype, checkpoint_every=FLAGS.checkpoint_every,
         profile=FLAGS.profile, sparse_feed=bool(FLAGS.sparse_feed),
@@ -182,19 +183,25 @@ def main(argv=None):
 
     trX = data_dict[FLAGS.input_format]["train"]
     trX_label = data_dict["label_" + FLAGS.label]["train"]
+    trX_label2 = vlX_label2 = None
+    if FLAGS.label2 != "none":
+        trX_label2 = data_dict["label_" + FLAGS.label2]["train"]
     vlX = vlX_label = None
     if FLAGS.validation:
         vlX = data_dict[FLAGS.input_format]["validate"]
         # fixed: the reference fed TRAIN labels here (SURVEY §2.3.2)
         vlX_label = data_dict["label_" + FLAGS.label]["validate"]
+        if FLAGS.label2 != "none":
+            vlX_label2 = data_dict["label_" + FLAGS.label2]["validate"]
 
     print("fit")
     model.fit(train_set=trX, validation_set=vlX, train_set_label=trX_label,
               validation_set_label=vlX_label,
-              restore_previous_model=FLAGS.restore_previous_model)
+              restore_previous_model=FLAGS.restore_previous_model,
+              train_set_label2=trX_label2, validation_set_label2=vlX_label2)
     with open(model.parameter_file, "a+") as f:
         for k in ("train_row", "validate_row", "input_format", "label",
-                  "restore_previous_data", "restore_previous_model"):
+                  "label2", "restore_previous_data", "restore_previous_model"):
             print(f"{k}={getattr(FLAGS, k)}", file=f)
     print("fit done")
 

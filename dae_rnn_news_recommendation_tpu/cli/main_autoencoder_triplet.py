@@ -47,8 +47,10 @@ def main(argv=None):
     train_row, validate_row = FLAGS.train_row, FLAGS.validate_row
 
     if FLAGS.synthetic:
+        n = int((train_row + validate_row)
+                * max(getattr(FLAGS, "synthetic_oversample", 1.0), 1.0))
         article_contents = articles.synthetic_articles(
-            n_articles=max(train_row + validate_row, 100),
+            n_articles=max(n, 100),
             vocab_size=FLAGS.synthetic_vocab, seed=max(FLAGS.seed, 0))
     else:
         article_contents = articles.read_articles(path=FLAGS.data_path)
@@ -58,13 +60,27 @@ def main(argv=None):
     article_contents["label_category_publish_name"] = pd.factorize(
         article_contents.category_publish_name.map(lambda s: s.lstrip("即時")))[0]
 
-    # per-category positive/negative mapping (reference similar_articles)
+    # positive/negative mapping. The reference keys it on category only
+    # (similar_articles, datasets/articles.py:83-128), which by construction
+    # carries no Story signal: positives are merely same-CATEGORY neighbors,
+    # so same-story pairs are pushed no closer than any category pair.
+    # --label story (net-new) keys the same recipe on the story column —
+    # positive = next article in the same story, negative = random article
+    # from a different (or no) story — so the triplet path can carry Story.
+    map_key = "story" if FLAGS.label == "story" else "category_publish_name"
     article_contents = articles.similar_articles(
         article_contents, id_colname="article_id",
-        cate_colname="category_publish_name", min_cate=2,
+        cate_colname=map_key, min_cate=2,
         seed=max(FLAGS.seed, 0))
     valid = article_contents[article_contents.valid_triplet_data == 1]
     valid = valid.iloc[: train_row + validate_row]
+    if FLAGS.validation and len(valid) <= train_row:
+        raise ValueError(
+            f"only {len(valid)} valid-triplet rows remain (mapping keyed on "
+            f"{map_key!r}) but --train_row {train_row} + --validation needs "
+            "more; lower the split sizes or raise --synthetic_oversample "
+            "(~35% of synthetic rows carry a story, and min_cate=2 filters "
+            "singleton groups)")
     train_row = min(train_row, len(valid))
 
     content = article_contents.main_content

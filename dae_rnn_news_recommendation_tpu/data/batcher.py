@@ -103,7 +103,7 @@ class PaddedBatcher:
             x[n_real:] = 0.0
         return {"x": x}
 
-    def epoch(self, data, labels=None):
+    def epoch(self, data, labels=None, labels2=None):
         ctx = self._prepare(data)
         n = (data["org"] if isinstance(data, dict) else data).shape[0]
         for idx, n_real, valid in self._index_batches(n):
@@ -112,6 +112,10 @@ class PaddedBatcher:
             if lab is not None:
                 lab[n_real:] = -1  # padded rows never share a label
                 batch["labels"] = lab
+            lab2 = _labels_at(labels2, idx)
+            if lab2 is not None:
+                lab2[n_real:] = -1
+                batch["labels2"] = lab2
             yield batch
 
 

@@ -49,6 +49,11 @@ class DAEConfig:
     corr_frac: float = 0.0
     triplet_strategy: str = "batch_all"  # batch_all | batch_hard | none
     alpha: float = 1.0
+    # weight of a SECOND batch_all mining term over batch["labels2"] (joint
+    # two-label mining, e.g. story+category; 0.0 = reference single-label
+    # behavior). No reference counterpart — the reference mines one label
+    # (triplet_loss_utils.py:79-131 takes a single label vector).
+    label2_alpha: float = 0.0
     xavier_const: float = 1.0
     compute_dtype: str = "float32"  # "bfloat16" runs the wide matmuls on the MXU in bf16
     matmul_precision: str = "default"  # "default" | "high" | "highest" for encode/decode

@@ -58,6 +58,7 @@ class DenoisingAutoencoder:
                  xavier_init=1, opt="gradient_descent", learning_rate=0.01,
                  momentum=0.5, corr_type="none", corr_frac=0.0, verbose=True,
                  verbose_step=5, seed=-1, alpha=1, triplet_strategy="batch_all",
+                 label2_alpha=0.0,
                  # --- TPU-native extras (no reference counterpart) ---
                  compute_dtype="float32", checkpoint_every=0, val_batch_size=512,
                  n_devices=1, mesh=None, mining_scope="global", results_root="results",
@@ -101,6 +102,9 @@ class DenoisingAutoencoder:
         self._resolved_seed = None
         self.alpha = alpha
         self.triplet_strategy = triplet_strategy
+        # joint two-label mining weight: cost += alpha * label2_alpha *
+        # batch_all(labels2) when fit() receives train_set_label2 (net-new)
+        self.label2_alpha = label2_alpha
 
         self.compute_dtype = compute_dtype
         self.checkpoint_every = checkpoint_every
@@ -160,6 +164,7 @@ class DenoisingAutoencoder:
             "corr_frac": self.corr_frac, "verbose": self.verbose,
             "verbose_step": self.verbose_step, "seed": self.seed,
             "alpha": self.alpha, "triplet_strategy": self.triplet_strategy,
+            "label2_alpha": self.label2_alpha,
             "n_components": self.n_components_override,
             "compute_dtype": self.compute_dtype, "n_devices": self.n_devices,
             "mining_scope": self.mining_scope,
@@ -197,7 +202,8 @@ class DenoisingAutoencoder:
             enc_act_func=self.enc_act_func, dec_act_func=self.dec_act_func,
             loss_func=self.loss_func, corr_type=self.corr_type,
             corr_frac=self.corr_frac, triplet_strategy=self.triplet_strategy,
-            alpha=self.alpha, xavier_const=self.xavier_init,
+            alpha=self.alpha, label2_alpha=self.label2_alpha,
+            xavier_const=self.xavier_init,
             compute_dtype=self.compute_dtype,
         )
 
@@ -281,8 +287,14 @@ class DenoisingAutoencoder:
     # ------------------------------------------------------------------ public API
 
     def fit(self, train_set, validation_set=None, train_set_label=None,
-            validation_set_label=None, restore_previous_model=False):
-        """Fit the model (reference autoencoder.py:126-156)."""
+            validation_set_label=None, restore_previous_model=False,
+            train_set_label2=None, validation_set_label2=None):
+        """Fit the model (reference autoencoder.py:126-156).
+
+        `train_set_label2`/`validation_set_label2` (no reference counterpart)
+        feed the joint two-label mining term enabled by label2_alpha > 0: a
+        second batch_all margin over the secondary label, weighted
+        alpha * label2_alpha in the cost."""
         if self.triplet_strategy != "none":
             assert train_set_label is not None
             # fail fast: mining needs labels for the validation feed too
@@ -293,6 +305,16 @@ class DenoisingAutoencoder:
             assert train_set.shape[0] == len(train_set_label)
         if validation_set is not None and validation_set_label is not None:
             assert validation_set.shape[0] == len(validation_set_label)
+        if self.label2_alpha > 0.0:
+            assert train_set_label2 is not None, (
+                "label2_alpha > 0 needs train_set_label2")
+            assert train_set.shape[0] == len(train_set_label2)
+            assert validation_set is None or validation_set_label2 is not None
+            if validation_set is not None:
+                assert validation_set.shape[0] == len(validation_set_label2)
+        self._train_label2 = train_set_label2 if self.label2_alpha > 0 else None
+        self._val_label2 = (validation_set_label2 if self.label2_alpha > 0
+                            else None)
 
         n_features = train_set.shape[1]
         # informational only (reference-parity attribute, autoencoder.py:143):
@@ -399,6 +421,7 @@ class DenoisingAutoencoder:
                           validation_set_label, batcher, extremes, train_writer,
                           val_writer):
         labels = train_set_label if self._needs_labels else None
+        labels2 = getattr(self, "_train_label2", None) if self._needs_labels else None
         from ..data.batcher import resolve_batch_size
         n_rows = train_set["org"].shape[0] if isinstance(train_set, dict) else train_set.shape[0]
         b = resolve_batch_size(self.batch_size, n_rows)
@@ -418,7 +441,7 @@ class DenoisingAutoencoder:
             # host-device sync each batch and stall the async dispatch pipeline
             step_in_epoch = 0
             device_metrics = []
-            for batch in prefetch(batcher.epoch(train_set, labels),
+            for batch in prefetch(batcher.epoch(train_set, labels, labels2),
                                   self.prefetch_depth):
                 batch.update(extremes)
                 batch = self._place_batch(batch)
@@ -497,7 +520,8 @@ class DenoisingAutoencoder:
         batcher = self._feed_batcher(validation_set)(
             b, shuffle=False, mesh_batch_multiple=self._batch_multiple)
         labels = validation_set_label if self._needs_labels else None
-        return batcher.epoch(validation_set, labels)
+        labels2 = getattr(self, "_val_label2", None) if self._needs_labels else None
+        return batcher.epoch(validation_set, labels, labels2)
 
     def _run_validation(self, epoch, validation_set, validation_set_label, val_writer):
         """Print train averages + chunked validation metrics (reference

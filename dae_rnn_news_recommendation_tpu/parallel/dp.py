@@ -31,7 +31,7 @@ _ROW_MATRICES = ("x", "x_corr", "org", "pos", "neg", "org_corr", "pos_corr",
 # feature axis — they shard over data only, never over a model axis
 _ROW_NNZ = ("indices", "values", "org_indices", "org_values",
             "pos_indices", "pos_values", "neg_indices", "neg_values")
-_ROW_VECTORS = ("labels", "row_valid")
+_ROW_VECTORS = ("labels", "labels2", "row_valid")
 
 
 def param_shardings(mesh, model_axis=None):

@@ -89,6 +89,22 @@ def loss_and_metrics(params, batch, key, config):
             t_loss, data_weight, fraction, num, extras = triplet.batch_hard_triplet_loss(
                 batch["labels"], h, row_valid=row_valid
             )
+        if config.label2_alpha > 0.0 and "labels2" in batch:
+            # joint two-label mining: a second batch_all term over labels2
+            # (always batch_all — batch_hard's max/min would let one label's
+            # hardest pair dominate both objectives). Rows active in either
+            # term keep their reconstruction weight. labels2 < 0 means "no
+            # secondary label" (pd.factorize maps missing stories to -1);
+            # those rows sit out this term — without the mask every
+            # storyless row would mine as one giant -1 'story'.
+            lab2 = batch["labels2"]
+            has2 = (lab2 >= 0).astype(h.dtype)
+            rv2 = has2 if row_valid is None else row_valid * has2
+            t2_loss, data_weight2, _, _, _ = triplet.batch_all_triplet_loss(
+                lab2, h, row_valid=rv2
+            )
+            t_loss = t_loss + config.label2_alpha * t2_loss
+            data_weight = jnp.maximum(data_weight, data_weight2)
         ae_loss = losses.weighted_loss(
             x, y, config.loss_func, weight=data_weight, row_valid=row_valid
         )

@@ -81,6 +81,16 @@ def build_parser(triplet_mode=False):
     if not triplet_mode:
         p.add_argument("--triplet_strategy", default="batch_all",
                        choices=["batch_all", "batch_hard", "none"])
+        p.add_argument("--label2", default="none",
+                       choices=["none", "category_publish_name", "story"],
+                       help="mine a SECOND batch_all margin term on this "
+                            "label jointly with --label (net-new; the "
+                            "reference mines one label). Rows missing the "
+                            "secondary label sit out that term")
+        p.add_argument("--label2_alpha", type=float, default=1.0,
+                       help="weight of the secondary mining term relative to "
+                            "the primary: cost += alpha * label2_alpha * "
+                            "triplet_loss(label2)")
     # --- TPU-native extras ---
     p.add_argument("--data_path", default="datasets/uci_news.snappy.parquet",
                    help="article parquet; --synthetic generates data instead")
@@ -177,6 +187,16 @@ def validate(args, triplet_mode=False):
         assert args.loss_func in ("mean_squared", "cosine_proximity"), (
             "tfidf input is not Bernoulli — cross_entropy is invalid "
             "(reference main_autoencoder.py:108-109)")
+    if getattr(args, "label2", "none") != "none":
+        assert args.label2 != args.label, (
+            "--label2 must differ from --label (same label twice is just a "
+            "larger --alpha)")
+        assert args.triplet_strategy != "none", (
+            "--label2 adds a second MINING term; it needs --triplet_strategy")
+        assert getattr(args, "n_experts", 1) == 1, (
+            "--label2 is not implemented for the MoE estimator "
+            "(moe_loss_and_metrics mines the primary label only); drop "
+            "--n_experts or --label2")
     if getattr(args, "n_experts", 1) > 1:
         assert not triplet_mode, (
             "--n_experts selects the MoE estimator, which has no precomputed-"

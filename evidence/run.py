@@ -100,6 +100,28 @@ REFSCALE_ARGS = [
     "--corr_type", "masking", "--corr_frac", "0.3",
     "--compute_dtype", "bfloat16", "--streaming_eval", "--seed", str(SEED),
 ]
+# the headline workload shape mined on STORY (VERDICT r4 item 3: the
+# story-mining knob that rescued Story at small scale had never been run at
+# reference scale). Same shape/schedule as REFSCALE_ARGS; alpha 30 is the
+# story-sweep frontier; 3x oversampling fills the story-valid splits (~35%
+# of synthetic rows carry a story)
+REFSTORY_ARGS = [a for a in REFSCALE_ARGS]
+REFSTORY_ARGS[REFSTORY_ARGS.index("evidence_refscale")] = "evidence_refstory"
+REFSTORY_ARGS[REFSTORY_ARGS.index("--alpha") + 1] = "30.0"
+REFSTORY_ARGS += ["--label", "story", "--synthetic_oversample", "3.0"]
+# the triplet recipe keyed on STORY instead of category (net-new --label
+# story on the triplet driver): the reference's per-category pos/neg mapping
+# carries no Story signal by construction (positives are same-CATEGORY
+# neighbors, datasets/articles.py:83-128), which is why the category-keyed
+# triplet run's Story cell sits at chance; this stage proves the same triplet
+# machinery carries Story when the mapping is keyed on it. alpha 30 /
+# corr 0.3 is the round-5 grid frontier (evidence/triplet_story_keyed.json)
+TRIPLET_STORY_ARGS = [a for a in TRIPLET_ARGS]
+TRIPLET_STORY_ARGS[TRIPLET_STORY_ARGS.index("evidence_triplet")] = (
+    "evidence_triplet_story")
+TRIPLET_STORY_ARGS[TRIPLET_STORY_ARGS.index("--alpha") + 1] = "30.0"
+TRIPLET_STORY_ARGS[TRIPLET_STORY_ARGS.index("--corr_frac") + 1] = "0.3"
+TRIPLET_STORY_ARGS += ["--label", "story", "--synthetic_oversample", "4.0"]
 # BASELINE config 5: stacked 2-layer DAE pretrain -> GRU user-state RNN over
 # per-user article-embedding sequences (the paper pipeline the reference never
 # implemented) — held-out pairwise rank accuracy vs the 0.5 chance level and
@@ -139,7 +161,7 @@ def _fingerprint():
         head, code = "nogit", "nogit"
     return json.dumps([head, code, SEED, MAIN_ARGS, TRIPLET_ARGS,
                        STARSPACE_ARGS, STORY_ARGS, MOE_ARGS, REFSCALE_ARGS,
-                       USER_ARGS])
+                       USER_ARGS, TRIPLET_STORY_ARGS, REFSTORY_ARGS])
 
 
 def _load_cache():
@@ -335,6 +357,9 @@ def main(argv=None):
 
         tri = staged("precomputed-triplet driver", _triplet_stage)
         tri_aurocs, tri_traj = tri["aurocs"], tri["loss_trajectory"]
+        tri_story_aurocs = staged(
+            "precomputed-triplet driver (story-keyed mapping)",
+            lambda: main_triplet(TRIPLET_STORY_ARGS)[1])
 
         def _ss():
             # the cached online-mining stage may reference a scratch dir a
@@ -369,6 +394,15 @@ def main(argv=None):
                      "streaming eval)", _ref)
         ref_aurocs, t_ref = ref["aurocs"], ref["wall"]
         _check_figures("reference-scale run", ref.get("figures", []))
+
+        def _refstory():
+            t_rs = time.time()
+            _, out = main_autoencoder(REFSTORY_ARGS)
+            return {"aurocs": out, "wall": time.time() - t_rs}
+
+        refstory = staged("reference-scale run, story-mined "
+                          "(8000 x 10000 -> 500, bf16)", _refstory)
+        refstory_aurocs = refstory["aurocs"]
 
         user = staged("user model (stacked DAE -> GRU, config 5)",
                       lambda: main_user_model(USER_ARGS)[1])
@@ -412,6 +446,26 @@ def main(argv=None):
           f"triplet encoded {tri_enc_vl:.4f} > binary_count {tri_bin_vl:.4f} "
           "(Category, validate — the precomputed-triplet pos/neg mapping is "
           "built per category, reference similar_articles)")
+    # VERDICT r4 item 4: the category-keyed triplet recipe's Story cell sits
+    # at chance BY CONSTRUCTION — the reference's similar_articles positives
+    # are same-CATEGORY neighbors (datasets/articles.py:83-128), so no
+    # gradient ever pulls same-story pairs together; the cell is noise around
+    # 0.5, not a defect. Bounded here; the story-keyed stage next proves the
+    # machinery carries Story when the mapping is keyed on it.
+    tri_sto_vl = tri_aurocs["similarity_boxplot_encoded_validate(Story)"]
+    check("triplet_story_chance_by_construction",
+          0.40 <= tri_sto_vl <= 0.62,
+          f"category-keyed triplet encoded(Story) validate {tri_sto_vl:.4f} "
+          "within the chance band [0.40, 0.62] (the per-category pos/neg "
+          "mapping carries no Story signal by construction — reference "
+          "datasets/articles.py:83-128)")
+    ts_enc_vl = tri_story_aurocs["similarity_boxplot_encoded_validate(Story)"]
+    check("triplet_story_keyed_carries_story",
+          ts_enc_vl > 0.60 and ts_enc_vl > tri_sto_vl,
+          f"story-keyed triplet encoded(Story) validate {ts_enc_vl:.4f} > "
+          f"0.60 and > the category-keyed {tri_sto_vl:.4f} (net-new --label "
+          "story mapping; grid frontier 0.6444, "
+          "evidence/triplet_story_keyed.json)")
     tl = tri_traj.get("triplet_loss", [])
     if len(tl) >= 2:
         # per-step values are noisy; compare first- vs last-decile means
@@ -463,6 +517,19 @@ def main(argv=None):
           ref_enc > 0.6 and ref_enc > ref_tfidf,
           f"reference-scale encoded {ref_enc:.4f} > tfidf {ref_tfidf:.4f} "
           f"(Category, validate; {t_ref:.0f}s end to end)")
+    # VERDICT r4 item 3: the story-mining knob at the headline workload shape
+    rs_enc = refstory_aurocs["similarity_boxplot_encoded_validate(Story)"]
+    rs_bin = refstory_aurocs["similarity_boxplot_binary_count_validate(Story)"]
+    rs_cat_run = ref_aurocs["similarity_boxplot_encoded_validate(Story)"]
+    check("refstory_story_mining_lifts_story_at_scale",
+          rs_enc > rs_cat_run,
+          f"refscale story-mined encoded(Story) validate {rs_enc:.4f} > the "
+          f"category-mined refscale run's {rs_cat_run:.4f} (the mining-label "
+          "knob works at the headline shape too)")
+    check("refstory_encoded_vs_binary",
+          rs_enc > rs_bin,
+          f"refscale story-mined encoded(Story) validate {rs_enc:.4f} > "
+          f"binary_count {rs_bin:.4f}")
     import numpy as np
 
     ss_loss = float(ss_result["best_val_error"])
@@ -498,6 +565,8 @@ def main(argv=None):
                                                 "<online-mining data_dir>"],
             "main_autoencoder_moe": MOE_ARGS,
             "main_autoencoder_refscale": REFSCALE_ARGS,
+            "main_autoencoder_refstory": REFSTORY_ARGS,
+            "main_autoencoder_triplet_story": TRIPLET_STORY_ARGS,
             "main_user_model": USER_ARGS,
         },
         "aurocs_online_mining": {k: float(v) for k, v in sorted(aurocs.items())},
@@ -506,6 +575,11 @@ def main(argv=None):
         "aurocs_refscale": {k: float(v) for k, v in sorted(ref_aurocs.items())},
         "refscale_wall_seconds": round(t_ref, 1),
         "aurocs_triplet": {k: float(v) for k, v in sorted(tri_aurocs.items())},
+        "aurocs_triplet_story_keyed": {
+            k: float(v) for k, v in sorted(tri_story_aurocs.items())},
+        "aurocs_refstory": {
+            k: float(v) for k, v in sorted(refstory_aurocs.items())},
+        "refstory_wall_seconds": round(refstory["wall"], 1),
         "triplet_loss_trajectory": tri_traj,
         "aurocs_moe": {k: float(v) for k, v in sorted(moe_aurocs.items())},
         "aurocs_starspace": {k: float(v) for k, v in sorted(ss_aurocs.items())},
@@ -523,6 +597,18 @@ def main(argv=None):
     print(f"evidence: {len(checks) - n_fail}/{len(checks)} checks passed; "
           f"artifacts in evidence/ ({payload['wall_seconds']}s)")
     return 1 if n_fail else 0
+
+
+def _cat_story_table(aurocs, reps=("tfidf", "binary_count", "encoded")):
+    """The standard representation x split Category/Story markdown table."""
+    lines = ["| representation | split | Category | Story |",
+             "|---|---|---|---|"]
+    for rep in reps:
+        for split, sfx in (("train", ""), ("validate", "_validate")):
+            cat = aurocs[f"similarity_boxplot_{rep}{sfx}(Category)"]
+            sto = aurocs[f"similarity_boxplot_{rep}{sfx}(Story)"]
+            lines.append(f"| {rep} | {split} | {cat:.4f} | {sto:.4f} |")
+    return lines
 
 
 def _write_md(p):
@@ -552,15 +638,9 @@ def _write_md(p):
         "",
         "## Online-mining driver: 12 AUROCs",
         "",
-        "| representation | split | Category | Story |",
-        "|---|---|---|---|",
     ]
     a = p["aurocs_online_mining"]
-    for rep in ("tfidf", "binary_count", "encoded"):
-        for split, sfx in (("train", ""), ("validate", "_validate")):
-            cat = a[f"similarity_boxplot_{rep}{sfx}(Category)"]
-            sto = a[f"similarity_boxplot_{rep}{sfx}(Story)"]
-            lines.append(f"| {rep} | {split} | {cat:.4f} | {sto:.4f} |")
+    lines += _cat_story_table(a)
     lines += [
         "",
         "The DAE is trained with `batch_all` online mining on the Category "
@@ -624,14 +704,8 @@ def _write_md(p):
         f"{a['similarity_boxplot_encoded_validate(Category)']:.4f} — the "
         "mining label is the knob, and the framework exposes both.",
         "",
-        "| representation | split | Category | Story |",
-        "|---|---|---|---|",
     ]
-    for rep in ("tfidf", "binary_count", "encoded"):
-        for split, sfx in (("train", ""), ("validate", "_validate")):
-            cat = st[f"similarity_boxplot_{rep}{sfx}(Category)"]
-            sto = st[f"similarity_boxplot_{rep}{sfx}(Story)"]
-            lines.append(f"| {rep} | {split} | {cat:.4f} | {sto:.4f} |")
+    lines += _cat_story_table(st)
     lines += [
         "",
         "## Reference-scale run (8000 x 10000 -> 500, bf16, streaming eval)",
@@ -640,15 +714,22 @@ def _write_md(p):
         f"{p['refscale_wall_seconds']}s (50 epochs of batch_all mining + "
         "histogram-streaming AUROC eval, figures included):",
         "",
-        "| representation | split | Category | Story |",
-        "|---|---|---|---|",
     ]
-    r = p["aurocs_refscale"]
-    for rep in ("tfidf", "binary_count", "encoded"):
-        for split, sfx in (("train", ""), ("validate", "_validate")):
-            cat = r[f"similarity_boxplot_{rep}{sfx}(Category)"]
-            sto = r[f"similarity_boxplot_{rep}{sfx}(Story)"]
-            lines.append(f"| {rep} | {split} | {cat:.4f} | {sto:.4f} |")
+    lines += _cat_story_table(p["aurocs_refscale"])
+    rs = p.get("aurocs_refstory")
+    if rs:
+        lines += [
+            "",
+            "## Reference-scale run, story-mined (`--label story`, 8000 x "
+            "10000 -> 500, bf16)",
+            "",
+            "The headline workload shape mined on STORY (alpha 30, the "
+            "story-sweep frontier; 3x oversampled generation fills the "
+            f"story-valid splits) in {p.get('refstory_wall_seconds', 0)}s — "
+            "the story-mining knob at reference scale:",
+            "",
+        ]
+        lines += _cat_story_table(rs)
     m = p["aurocs_moe"]
     lines += [
         "",
@@ -678,13 +759,7 @@ def _write_md(p):
         "",
     ]
     if "similarity_boxplot_tfidf(Category)" in t:
-        lines += ["| representation | split | Category | Story |",
-                  "|---|---|---|---|"]
-        for rep in ("tfidf", "binary_count", "encoded"):
-            for split, sfx in (("train", ""), ("validate", "_validate")):
-                cat = t[f"similarity_boxplot_{rep}{sfx}(Category)"]
-                sto = t[f"similarity_boxplot_{rep}{sfx}(Story)"]
-                lines.append(f"| {rep} | {split} | {cat:.4f} | {sto:.4f} |")
+        lines += _cat_story_table(t)
     else:
         # pre-round-4 record shape (train-only, mined label only): reachable
         # only when rendering an older committed results.json (the provenance
@@ -692,6 +767,19 @@ def _write_md(p):
         # produces the 12-key shape
         lines += ["| representation | AUROC |", "|---|---|"]
         lines += [f"| {k} | {v:.4f} |" for k, v in t.items()]
+    tsb = p.get("aurocs_triplet_story_keyed")
+    if tsb:
+        lines += [
+            "",
+            "The Story column above sits at chance BY CONSTRUCTION: the "
+            "reference's per-category mapping makes positives same-CATEGORY "
+            "neighbors (datasets/articles.py:83-128), so no gradient pulls "
+            "same-story pairs together. Keying the same recipe on the story "
+            "column instead (net-new `--label story` on this driver) makes "
+            "the triplet path carry Story:",
+            "",
+        ]
+        lines += _cat_story_table(tsb)
     tj = p.get("triplet_loss_trajectory", {})
     if tj.get("triplet_loss"):
         first, last = tj["triplet_loss"][0], tj["triplet_loss"][-1]

@@ -44,6 +44,22 @@ def test_similar_articles_mapping(df):
         assert by_id.loc[row.article_id_neg].category_publish_name != row.category_publish_name
 
 
+def test_similar_articles_story_keyed(df):
+    # net-new story-keyed mapping (cli/main_autoencoder_triplet.py --label
+    # story): positive shares the STORY, negative comes from a different (or
+    # no) story — the signal the reference's category-keyed recipe cannot
+    # carry by construction (reference datasets/articles.py:83-128)
+    out = articles.similar_articles(df, id_colname="article_id",
+                                    cate_colname="story", seed=0)
+    valid = out[out.valid_triplet_data == 1]
+    assert len(valid) > 0
+    by_id = out.set_index("article_id")
+    for _, row in valid.head(20).iterrows():
+        assert row.story is not None
+        assert by_id.loc[row.article_id_pos].story == row.story
+        assert by_id.loc[row.article_id_neg].story != row.story
+
+
 def test_count_vectorize_shared_vocab(df):
     out = articles.similar_articles(df, cate_colname="category_publish_name", seed=0)
     valid = out[out.valid_triplet_data == 1].head(50)

@@ -36,6 +36,23 @@ def test_main_autoencoder_end_to_end(workdir):
     assert len(os.listdir(model.plot_dir)) == len(finite)
 
 
+def test_main_autoencoder_joint_two_label_mining(workdir):
+    # --label2 mines a second batch_all term (story) jointly with the primary
+    # (category) label; rows without a story sit out the second term
+    from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import main
+
+    model, aurocs = main([
+        "--model_name", "j2", "--synthetic", "--validation", "--num_epochs", "2",
+        "--train_row", "120", "--validate_row", "40", "--max_features", "300",
+        "--batch_size", "0.25", "--opt", "ada_grad",
+        "--label2", "story", "--label2_alpha", "0.5",
+    ])
+    assert model.label2_alpha == 0.5
+    assert len(aurocs) == 12
+    finite = {k: v for k, v in aurocs.items() if np.isfinite(v)}
+    assert all(0.0 <= v <= 1.0 for v in finite.values())
+
+
 def test_main_autoencoder_restore_data(workdir):
     from dae_rnn_news_recommendation_tpu.cli.main_autoencoder import main
 
@@ -46,6 +63,23 @@ def test_main_autoencoder_restore_data(workdir):
     # second run restores the saved data artifacts and the model
     model, aurocs = main(args + ["--restore_previous_data", "--restore_previous_model"])
     assert any(np.isfinite(v) for v in aurocs.values())
+
+
+def test_main_autoencoder_triplet_story_keyed(workdir):
+    # --label story keys similar_articles on the story column (net-new; the
+    # reference recipe is category-only and carries no Story signal)
+    from dae_rnn_news_recommendation_tpu.cli.main_autoencoder_triplet import main
+
+    model, aurocs = main([
+        "--model_name", "ts", "--synthetic", "--num_epochs", "1",
+        "--train_row", "80", "--validate_row", "20", "--max_features", "300",
+        "--batch_size", "0.25", "--opt", "ada_grad", "--label", "story",
+        "--synthetic_oversample", "10.0",
+        "--loss_func", "mean_squared", "--dec_act_func", "none", "--validation",
+    ])
+    assert len(aurocs) == 12
+    finite = {k: v for k, v in aurocs.items() if np.isfinite(v)}
+    assert all(0.0 <= v <= 1.0 for v in finite.values())
 
 
 def test_main_autoencoder_triplet_end_to_end(workdir):

@@ -78,8 +78,11 @@ def test_evidence_arg_lists_parse(evrun):
     stage's arg list parses against the live config schema."""
     from dae_rnn_news_recommendation_tpu.utils.config import parse_flags
 
-    for name in ("MAIN_ARGS", "STORY_ARGS", "MOE_ARGS", "REFSCALE_ARGS"):
+    for name in ("MAIN_ARGS", "STORY_ARGS", "MOE_ARGS", "REFSCALE_ARGS",
+                 "REFSTORY_ARGS"):
         parse_flags(getattr(evrun, name))
+    for name in ("TRIPLET_ARGS", "TRIPLET_STORY_ARGS"):
+        parse_flags(getattr(evrun, name), triplet_mode=True)
 
     spec = importlib.util.spec_from_file_location(
         "scale_under_test", os.path.join(REPO, "evidence", "scale.py"))

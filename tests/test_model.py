@@ -81,6 +81,53 @@ def test_train_step_triplet_strategies():
             assert np.isfinite(float(metrics[k])), (strategy, k)
 
 
+def test_train_step_joint_two_label_mining():
+    """label2_alpha adds a second batch_all term over labels2; rows with
+    labels2 < 0 (missing secondary label) sit out that term. Oracle: compose
+    the two single-label calls by hand."""
+    from dae_rnn_news_recommendation_tpu.ops import losses, triplet
+    from dae_rnn_news_recommendation_tpu.train.step import loss_and_metrics
+
+    rng = np.random.default_rng(7)
+    b = 16
+    x = (rng.uniform(size=(b, 32)) < 0.3).astype(np.float32)
+    lab1 = rng.integers(0, 3, b).astype(np.int32)
+    lab2 = rng.integers(0, 4, b).astype(np.int32)
+    lab2[:5] = -1  # missing secondary labels
+    rv = np.ones(b, np.float32)
+    cfg = _cfg(triplet_strategy="batch_all", alpha=2.0, label2_alpha=0.5,
+               corr_type="none")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch = {"x": jnp.asarray(x), "labels": jnp.asarray(lab1),
+             "labels2": jnp.asarray(lab2), "row_valid": jnp.asarray(rv)}
+    cost, metrics = loss_and_metrics(params, batch, jax.random.PRNGKey(1), cfg)
+
+    from dae_rnn_news_recommendation_tpu.models.dae_core import decode, encode
+    h = encode(params, jnp.asarray(x), cfg)
+    y = decode(params, h, cfg)
+    t1, w1, _, _, _ = triplet.batch_all_triplet_loss(
+        jnp.asarray(lab1), h, row_valid=jnp.asarray(rv))
+    rv2 = rv * (lab2 >= 0)
+    t2, w2, _, _, _ = triplet.batch_all_triplet_loss(
+        jnp.asarray(lab2), h, row_valid=jnp.asarray(rv2))
+    ae = losses.weighted_loss(jnp.asarray(x), y, cfg.loss_func,
+                              weight=jnp.maximum(w1, w2),
+                              row_valid=jnp.asarray(rv))
+    expect = float(ae + 2.0 * (t1 + 0.5 * t2))
+    np.testing.assert_allclose(float(cost), expect, rtol=1e-6)
+    np.testing.assert_allclose(float(metrics["triplet_loss"]),
+                               float(t1 + 0.5 * t2), rtol=1e-6)
+
+    # label2_alpha=0 ignores labels2 entirely (reference single-label behavior)
+    cfg0 = _cfg(triplet_strategy="batch_all", alpha=2.0, corr_type="none")
+    cost0, _ = loss_and_metrics(init_params(jax.random.PRNGKey(0), cfg0),
+                                batch, jax.random.PRNGKey(1), cfg0)
+    expect0 = float(losses.weighted_loss(
+        jnp.asarray(x), y, cfg0.loss_func, weight=w1,
+        row_valid=jnp.asarray(rv)) + 2.0 * t1)
+    np.testing.assert_allclose(float(cost0), expect0, rtol=1e-6)
+
+
 @pytest.fixture
 def workdir(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
