@@ -438,14 +438,17 @@ def main(argv=None):
           f"encoded {enc_vl:.4f} > tfidf {tfidf_vl:.4f} (Category, validate)")
     tri_enc_vl = tri_aurocs["similarity_boxplot_encoded_validate(Category)"]
     tri_bin_vl = tri_aurocs["similarity_boxplot_binary_count_validate(Category)"]
-    check("triplet_encoded_meets_sweep_frontier", tri_enc_vl > 0.70,
-          f"triplet encoded(Category) validate AUROC {tri_enc_vl:.4f} > 0.70 "
-          "(calibrated to the round-4 sweep frontier 0.7462, "
-          "evidence/triplet_sweep.json)")
+    check("triplet_encoded_meets_sweep_frontier", tri_enc_vl > 0.60,
+          f"triplet encoded(Category) validate AUROC {tri_enc_vl:.4f} > 0.60 "
+          "(threshold = worst seed of the 3-seed spread [0.602, 0.8096], "
+          "mean 0.7193, evidence/seed_spread.json; the r4 sweep frontier "
+          "0.7462 is this record's seed-0 draw)")
     check("triplet_encoded_beats_binary_validate", tri_enc_vl > tri_bin_vl,
           f"triplet encoded {tri_enc_vl:.4f} > binary_count {tri_bin_vl:.4f} "
-          "(Category, validate — the precomputed-triplet pos/neg mapping is "
-          "built per category, reference similar_articles)")
+          "(Category, validate — holds on the 3-seed means too, 0.7193 vs "
+          "0.6166, though the worst seed is a near-tie 0.6020 vs 0.6036; "
+          "the pos/neg mapping is built per category, reference "
+          "similar_articles)")
     # VERDICT r4 item 4: the category-keyed triplet recipe's Story cell sits
     # at chance BY CONSTRUCTION — the reference's similar_articles positives
     # are same-CATEGORY neighbors (datasets/articles.py:83-128), so no
@@ -458,7 +461,8 @@ def main(argv=None):
           f"category-keyed triplet encoded(Story) validate {tri_sto_vl:.4f} "
           "within the chance band [0.40, 0.62] (the per-category pos/neg "
           "mapping carries no Story signal by construction — reference "
-          "datasets/articles.py:83-128)")
+          "datasets/articles.py:83-128; 3-seed spread [0.4254, 0.4852], "
+          "evidence/seed_spread.json)")
     ts_enc_vl = tri_story_aurocs["similarity_boxplot_encoded_validate(Story)"]
     check("triplet_story_keyed_carries_story",
           ts_enc_vl > 0.60 and ts_enc_vl > tri_sto_vl,
@@ -488,17 +492,29 @@ def main(argv=None):
           sto_enc_vl > cat_run_story_vl,
           f"story-mined encoded(Story) validate {sto_enc_vl:.4f} > "
           f"category-mined run's {cat_run_story_vl:.4f} (the mining label "
-          "steers which similarity the embedding learns)")
+          "steers which similarity the embedding learns; holds on the "
+          "3-seed means too — 0.6466 vs 0.6116 — though not at every "
+          "individual seed, evidence/seed_spread.json)")
     sto_bin_vl = story_aurocs["similarity_boxplot_binary_count_validate(Story)"]
     tfidf_note = (f"tfidf {sto_tfidf_vl:.4f} "
                   + ("stays ahead" if sto_tfidf_vl > sto_enc_vl else "beaten"))
-    check("story_mined_encoded_beats_binary", sto_enc_vl > sto_bin_vl,
-          f"story-mined encoded(Story) validate {sto_enc_vl:.4f} > "
-          f"binary_count {sto_bin_vl:.4f} (the r3 verdict's bar; {tfidf_note}"
-          " — alpha sweep frontier 0.675, evidence/story_sweep.json)")
-    check("story_mined_encoded_above_chance", sto_enc_vl > 0.64,
-          f"story-mined encoded(Story) validate {sto_enc_vl:.4f} > 0.64 "
-          "(calibrated to the round-4 sweep frontier 0.6752, not post-hoc)")
+    # VERDICT r4 item 5: checks are calibrated to the measured 3-seed spread
+    # (evidence/seed_spread.json), not this record's single draw. The spread
+    # shows story-mined encoded (0.6466 +- 0.021 over seeds 0/1/2) is
+    # statistically indistinguishable from binary counts (0.6506 +- 0.007) at
+    # this corpus size — the earlier seed-0-only "encoded beats binary" claim
+    # does not survive the spread and is retired honestly.
+    check("story_mined_encoded_matches_binary_within_spread",
+          sto_enc_vl >= sto_bin_vl - 0.05,
+          f"story-mined encoded(Story) validate {sto_enc_vl:.4f} >= "
+          f"binary_count {sto_bin_vl:.4f} - 0.05 (one-sided: not worse than "
+          "binary beyond seed noise; 3-seed means 0.6466 vs 0.6506, "
+          f"evidence/seed_spread.json; {tfidf_note} — 27-config plateau "
+          "~0.67, evidence/story_sweep.json + story_sweep2.json)")
+    check("story_mined_encoded_above_chance", sto_enc_vl > 0.62,
+          f"story-mined encoded(Story) validate {sto_enc_vl:.4f} > 0.62 "
+          "(worst seed of the 3-seed spread is 0.6254, "
+          "evidence/seed_spread.json; chance 0.5)")
     # three-way on ONE split (StarSpace trains on the online-mining stage's
     # saved artifacts): the reference notebook's cells 9-13 comparison
     ss_vl = ss_aurocs["starspace_validate"]
@@ -509,8 +525,11 @@ def main(argv=None):
     check("moe_encoded_beats_tfidf_validate",
           moe_vl > 0.65 and moe_vl > tfidf_vl,
           f"4-expert mixture encoded {moe_vl:.4f} > tfidf {tfidf_vl:.4f} "
-          "(Category, validate; same corpus, 60-epoch schedule — each expert "
-          "sees ~1/4 of the rows per epoch)")
+          "(Category, validate; EXPERIMENTAL family — the iso-epoch sweep "
+          "shows it does not match the single DAE at any schedule: 0.8040@60 "
+          "/ 0.7904@100 / 0.7824@150 epochs vs 0.8477, "
+          "evidence/moe_iso_epoch.json; kept as the expert-parallelism demo, "
+          "claiming only the tfidf comparison)")
     ref_enc = ref_aurocs["similarity_boxplot_encoded_validate(Category)"]
     ref_tfidf = ref_aurocs["similarity_boxplot_tfidf_validate(Category)"]
     check("refscale_encoded_beats_tfidf",
@@ -587,6 +606,13 @@ def main(argv=None):
         "user_model": dict(user),
         "checks": checks,
     }
+    # the 3-seed spread behind the calibrated thresholds rides along in the
+    # record (full per-seed AUROCs in evidence/seed_spread.json)
+    try:
+        with open(os.path.join(HERE, "seed_spread.json")) as f:
+            payload["seed_spread_summary"] = json.load(f)["summary"]
+    except (FileNotFoundError, KeyError, json.JSONDecodeError):
+        pass
     with open(os.path.join(HERE, "results.json"), "w") as f:
         json.dump(payload, f, indent=2)
 
@@ -733,12 +759,17 @@ def _write_md(p):
     m = p["aurocs_moe"]
     lines += [
         "",
-        "## Mixture-of-denoisers (--n_experts 4, net-new family)",
+        "## Mixture-of-denoisers (--n_experts 4, net-new family — "
+        "EXPERIMENTAL)",
         "",
         "Same corpus as the online-mining run above, routed across 4 expert "
-        "DAEs (Switch-style top-1 gating) on a 60-epoch schedule (each expert "
-        "sees ~1/4 of the rows per epoch, so the mixture converges slower "
-        "than the single DAE's 25 epochs):",
+        "DAEs (Switch-style top-1 gating) on a 60-epoch schedule. "
+        "**Experimental / expert-parallelism demo**: the iso-epoch sweep "
+        "(evidence/moe_iso_epoch.json) shows the mixture does not match the "
+        "single DAE at any schedule (0.8040@60 / 0.7904@100 / 0.7824@150 "
+        "epochs vs the single DAE's 0.8477 — each expert trains on a ~1/4 "
+        "data shard, and longer schedules overfit the shards rather than "
+        "close the gap). It beats tfidf, and that is all its check claims:",
         "",
         "| representation | split | Category | Story |",
         "|---|---|---|---|",
