@@ -90,3 +90,27 @@ def test_evidence_arg_lists_parse(evrun):
     spec.loader.exec_module(scale)
     flags = parse_flags(scale.ARGS)
     assert flags.max_features == 50000 and flags.train_row == 100000
+
+
+def test_sweep_script_arg_lists_parse(evrun):
+    """The committed sweep/spread harnesses must keep parsing too: every GRID
+    entry in story_sweep2 and every reseeded stage in seed_spread goes through
+    the live flag schema."""
+    from dae_rnn_news_recommendation_tpu.utils.config import parse_flags
+
+    spec = importlib.util.spec_from_file_location(
+        "sweep2_under_test", os.path.join(REPO, "evidence", "story_sweep2.py"))
+    sweep2 = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sweep2)
+    for name, extra in sweep2.GRID:
+        parse_flags(sweep2.BASE + ["--model_name", name] + extra)
+
+    spec = importlib.util.spec_from_file_location(
+        "spread_under_test", os.path.join(REPO, "evidence", "seed_spread.py"))
+    spread = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(spread)
+    args = spread._stage_args(seed=5)
+    for stage in ("main", "story"):
+        flags = parse_flags(args[stage])
+        assert flags.seed == 5
+    assert parse_flags(args["triplet"], triplet_mode=True).seed == 5
