@@ -166,7 +166,9 @@ def main(argv=None):
         n_devices=FLAGS.n_devices, mining_scope=FLAGS.mining_scope,
         compute_dtype=FLAGS.compute_dtype, checkpoint_every=FLAGS.checkpoint_every,
         profile=FLAGS.profile, sparse_feed=bool(FLAGS.sparse_feed),
-        weight_update_sharding=FLAGS.weight_update_sharding)
+        weight_update_sharding=FLAGS.weight_update_sharding,
+        resident_feed={"auto": "auto", "on": True, "off": False}[
+            FLAGS.resident_feed])
 
     (article_contents, X, X_validate, X_tfidf, X_tfidf_validate,
      labels) = prepare_or_restore_data(model, FLAGS)

@@ -64,7 +64,8 @@ class DenoisingAutoencoder:
                  n_devices=1, mesh=None, mining_scope="global", results_root="results",
                  use_tensorboard=True, n_components=None, profile=False,
                  prefetch_depth=2, keep_checkpoint_max=0, sparse_feed=True,
-                 weight_update_sharding=False):
+                 weight_update_sharding=False, resident_feed="auto",
+                 resident_budget_bytes=2 << 30):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
 
         :param n_components: explicit code size; overrides the compress_factor
@@ -127,6 +128,15 @@ class DenoisingAutoencoder:
         # shard optimizer accumulators over the data axis (ZeRO-1 style,
         # parallel/dp.py:opt_state_shardings) — 1/N optimizer memory per device
         self.weight_update_sharding = weight_update_sharding
+        # resident-epoch execution (train/resident.py): keep the train set in
+        # HBM and run each epoch as ONE lax.scan dispatch instead of one
+        # dispatch per batch. "auto" enables it on TPU backends (where
+        # dispatch latency dominates at reference shapes) for single-process,
+        # single-input fits whose feed fits resident_budget_bytes; True/False
+        # force it. Semantics match the streaming path batch for batch
+        # (tests/test_resident.py).
+        self.resident_feed = resident_feed
+        self.resident_budget_bytes = resident_budget_bytes
 
         assert isinstance(self.verbose_step, int)
         assert self.verbose >= 0
@@ -430,6 +440,16 @@ class DenoisingAutoencoder:
         n_batches = int(np.ceil(n_rows / b))
         ran_validation = False
         self._last_epoch = self._epoch0
+
+        resident_mode = self._resident_active(train_set)
+        self._last_fit_resident = resident_mode  # introspection for tests/tools
+        if resident_mode:
+            from ..train import resident as resident_mod
+
+            resident_data = resident_mod.build_resident(train_set, labels,
+                                                        labels2)
+            epoch_fn = resident_mod.make_epoch_fn(self.config, self.optimizer)
+
         for e in range(self.num_epochs):
             epoch = self._epoch0 + e + 1
             self.train_cost_batch = [], [], []
@@ -437,23 +457,37 @@ class DenoisingAutoencoder:
             self.num_triplet_batch = []
             t0 = time.time()
 
-            # accumulate device arrays only — converting per step would force a
-            # host-device sync each batch and stall the async dispatch pipeline
-            step_in_epoch = 0
-            device_metrics = []
-            for batch in prefetch(batcher.epoch(train_set, labels, labels2),
-                                  self.prefetch_depth):
-                batch.update(extremes)
-                batch = self._place_batch(batch)
-                self._key, sub = jax.random.split(self._key)
-                self.params, self.opt_state, metrics = self._train_step(
-                    self.params, self.opt_state, sub, batch)
-                step_in_epoch += 1
-                device_metrics.append(metrics)
+            if resident_mode:
+                # whole epoch in ONE dispatch: scan over the same permuted
+                # batches the streaming path would emit (train/resident.py)
+                from ..train.resident import stack_epoch_indices
 
-            # one sync per epoch: pull all step metrics, then log/record on host
-            host_metrics = jax.device_get(device_metrics)
-            self.train_time = time.time() - t0
+                perm, rvalid = stack_epoch_indices(batcher, n_rows)
+                (self.params, self.opt_state, self._key, stacked) = epoch_fn(
+                    self.params, self.opt_state, self._key, resident_data,
+                    perm, rvalid, extremes)
+                host = jax.device_get(stacked)
+                host_metrics = [{k: v[i] for k, v in host.items()}
+                                for i in range(perm.shape[0])]
+                self.train_time = time.time() - t0
+            else:
+                # accumulate device arrays only — converting per step would force a
+                # host-device sync each batch and stall the async dispatch pipeline
+                step_in_epoch = 0
+                device_metrics = []
+                for batch in prefetch(batcher.epoch(train_set, labels, labels2),
+                                      self.prefetch_depth):
+                    batch.update(extremes)
+                    batch = self._place_batch(batch)
+                    self._key, sub = jax.random.split(self._key)
+                    self.params, self.opt_state, metrics = self._train_step(
+                        self.params, self.opt_state, sub, batch)
+                    step_in_epoch += 1
+                    device_metrics.append(metrics)
+
+                # one sync per epoch: pull all step metrics, then log/record on host
+                host_metrics = jax.device_get(device_metrics)
+                self.train_time = time.time() - t0
             for i, m in enumerate(host_metrics):
                 m = {k: float(v) for k, v in m.items()}
                 # reference step key: (epoch-1)*num_batches + i (autoencoder.py:245)
@@ -486,6 +520,31 @@ class DenoisingAutoencoder:
             self._run_validation(self._last_epoch, validation_set,
                                  validation_set_label, val_writer)
             self._log_param_histograms(train_writer, self._last_epoch * n_batches)
+
+    def _resident_active(self, train_set):
+        """Whether this fit runs resident-epoch execution (train/resident.py).
+
+        Only the single-process, single-input paths qualify: the triplet
+        subclass feeds {org,pos,neg} dicts and multi-process fits shard the
+        feed per host (parallel/feed.py). `resident_feed="auto"` turns it on
+        when dispatch latency dominates — i.e. on TPU backends — and the feed
+        fits the budget; CPU keeps the streaming path so existing records stay
+        byte-stable (the two paths agree to float tolerance, not bitwise:
+        different XLA programs may fuse differently)."""
+        if self._multiprocess or isinstance(train_set, dict):
+            return False
+        if self._batcher_cls is not PaddedBatcher:
+            return False
+        if sp.issparse(train_set) and not self.sparse_feed:
+            return False  # dense feed of sparse data: stream it
+        if self.resident_feed is True:
+            return True
+        if not self.resident_feed or self.resident_feed != "auto":
+            return False
+        from ..train.resident import resident_bytes
+
+        return (jax.default_backend() == "tpu"
+                and resident_bytes(train_set) <= self.resident_budget_bytes)
 
     def _feed_batcher(self, data):
         """The batcher class for `data`: the sparse-ingest feed for scipy-sparse
@@ -626,7 +685,13 @@ class DenoisingAutoencoder:
     def _transform_sparse(self, data, batch_size):
         """Sparse-ingest encode stream: pad rows to one global K (single compiled
         shape), dispatch every batch asynchronously, collect at the end — host
-        packing of batch i+1 overlaps the device encode of batch i."""
+        packing of batch i+1 overlaps the device encode of batch i.
+
+        Overlapped per-batch dispatch is the measured winner: grouping batches
+        into one lax.scan dispatch (ops/sparse_ingest.sparse_encode_scan)
+        serializes the larger host->device puts and loses whenever transfer —
+        not dispatch latency — is the bottleneck (bench.py 2026-08-02:
+        stream 114k vs scan-grouped 99k articles/sec on the tunneled v5e)."""
         from ..ops.sparse_ingest import pad_csr_batch, sparse_encode
 
         data = data.tocsr()

@@ -13,11 +13,14 @@
    (pltpu.prng_seed / prng_random_bits): one read-mask-write pass with on-chip
    randomness instead of counter-based threefry bit generation.
 
-STATUS: VALIDATED INFRASTRUCTURE, NOT PRODUCTION (final as of round 3). Measured
-on a real v5e-1 (2026-07, jax 0.9): XLA wins batch_all — its fusion also never
-materializes the cube (runs B=4096 where the cube would be 256 GiB) and is
-~1.4-1.8x faster than this kernel (14 vs 19 ms at B=1024/D=500; 431 vs 781 ms at
-B=4096, best tiles (16,128,128)). Masking is sub-millisecond in both forms at
+STATUS: VALIDATED INFRASTRUCTURE, NOT PRODUCTION (final as of round 3;
+re-confirmed round 5 with fetch-fenced timing). Measured on a real v5e-1:
+XLA wins batch_all — its fusion also never materializes the cube (runs B=4096
+where the cube would be 256 GiB). Round-5 numbers (2026-08-02, hard host-fetch
+sync per bench.py:_hard_sync — the earlier block_until_ready timings were
+optimistic for BOTH sides, ratio unchanged): grad-step XLA vs Pallas
+8.6 vs 30.2 ms at B=800/D=500; 129 vs 288 ms at B=2048; 950 vs 2308 ms at
+B=4096, tiles (8,128,128). Masking is sub-millisecond in both forms at
 [8192, 10000] — below reliable timing resolution over the axon tunnel. A round-2
 re-tune (tile sweep + fused-mask variant) was abandoned as unmeasurable: the
 tunnel memoizes (executable, inputs) dispatches, so microbenchmarks neither scale

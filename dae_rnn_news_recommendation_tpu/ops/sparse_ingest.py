@@ -181,6 +181,27 @@ def sparse_encode_matmul(w, indices, values=None, chunk=256,
     return out.reshape(b, d)
 
 
+def sparse_encode_scan(params, indices, values, config, chunk=256,
+                       via_dense=False):
+    """Encode M packed batches in ONE dispatch: lax.scan of `sparse_encode`
+    over stacked [M, B, K] indices (and values, or None for binary mode),
+    returning [M, B, D].
+
+    Why: each jitted call pays a dispatch round trip; over a high-latency link
+    (tunneled TPU: ~23-70 ms measured) per-batch dispatch leaves the chip
+    idle. Scanning amortizes one dispatch over M batches while the per-batch
+    [B, K] working-set bound of `sparse_encode` is unchanged.
+    """
+    def body(carry, sl):
+        idx, vals = sl if values is not None else (sl, None)
+        return carry, sparse_encode(params, idx, vals, config, chunk=chunk,
+                                    via_dense=via_dense)
+
+    xs = indices if values is None else (indices, values)
+    _, out = jax.lax.scan(body, None, xs)
+    return out
+
+
 def densify_on_device(indices, values, n_features, dtype=jnp.float32):
     """Scatter-add (indices, values) into a dense [B, F] tile on device.
 

@@ -1,0 +1,134 @@
+"""One-dispatch-per-epoch training: resident dataset + lax.scan over minibatches.
+
+Why this exists: each jitted call pays a host->device dispatch round trip. Over
+a high-latency link (the axon TPU tunnel here: ~23-70 ms per call, measured
+2026-08-02 — see bench.py:_hard_sync) a per-batch dispatch leaves the chip ~99%
+idle at reference shapes. The TPU-idiomatic fix is to keep the training set
+resident in HBM and compile the whole epoch as ONE XLA program: `lax.scan`
+gathers each permuted minibatch from the resident arrays, corrupts, mines, and
+updates donated params in place. Host traffic per epoch drops to one [S, B]
+int32 permutation upload and one stacked-metrics download.
+
+Semantics match the streaming path (models/estimator.py _train_loop_inner)
+exactly:
+  - the permutation/padding comes from the same PaddedBatcher bookkeeping
+    (`_index_batches`), so batch composition per epoch is identical;
+  - the per-step PRNG chain is the same `key, sub = jax.random.split(key)`
+    sequence, carried through the scan;
+  - padded rows are zeroed (x * row_valid) and their labels set to -1, exactly
+    as the host batcher emits them.
+tests/test_resident.py asserts parameter parity between the two paths.
+
+No reference counterpart: the reference dispatches one Session.run per batch
+and corrupts on host once per epoch (autoencoder/autoencoder.py:218, :233).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from .step import loss_and_metrics
+
+# resident sparse feeds reuse the streaming feed's padded layout
+_DENSE_BYTES_PER_VAL = 4
+
+
+def resident_bytes(train_set):
+    """Device-memory estimate for keeping `train_set` resident (feed layout)."""
+    if sp.issparse(train_set):
+        n = train_set.shape[0]
+        k = int(np.diff(train_set.tocsr().indptr).max(initial=1))
+        return n * k * (2 + 4)  # uint16 indices + f32 values
+    n, f = train_set.shape
+    return n * f * _DENSE_BYTES_PER_VAL
+
+
+def build_resident(train_set, labels=None, labels2=None, device_put=None):
+    """Upload the training set (and labels) to the device once.
+
+    Sparse input keeps the sparse-ingest layout ({indices [N,K] u16/u32,
+    values [N,K] f32}, same padded K the streaming SparseIngestBatcher uses),
+    densified on device per minibatch; dense input uploads [N, F] float32.
+    """
+    put = device_put or jax.device_put
+    resident = {}
+    if sp.issparse(train_set):
+        from ..ops.sparse_ingest import pad_csr_rows
+
+        csr = train_set.tocsr()
+        if csr.data.dtype != np.float32:
+            csr = csr.astype(np.float32)
+        k = int(np.diff(csr.indptr).max(initial=1))
+        packed = pad_csr_rows(csr, np.arange(csr.shape[0]), k=k)
+        resident["indices"] = put(packed["indices"])
+        resident["values"] = put(packed["values"])
+    else:
+        x = np.asarray(train_set, dtype=np.float32)
+        resident["x"] = put(x)
+    if labels is not None:
+        resident["labels"] = put(
+            np.asarray(labels).reshape(-1).astype(np.int32))
+    if labels2 is not None:
+        resident["labels2"] = put(
+            np.asarray(labels2).reshape(-1).astype(np.int32))
+    return resident
+
+
+def stack_epoch_indices(batcher, n_rows):
+    """One epoch of the batcher's shuffle/pad bookkeeping, stacked for the scan:
+    (perm [S, B] int32, row_valid [S, B] f32). Advances the batcher RNG exactly
+    like a streaming epoch does, so the two paths see identical batches."""
+    perms, valids = [], []
+    for idx, _n_real, valid in batcher._index_batches(n_rows):
+        perms.append(idx.astype(np.int32))
+        valids.append(valid)
+    return np.stack(perms), np.stack(valids)
+
+
+def make_epoch_fn(config, optimizer):
+    """Build the jitted whole-epoch function.
+
+    epoch_fn(params, opt_state, key, resident, perm, row_valid, extremes)
+      -> (params, opt_state, key, metrics_stacked)
+
+    `perm`/`row_valid` are [S, B]; `metrics_stacked` maps each metric name to a
+    [S] array (one entry per step, same order as the streaming loop's per-batch
+    metrics). params/opt_state are donated: XLA updates them in place in HBM.
+    """
+
+    def gather_batch(resident, idx, rv, extremes):
+        batch = dict(extremes)
+        batch["row_valid"] = rv
+        if "x" in resident:
+            # zero padded rows: bit-parity with the host batcher's x[n_real:]=0
+            batch["x"] = jnp.take(resident["x"], idx, axis=0) * rv[:, None]
+        else:
+            batch["indices"] = jnp.take(resident["indices"], idx, axis=0)
+            batch["values"] = jnp.take(resident["values"], idx, axis=0) * rv[:, None]
+        valid = rv > 0
+        if "labels" in resident:
+            batch["labels"] = jnp.where(
+                valid, jnp.take(resident["labels"], idx), -1)
+        if "labels2" in resident:
+            batch["labels2"] = jnp.where(
+                valid, jnp.take(resident["labels2"], idx), -1)
+        return batch
+
+    def epoch_fn(params, opt_state, key, resident, perm, row_valid, extremes):
+        def body(carry, sl):
+            params, opt_state, key = carry
+            idx, rv = sl
+            batch = gather_batch(resident, idx, rv, extremes)
+            key, sub = jax.random.split(key)
+            (_cost, metrics), grads = jax.value_and_grad(
+                loss_and_metrics, has_aux=True)(params, batch, sub, config)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            return (params, opt_state, key), metrics
+
+        (params, opt_state, key), metrics = jax.lax.scan(
+            body, (params, opt_state, key), (perm, row_valid))
+        return params, opt_state, key, metrics
+
+    return jax.jit(epoch_fn, donate_argnums=(0, 1))

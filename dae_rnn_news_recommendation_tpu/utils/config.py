@@ -151,6 +151,14 @@ def build_parser(triplet_mode=False):
                         "the device as (indices, values) pairs and densify "
                         "on-device — bit-identical math, ~50x fewer feed bytes; "
                         "0: dense host batches")
+    p.add_argument("--resident_feed", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="resident-epoch execution (train/resident.py): keep "
+                        "the train set in device HBM and run each epoch as ONE "
+                        "lax.scan dispatch instead of one dispatch per batch "
+                        "(same batches/PRNG chain, tested equivalent). 'auto' "
+                        "(default) enables it on TPU backends when the feed "
+                        "fits the device budget")
     return p
 
 

@@ -81,8 +81,17 @@ def main(argv=None):
     check("scale_encoded_above_chance", enc_vl > 0.55,
           f"encoded(Category) validate AUROC {enc_vl:.4f} > 0.55 at 100k rows")
 
+    try:
+        import subprocess
+
+        rev = subprocess.run(["git", "-C", REPO, "rev-parse", "HEAD"],
+                             capture_output=True, text=True,
+                             timeout=10).stdout.strip()
+    except Exception:
+        rev = ""
     payload = {
         "generated": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "git_rev": rev,
         "platform": platform,
         "seed": SEED,
         "wall_seconds": round(wall, 1),

