@@ -242,6 +242,10 @@ def _bench_encode(jax, params, config, sz, via_dense=False, feeds=None,
     if scan_group > 1:
         # one dispatch per `scan_group` batches: amortizes the per-call round
         # trip (the dominating cost over the tunnel — see _hard_sync)
+        assert n_batches % scan_group == 0, (
+            f"scan_group={scan_group} must divide n_batches={n_batches}: a "
+            "ragged tail group has a different stacked shape and would "
+            "recompile inside the timed section")
         enc_fn = jax.jit(lambda p, i: sparse_encode_scan(
             p, i, None, config, chunk=512, via_dense=via_dense))
         group = scan_group
@@ -252,8 +256,7 @@ def _bench_encode(jax, params, config, sz, via_dense=False, feeds=None,
         _phase("encode: warm")
 
         def one_pass(feeds):
-            grouped = [np.stack(feeds[g : g + group])
-                       for g in range(0, len(feeds), group)]
+            grouped = _stack_groups(feeds, group)
             t0 = time.perf_counter()
             inflight = [jax.device_put(grouped[0])]
             out = None
@@ -348,7 +351,48 @@ def _bench_train(jax, sz, batch_override=None, steps_override=None,
     return n_steps * tb / dt
 
 
-def _bench_train_stream(jax, sz):
+def _stack_groups(feeds, group):
+    """Stack `feeds` into [group, ...] arrays for the scanned dispatch,
+    DROPPING a ragged tail: a tail group with fewer batches has a different
+    stacked shape and would recompile inside the timed section (the caller
+    asserts divisibility up front so nothing is actually dropped at the
+    bench's own sizes)."""
+    n = (len(feeds) // group) * group
+    return [np.stack(feeds[g : g + group]) for g in range(0, n, group)]
+
+
+def _fit_workload(jax, sz):
+    """Shared fixture for the fit-path benches: one dataset, one config, ONE
+    compiled train step reused by the stream and (on CPU) pipelined figures.
+    The CPU child's wall clock is dominated by XLA compiles at the 10k-feature
+    shape, so every extra jit instance risks the child timeout; sharing the
+    executable also makes the stream-vs-pipelined comparison a pure feed A/B."""
+    from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+    from dae_rnn_news_recommendation_tpu.train import make_optimizer
+    from dae_rnn_news_recommendation_tpu.train.step import make_train_step
+
+    n_rows = sz["stream_rows"]
+    rng = np.random.default_rng(3)
+    config = DAEConfig(
+        n_features=F, n_components=D, enc_act_func="sigmoid", dec_act_func="sigmoid",
+        loss_func="cross_entropy", corr_type="masking", corr_frac=0.3,
+        triplet_strategy="batch_all", alpha=1.0, compute_dtype="bfloat16",
+    )
+    optimizer = make_optimizer("ada_grad", 0.1)
+
+    def init(jax=jax):
+        params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
+        return params, jax.device_put(optimizer.init(params))
+
+    return {
+        "data": _make_pool(n_rows, rng).astype(np.float32),
+        "labels": rng.integers(0, 30, n_rows).astype(np.int32),
+        "config": config, "optimizer": optimizer,
+        "step": make_train_step(config, optimizer), "init": init,
+    }
+
+
+def _bench_train_stream(jax, sz, workload=None):
     """End-to-end fit hot loop INCLUDING the host feed: csr -> sparse-ingest
     batches (uint16 indices + f32 values, prefetched) -> on-device densify +
     train step. This is what a real fit() pays per epoch."""
@@ -356,23 +400,11 @@ def _bench_train_stream(jax, sz):
 
     from dae_rnn_news_recommendation_tpu.data.batcher import (
         SparseIngestBatcher, prefetch)
-    from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
-    from dae_rnn_news_recommendation_tpu.train import make_optimizer
-    from dae_rnn_news_recommendation_tpu.train.step import make_train_step
 
+    wl = workload or _fit_workload(jax, sz)
     n_rows, batch = sz["stream_rows"], sz["stream_batch"]
-    rng = np.random.default_rng(3)
-    data = _make_pool(n_rows, rng).astype(np.float32)
-    labels = rng.integers(0, 30, n_rows).astype(np.int32)
-    config = DAEConfig(
-        n_features=F, n_components=D, enc_act_func="sigmoid", dec_act_func="sigmoid",
-        loss_func="cross_entropy", corr_type="masking", corr_frac=0.3,
-        triplet_strategy="batch_all", alpha=1.0, compute_dtype="bfloat16",
-    )
-    params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
-    optimizer = make_optimizer("ada_grad", 0.1)
-    opt_state = jax.device_put(optimizer.init(params))
-    step = make_train_step(config, optimizer)
+    data, labels, step = wl["data"], wl["labels"], wl["step"]
+    params, opt_state = wl["init"]()
     batcher = SparseIngestBatcher(batch, seed=0)
     key = jax.random.PRNGKey(1)
 
@@ -398,6 +430,58 @@ def _bench_train_stream(jax, sz):
         _phase(f"fit-stream: epoch {i + 1}/{epochs} done")
     dt = time.perf_counter() - t0
     return epochs * n_rows / dt
+
+
+def _bench_fit_pipelined(jax, sz, workload=None):
+    """The overlapped-feed fit hot loop (train/pipeline.py): a background
+    worker device_puts sparse batches up to depth=4 ahead of the step, so the
+    host->device transfer of batch i+1.. overlaps the compute of batch i; on
+    TPU the step additionally donates its consumed batch buffers
+    (make_train_step(donate_batch=True)). On CPU the STREAM bench's compiled
+    step is reused — no donation benefit host-side, and a second 10k-shape
+    compile would eat the CPU child's timeout margin.
+
+    Returns (articles_per_sec, FeedStats) — the stats carry
+    feed_stall_fraction over the timed epochs."""
+    from dae_rnn_news_recommendation_tpu.data.batcher import SparseIngestBatcher
+    from dae_rnn_news_recommendation_tpu.train.pipeline import (
+        FeedStats, PipelinedFeed)
+    from dae_rnn_news_recommendation_tpu.train.step import make_train_step
+
+    wl = workload or _fit_workload(jax, sz)
+    n_rows, batch = sz["stream_rows"], sz["stream_batch"]
+    if jax.devices()[0].platform == "tpu":
+        _phase("fit-pipelined: compiling donating step")
+        step = make_train_step(wl["config"], wl["optimizer"], donate_batch=True)
+    else:
+        step = wl["step"]
+    params, opt_state = wl["init"]()
+    batcher = SparseIngestBatcher(batch, seed=0)
+    key = jax.random.PRNGKey(1)
+    stats = FeedStats()
+
+    def one_epoch():
+        nonlocal params, opt_state, key
+        metrics = None
+        feed = PipelinedFeed(batcher.epoch(wl["data"], wl["labels"]),
+                             depth=4, stats=stats)
+        for b in feed:
+            key, sub = jax.random.split(key)
+            params, opt_state, metrics = step(params, opt_state, sub, b)
+        _hard_sync(jax, metrics)
+
+    _phase("fit-pipelined: compiling + warm epoch")
+    one_epoch()
+    _phase("fit-pipelined: warm")
+    stats.reset()
+    t0 = time.perf_counter()
+    epochs = sz["stream_epochs"]
+    for i in range(epochs):
+        one_epoch()
+        _phase(f"fit-pipelined: epoch {i + 1}/{epochs} done")
+    dt = time.perf_counter() - t0
+    stats.finish(dt)
+    return epochs * n_rows / dt, stats
 
 
 def _bench_encode_resident(jax, params, config, sz):
@@ -598,11 +682,21 @@ def child_main():
                     big_aps * big_flops / (spec[0] * 1e12), 4)
         except Exception as e:
             extra["train_big_error"] = repr(e)[-300:]
+    fit_wl = None
     try:
+        fit_wl = _fit_workload(jax, sz)
         extra["fit_stream_articles_per_sec"] = round(
-            _bench_train_stream(jax, sz), 1)
+            _bench_train_stream(jax, sz, workload=fit_wl), 1)
     except Exception as e:
         extra["fit_stream_error"] = repr(e)[-300:]
+    try:
+        pipe_aps, pipe_stats = _bench_fit_pipelined(jax, sz, workload=fit_wl)
+        extra["fit_pipelined_articles_per_sec"] = round(pipe_aps, 1)
+        extra["feed_stall_fraction"] = round(
+            pipe_stats.feed_stall_fraction, 4)
+        extra["fit_pipelined_feed"] = pipe_stats.summary()
+    except Exception as e:
+        extra["fit_pipelined_error"] = repr(e)[-300:]
     try:
         extra["fit_resident_articles_per_sec"] = round(
             _bench_fit_resident(jax, sz), 1)

@@ -65,7 +65,7 @@ class DenoisingAutoencoder:
                  use_tensorboard=True, n_components=None, profile=False,
                  prefetch_depth=2, keep_checkpoint_max=0, sparse_feed=True,
                  weight_update_sharding=False, resident_feed="auto",
-                 resident_budget_bytes=2 << 30):
+                 resident_budget_bytes=2 << 30, feed=None):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
 
         :param n_components: explicit code size; overrides the compress_factor
@@ -137,6 +137,16 @@ class DenoisingAutoencoder:
         # (tests/test_resident.py).
         self.resident_feed = resident_feed
         self.resident_budget_bytes = resident_budget_bytes
+        # explicit feed mode: "stream" | "pipelined" | "resident" | "auto".
+        # None defers to the legacy resident_feed knob (True -> "resident",
+        # "auto" -> "auto", False -> "stream"). "auto" picks resident when the
+        # corpus fits the HBM budget on TPU, else the pipelined feed
+        # (train/pipeline.py), else streaming. An explicit mode that the fit
+        # shape can't support (e.g. "resident" for a multi-process fit) falls
+        # back to "stream" rather than erroring — _last_fit_feed records what
+        # actually ran.
+        assert feed in (None, "auto", "stream", "pipelined", "resident"), feed
+        self.feed = feed
 
         assert isinstance(self.verbose_step, int)
         assert self.verbose >= 0
@@ -441,14 +451,40 @@ class DenoisingAutoencoder:
         ran_validation = False
         self._last_epoch = self._epoch0
 
-        resident_mode = self._resident_active(train_set)
-        self._last_fit_resident = resident_mode  # introspection for tests/tools
+        feed_mode = self._select_feed(train_set, labels, labels2)
+        # introspection for tests/tools
+        self._last_fit_feed = feed_mode
+        resident_mode = feed_mode == "resident"
+        self._last_fit_resident = resident_mode
         if resident_mode:
             from ..train import resident as resident_mod
 
             resident_data = resident_mod.build_resident(train_set, labels,
                                                         labels2)
-            epoch_fn = resident_mod.make_epoch_fn(self.config, self.optimizer)
+            epoch_fn = resident_mod.make_epoch_fn(self.config, self.optimizer,
+                                                  loss_fn=self._loss_fn)
+        pipelined_mode = feed_mode == "pipelined"
+        if pipelined_mode:
+            from ..train.pipeline import FeedStats, PipelinedFeed
+
+            feed_stats = FeedStats()
+            self.feed_stats_epochs = []
+            if self.mesh is not None:
+                from ..parallel.feed import put_sharded_batch
+
+                # staged batches land row-sharded over the data axis; the
+                # mesh step keeps its own donation policy (params only)
+                place = (lambda hb: put_sharded_batch(
+                    hb, self.mesh, model_axis=self._model_axis))
+                pipe_step = self._train_step
+            else:
+                # single device: default device_put staging, and a step that
+                # also donates the (device-resident, consumer-owned) batch so
+                # each consumed batch's HBM is recycled, not churned
+                place = None
+                pipe_step = make_train_step(self.config, self.optimizer,
+                                            loss_fn=self._loss_fn,
+                                            donate_batch=True)
 
         for e in range(self.num_epochs):
             epoch = self._epoch0 + e + 1
@@ -470,6 +506,29 @@ class DenoisingAutoencoder:
                 host_metrics = [{k: v[i] for k, v in host.items()}
                                 for i in range(perm.shape[0])]
                 self.train_time = time.time() - t0
+            elif pipelined_mode:
+                # overlapped feed (train/pipeline.py): a background worker
+                # device_puts staged batches up to depth ahead; the step
+                # consumes device-resident refs (and donates them on the
+                # single-device path). Same batcher, same PRNG chain as
+                # streaming — parity is tested, overlap is measured.
+                feed_stats.reset()
+                device_metrics = []
+                feed = PipelinedFeed(
+                    batcher.epoch(train_set, labels, labels2),
+                    depth=max(2, self.prefetch_depth), place=place,
+                    extremes=extremes, buckets=(b,), stats=feed_stats)
+                for batch in feed:
+                    self._key, sub = jax.random.split(self._key)
+                    self.params, self.opt_state, metrics = pipe_step(
+                        self.params, self.opt_state, sub, batch)
+                    device_metrics.append(metrics)
+
+                host_metrics = jax.device_get(device_metrics)
+                self.train_time = time.time() - t0
+                feed_stats.finish(self.train_time)
+                self.feed_stats_epochs.append(feed_stats.summary())
+                train_writer.feed_stats(feed_stats, epoch)
             else:
                 # accumulate device arrays only — converting per step would force a
                 # host-device sync each batch and stall the async dispatch pipeline
@@ -521,30 +580,100 @@ class DenoisingAutoencoder:
                                  validation_set_label, val_writer)
             self._log_param_histograms(train_writer, self._last_epoch * n_batches)
 
-    def _resident_active(self, train_set):
-        """Whether this fit runs resident-epoch execution (train/resident.py).
+    def _feed_mode(self):
+        """The requested feed mode: the explicit `feed` param, else derived
+        from the legacy resident_feed knob (True -> "resident", "auto" ->
+        "auto", anything else -> "stream")."""
+        if self.feed is not None:
+            return self.feed
+        if self.resident_feed is True:
+            return "resident"
+        if self.resident_feed == "auto":
+            return "auto"
+        return "stream"
 
-        Only the single-process, single-input paths qualify: the triplet
-        subclass feeds {org,pos,neg} dicts and multi-process fits shard the
-        feed per host (parallel/feed.py). `resident_feed="auto"` turns it on
-        when dispatch latency dominates — i.e. on TPU backends — and the feed
-        fits the budget; CPU keeps the streaming path so existing records stay
-        byte-stable (the two paths agree to float tolerance, not bitwise:
-        different XLA programs may fuse differently)."""
+    def _resident_eligible(self, train_set):
+        """Whether this fit's SHAPE can run resident-epoch execution at all
+        (train/resident.py), independent of the resident_feed policy knob.
+
+        Only single-process, single-device, single-input, default-objective
+        fits qualify:
+          - the triplet subclass feeds {org,pos,neg} dicts and multi-process
+            fits shard the feed per host (parallel/feed.py);
+          - a mesh (or n_devices>1) fit must keep the mesh-sharded step — the
+            resident scan is single-device and would silently train on one
+            chip while the rest idle (ADVICE r05);
+          - a subclass overriding `_loss_fn` (the MoE mixture) must not train
+            the default objective: the resident scan's gather layout assumes
+            the base [F,D] params, and make_epoch_fn must receive the real
+            loss_fn — gating here keeps both invariants (ADVICE r05)."""
         if self._multiprocess or isinstance(train_set, dict):
+            return False
+        if self.mesh is not None or self.n_devices != 1:
             return False
         if self._batcher_cls is not PaddedBatcher:
             return False
+        if self._loss_fn is not loss_and_metrics:
+            return False
         if sp.issparse(train_set) and not self.sparse_feed:
             return False  # dense feed of sparse data: stream it
-        if self.resident_feed is True:
+        return True
+
+    def _resident_active(self, train_set, labels=None, labels2=None):
+        """Whether this fit runs resident-epoch execution (train/resident.py).
+
+        Eligibility (shape) gates first; then resident_feed=True (or
+        feed="resident") forces it, and "auto" turns it on when dispatch
+        latency dominates — i.e. on TPU backends — and the feed (including
+        labels) fits the budget. CPU auto keeps the streaming path so existing
+        records stay byte-stable (the two paths agree to float tolerance, not
+        bitwise: different XLA programs may fuse differently)."""
+        if not self._resident_eligible(train_set):
+            return False
+        if self.resident_feed is True or self.feed == "resident":
             return True
-        if not self.resident_feed or self.resident_feed != "auto":
+        if self._feed_mode() != "auto":
             return False
         from ..train.resident import resident_bytes
 
         return (jax.default_backend() == "tpu"
-                and resident_bytes(train_set) <= self.resident_budget_bytes)
+                and resident_bytes(train_set, labels, labels2)
+                <= self.resident_budget_bytes)
+
+    def _pipeline_eligible(self, train_set):
+        """Whether this fit can run the overlapped feed (train/pipeline.py).
+
+        Multi-process fits keep their own feed stitching; a mesh fit
+        qualifies only when it has a data axis to row-shard staged batches
+        over (the MoE expert-only mesh replicates batches inside its own
+        step and gains nothing from pre-placement)."""
+        if self._multiprocess:
+            return False
+        if self.mesh is not None and "data" not in self.mesh.shape:
+            return False
+        return True
+
+    def _select_feed(self, train_set, labels=None, labels2=None):
+        """Resolve the feed mode that actually runs this fit.
+
+        Explicit modes fall back to "stream" when the fit shape can't support
+        them (never error — _last_fit_feed records the outcome). "auto"
+        prefers resident (fastest when the corpus fits HBM), then the
+        pipelined feed on TPU (overlap beats synchronous feed whenever the
+        link is the bottleneck), else streaming; CPU auto stays streaming so
+        existing CPU evidence is byte-stable."""
+        mode = self._feed_mode()
+        if mode == "resident":
+            return "resident" if self._resident_eligible(train_set) else "stream"
+        if mode == "pipelined":
+            return "pipelined" if self._pipeline_eligible(train_set) else "stream"
+        if mode == "auto":
+            if self._resident_active(train_set, labels, labels2):
+                return "resident"
+            if (jax.default_backend() == "tpu"
+                    and self._pipeline_eligible(train_set)):
+                return "pipelined"
+        return "stream"
 
     def _feed_batcher(self, data):
         """The batcher class for `data`: the sparse-ingest feed for scipy-sparse

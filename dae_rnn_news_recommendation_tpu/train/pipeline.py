@@ -1,0 +1,258 @@
+"""Overlapped sparse-feed pipeline: double-buffered async H2D prefetch.
+
+Why this exists: the streaming fit path hands HOST numpy batches straight to
+jit, so every step pays its host->device transfer synchronously inside the
+dispatch — over a thin link (the axon TPU tunnel: ~15-60 MB/s effective,
+bench.py `h2d_bandwidth_mbps`) the chip idles while bytes trickle in, which is
+exactly the measured stream-vs-resident gap (BENCH_r05: 30.9k vs 65.4k
+articles/sec). The resident path (train/resident.py) closes that gap only when
+the whole corpus fits the HBM budget; a production news corpus (millions of
+articles) does not.
+
+This module is the middle way: batches stay sparse on the wire (padded CSR
+(indices, values) pairs, ~nnz*6 bytes/row instead of dense F*4 — the
+data/batcher.SparseIngestBatcher layout), a background worker issues
+`jax.device_put` up to `depth` batches AHEAD of consumption (double/triple
+buffering — transfer of batch i+1..i+depth overlaps compute of batch i), and
+the consumer hands device-RESIDENT refs to a jitted step that densifies on
+device (ops/sparse_ingest.densify_on_device via train/step.materialize_x) and
+donates its input buffers (`make_train_step(donate_batch=True)`) so each
+consumed batch's HBM is recycled into the next allocation instead of churning.
+
+The pipeline never touches a batch after yielding it — the consumer is the
+sole owner, which is what makes input donation safe (tests/test_pipeline.py
+asserts the donated buffers are deleted and the host copies untouched).
+
+Shape bucketing: XLA compiles one program per input shape, so a ragged tail
+batch (or any iterator that emits varying leading dims) would recompile the
+step mid-epoch. `bucket_pad` pads each batch's leading dim up to a fixed
+bucket set (`bucket_sizes`), bounding compilations at len(buckets) per epoch;
+padded rows carry row_valid=0 / labels=-1, exactly the PaddedBatcher contract,
+so the math is unchanged.
+
+Instrumentation: `FeedStats` splits each epoch's wall time into feed-wait
+(consumer blocked on the queue — the chip would be idle) vs step-compute, and
+exposes `feed_stall_fraction` = feed_wait / epoch. The estimator logs it per
+epoch (utils/metrics.MetricsWriter.feed_stats) and bench.py reports it next to
+`fit_pipelined_articles_per_sec`, so the stream->resident gap is a measured,
+regression-tracked number instead of folklore.
+
+No reference counterpart: the reference's only feed is the synchronous
+in-process feed_dict copy (SURVEY §5.8). Pipelined input prefetch as a
+first-class runtime concern follows the TensorFlow system paper (arXiv
+1605.08695 §4.2); shipping sparse payloads and densifying device-side follows
+"Densifying Assumed-sparse Tensors" (arXiv 1905.04035).
+"""
+
+import queue
+import threading
+import time
+
+import jax
+import numpy as np
+
+# Keys whose padding rows must be flagged invalid rather than zero-filled
+# (PaddedBatcher contract: padded labels never share a class with real rows).
+_PAD_MINUS_ONE = ("labels", "labels2")
+
+
+class FeedStats:
+    """Per-epoch feed-wait vs step-compute split for a pipelined feed.
+
+    feed_wait_s counts the time the CONSUMER spent blocked waiting for the
+    next device-resident batch — i.e. time the device had nothing new to
+    chew on because the feed fell behind. step_time_s is the rest of the
+    epoch (dispatch + the epoch-end sync that drains the device queue).
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.feed_wait_s = 0.0
+        self.epoch_s = 0.0
+        self.batches = 0
+        self.bytes_in = 0
+
+    def note_wait(self, dt):
+        self.feed_wait_s += dt
+        self.batches += 1
+
+    def note_bytes(self, n):
+        self.bytes_in += int(n)
+
+    def finish(self, epoch_s):
+        """Record the epoch's total wall time (measured by the caller, who
+        also owns the epoch-end device sync)."""
+        self.epoch_s = float(epoch_s)
+
+    @property
+    def step_time_s(self):
+        return max(self.epoch_s - self.feed_wait_s, 0.0)
+
+    @property
+    def feed_stall_fraction(self):
+        """Fraction of the epoch the consumer sat waiting on the feed.
+        ~0 means compute-bound (the pipeline kept the device fed); ~1 means
+        the feed is the bottleneck and a deeper buffer / fatter link / the
+        resident path is the next lever."""
+        return self.feed_wait_s / self.epoch_s if self.epoch_s > 0 else 0.0
+
+    def summary(self):
+        return {
+            "feed_wait_s": round(self.feed_wait_s, 4),
+            "step_time_s": round(self.step_time_s, 4),
+            "feed_stall_fraction": round(self.feed_stall_fraction, 4),
+            "feed_batches": self.batches,
+            "feed_bytes": self.bytes_in,
+        }
+
+
+def bucket_sizes(batch_size, n_buckets=3, floor=32):
+    """The fixed set of leading-dim shapes a pipelined epoch may compile.
+
+    Halving buckets from `batch_size` down to `floor`: a ragged tail of any
+    size pads up by at most 2x instead of compiling its own program. Returns
+    an ascending tuple; len(buckets) bounds per-epoch compilations.
+    """
+    assert int(batch_size) >= 1
+    sizes = {int(batch_size)}
+    s = int(batch_size)
+    while len(sizes) < n_buckets and s // 2 >= floor:
+        s //= 2
+        sizes.add(s)
+    return tuple(sorted(sizes))
+
+
+def bucket_pad(batch, buckets):
+    """Pad every leading-B array in `batch` up to the smallest bucket >= B.
+
+    Padded rows follow the PaddedBatcher contract: row_valid 0 (synthesized if
+    the batch lacks it), labels -1, everything else zeros — so the padded rows
+    are mathematically inert in the step. Batches already at a bucket size (or
+    larger than every bucket) pass through untouched.
+    """
+    if not buckets:
+        return batch
+    b = _leading_dim(batch)
+    if b is None:
+        return batch
+    target = min((s for s in buckets if s >= b), default=None)
+    if target is None or target == b:
+        return batch
+    out = {}
+    for k, v in batch.items():
+        arr = np.asarray(v)
+        if arr.ndim >= 1 and arr.shape[0] == b:
+            fill = -1 if k in _PAD_MINUS_ONE else 0
+            pad = np.full((target - b,) + arr.shape[1:], fill, arr.dtype)
+            out[k] = np.concatenate([arr, pad])
+        else:
+            out[k] = v
+    if "row_valid" not in out:
+        rv = np.zeros(target, np.float32)
+        rv[:b] = 1.0
+        out["row_valid"] = rv
+    return out
+
+
+def _leading_dim(batch):
+    """The batch's row count: row_valid's length when present, else the most
+    common leading dim among the non-scalar entries."""
+    rv = batch.get("row_valid")
+    if rv is not None:
+        return len(rv)
+    dims = [np.asarray(v).shape[0] for v in batch.values()
+            if getattr(np.asarray(v), "ndim", 0) >= 1]
+    return max(dims) if dims else None
+
+
+class PipelinedFeed:
+    """Iterate device-resident batches, transfers running `depth` ahead.
+
+    :param batches: iterator of host batch dicts (e.g. `batcher.epoch(...)`)
+    :param depth: how many batches may be staged on device ahead of the
+        consumer (2 = double buffering, 3 = triple). The worker blocks once
+        `depth` transfers are in flight, bounding device memory at
+        ~depth * batch_bytes beyond the consumer's working set.
+    :param place: host batch -> device batch. Defaults to `jax.device_put`
+        (single device); the mesh path passes `parallel.feed.put_sharded_batch`
+        so each staged batch lands row-sharded over the data axis.
+    :param extremes: scalar entries (corr_min/corr_max) merged into every
+        batch BEFORE placement — they ride the same transfer and may be
+        donated with the rest of the batch.
+    :param buckets: optional `bucket_sizes(...)` tuple; ragged batches pad up
+        to the nearest bucket (see `bucket_pad`).
+    :param stats: optional FeedStats; consumer wait time and staged bytes are
+        recorded there.
+
+    Yielded batches are owned by the consumer alone: the pipeline drops its
+    reference at hand-off, so passing them to a step with donated inputs
+    (`make_train_step(donate_batch=True)`) is safe.
+    """
+
+    def __init__(self, batches, depth=2, place=None, extremes=None,
+                 buckets=None, stats=None):
+        self._batches = batches
+        self.depth = max(1, int(depth))
+        self._place = place or jax.device_put
+        self._extremes = dict(extremes) if extremes else None
+        self._buckets = tuple(buckets) if buckets else None
+        self.stats = stats
+
+    def _stage(self, host_batch):
+        """Host batch -> staged device batch (runs on the worker thread)."""
+        if self._extremes:
+            host_batch = {**host_batch, **self._extremes}
+        if self._buckets:
+            host_batch = bucket_pad(host_batch, self._buckets)
+        if self.stats is not None:
+            self.stats.note_bytes(sum(
+                np.asarray(v).nbytes for v in host_batch.values()))
+        # device_put dispatches the H2D copy asynchronously; by the time the
+        # consumer's step consumes this batch, the bytes are already (or still
+        # becoming) resident — that overlap is the whole point
+        return self._place(host_batch)
+
+    def __iter__(self):
+        q = queue.Queue(maxsize=self.depth)
+        end = object()
+        err = []
+        stop = threading.Event()
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for hb in self._batches:
+                    if not put(self._stage(hb)):
+                        return
+            except BaseException as e:  # surfaced on the consumer thread
+                err.append(e)
+            finally:
+                put(end)
+
+        threading.Thread(target=worker, daemon=True,
+                         name="pipelined-feed").start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                if self.stats is not None and item is not end:
+                    self.stats.note_wait(time.perf_counter() - t0)
+                if item is end:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+                del item  # the consumer owns it now; keep donation safe
+        finally:
+            # early consumer exit: release a worker blocked on the full queue
+            stop.set()

@@ -34,14 +34,27 @@ from .step import loss_and_metrics
 _DENSE_BYTES_PER_VAL = 4
 
 
-def resident_bytes(train_set):
-    """Device-memory estimate for keeping `train_set` resident (feed layout)."""
+def resident_bytes(train_set, labels=None, labels2=None):
+    """Device-memory estimate for keeping `train_set` resident (feed layout).
+
+    Mirrors build_resident's ACTUAL device allocation, not the raw csr
+    geometry: pad_csr_rows rounds the pad width up to a multiple of 64
+    (k=5 -> kk=64) and switches to uint32 indices when the feature count
+    outgrows uint16 — an estimate using the raw k and fixed 2-byte indices
+    underestimates ~13x at low density, and resident_feed="auto" would admit
+    a feed that OOMs the chip. Labels upload as int32 per row."""
+    label_bytes = sum(4 * train_set.shape[0]
+                      for lab in (labels, labels2) if lab is not None)
     if sp.issparse(train_set):
-        n = train_set.shape[0]
+        n, f = train_set.shape
         k = int(np.diff(train_set.tocsr().indptr).max(initial=1))
-        return n * k * (2 + 4)  # uint16 indices + f32 values
+        # same layout rules as ops/sparse_ingest.pad_csr_rows (k_multiple=64,
+        # non-binary pad index 0 so the u16->u32 flip happens past f=65536)
+        kk = max(64, int(np.ceil(k / 64) * 64))
+        idx_bytes = 2 if f <= np.iinfo(np.uint16).max + 1 else 4
+        return n * kk * (idx_bytes + 4) + label_bytes
     n, f = train_set.shape
-    return n * f * _DENSE_BYTES_PER_VAL
+    return n * f * _DENSE_BYTES_PER_VAL + label_bytes
 
 
 def build_resident(train_set, labels=None, labels2=None, device_put=None):
@@ -86,7 +99,7 @@ def stack_epoch_indices(batcher, n_rows):
     return np.stack(perms), np.stack(valids)
 
 
-def make_epoch_fn(config, optimizer):
+def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics):
     """Build the jitted whole-epoch function.
 
     epoch_fn(params, opt_state, key, resident, perm, row_valid, extremes)
@@ -95,6 +108,12 @@ def make_epoch_fn(config, optimizer):
     `perm`/`row_valid` are [S, B]; `metrics_stacked` maps each metric name to a
     [S] array (one entry per step, same order as the streaming loop's per-batch
     metrics). params/opt_state are donated: XLA updates them in place in HBM.
+
+    `loss_fn` is the estimator's `_loss_fn` hook — a subclass overriding the
+    objective (e.g. the MoE mixture) must NOT silently train the default one
+    here; the estimator additionally gates resident execution on the default
+    objective (`_resident_eligible`) because subclass params may not match
+    this scan's gather layout.
     """
 
     def gather_batch(resident, idx, rv, extremes):
@@ -122,7 +141,7 @@ def make_epoch_fn(config, optimizer):
             batch = gather_batch(resident, idx, rv, extremes)
             key, sub = jax.random.split(key)
             (_cost, metrics), grads = jax.value_and_grad(
-                loss_and_metrics, has_aux=True)(params, batch, sub, config)
+                loss_fn, has_aux=True)(params, batch, sub, config)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
             return (params, opt_state, key), metrics

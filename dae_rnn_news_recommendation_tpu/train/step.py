@@ -167,9 +167,17 @@ def triplet_loss_and_metrics(params, batch, key, config):
     }
 
 
-def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True):
+def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True,
+                    donate_batch=False):
     """Build the jitted train step. `config` is static; params/opt_state are donated
-    so XLA updates them in place in HBM."""
+    so XLA updates them in place in HBM.
+
+    `donate_batch=True` additionally donates the batch dict — for feeds that
+    hand the step DEVICE-RESIDENT buffers they will never touch again (the
+    pipelined feed, train/pipeline.py): XLA recycles each consumed batch's
+    HBM into the next allocation instead of churning fresh buffers per step.
+    The streaming path must keep it False (it hands jit host arrays, and the
+    prefetch queue may still hold references)."""
 
     def step(params, opt_state, key, batch):
         (cost, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -179,7 +187,18 @@ def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True):
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, metrics
 
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    donate_argnums = (0, 1) if donate else ()
+    if donate_batch:
+        donate_argnums = donate_argnums + (3,)
+        # Donating the batch frees its buffers either way, but XLA may not be
+        # able to RECYCLE every one into an output (e.g. CPU layouts, or the
+        # uint16 indices with no same-shaped output); that best-effort case
+        # warns once per compile and would pollute every pipelined fit.
+        import warnings
+
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+    return jax.jit(step, donate_argnums=donate_argnums)
 
 
 def make_eval_step(config, loss_fn=loss_and_metrics):

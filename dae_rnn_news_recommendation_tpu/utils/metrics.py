@@ -39,6 +39,17 @@ class MetricsWriter:
         for tag, value in mapping.items():
             self.scalar(tag, value, step)
 
+    def feed_stats(self, stats, step):
+        """Per-epoch feed/compute split from a pipelined fit
+        (train/pipeline.FeedStats): feed_wait_s, step_time_s and
+        feed_stall_fraction land in both sinks under feed/ so the
+        stream->resident gap is a tracked trajectory, not a one-off print."""
+        self.scalars({
+            "feed/feed_wait_s": stats.feed_wait_s,
+            "feed/step_time_s": stats.step_time_s,
+            "feed/feed_stall_fraction": stats.feed_stall_fraction,
+        }, step)
+
     def histogram(self, tag, values, step):
         """Summary-stats histogram (the reference logs full TB histograms; JSONL keeps
         min/max/mean/std/percentiles, TB sink keeps the full histogram)."""

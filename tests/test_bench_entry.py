@@ -48,11 +48,46 @@ def test_bench_functions_produce_finite_rates(bench):
     r_enc = bench._bench_encode(jax, params, config, TINY, feeds=feeds)
     r_dense = bench._bench_encode(jax, params, config, TINY, via_dense=True,
                                   feeds=feeds)
+    r_scan = bench._bench_encode(jax, params, config, TINY, feeds=feeds,
+                                 scan_group=2)
     r_train = bench._bench_train(jax, TINY)
     r_big = bench._bench_train(jax, TINY, batch_override=48, steps_override=2)
-    r_stream = bench._bench_train_stream(jax, TINY)
-    for r in (r_enc, r_dense, r_train, r_big, r_stream):
+    wl = bench._fit_workload(jax, TINY)
+    r_stream = bench._bench_train_stream(jax, TINY, workload=wl)
+    r_pipe, pipe_stats = bench._bench_fit_pipelined(jax, TINY, workload=wl)
+    for r in (r_enc, r_dense, r_scan, r_train, r_big, r_stream, r_pipe):
         assert np.isfinite(r) and r > 0.0
+    # the diagnostic the pipelined figure ships with must be populated
+    assert 0.0 <= pipe_stats.feed_stall_fraction <= 1.0
+    assert pipe_stats.batches > 0 and pipe_stats.epoch_s > 0
+
+
+def test_stack_groups_drops_ragged_tail(bench):
+    """The scanned-dispatch grouping must emit uniformly-shaped stacks only —
+    a ragged tail group would recompile inside the timed section (ADVICE r05)."""
+    feeds = [np.full((4, 8), i, np.uint16) for i in range(7)]
+    grouped = bench._stack_groups(feeds, 3)
+    assert len(grouped) == 2  # 7 // 3 — the 1-batch tail is dropped
+    assert all(g.shape == (3, 4, 8) for g in grouped)
+    np.testing.assert_array_equal(grouped[1][0], feeds[3])
+    # exact divisibility keeps everything
+    assert len(bench._stack_groups(feeds[:6], 3)) == 2
+
+
+def test_bench_encode_scan_rejects_ragged_n_batches(bench):
+    """A scan_group that does not divide n_batches must fail fast at the
+    assert, not silently recompile mid-measurement."""
+    from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+
+    config = DAEConfig(
+        n_features=bench.F, n_components=bench.D, enc_act_func="sigmoid",
+        dec_act_func="sigmoid", loss_func="cross_entropy", corr_type="none",
+        corr_frac=0.0, triplet_strategy="none", compute_dtype="bfloat16")
+    params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
+    sz = dict(TINY, n_batches=3)
+    with pytest.raises(AssertionError, match="must divide n_batches"):
+        bench._bench_encode(jax, params, config, sz, feeds=([], []),
+                            scan_group=2)
 
 
 def test_bench_size_tables_consistent(bench):

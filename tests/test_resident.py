@@ -123,6 +123,88 @@ def test_resident_bytes_estimate():
     assert resident_bytes(sparse) > 0
 
 
+def test_resident_bytes_mirrors_pad_csr_rows_layout():
+    """The auto-budget estimate must match what build_resident ACTUALLY
+    allocates: pad_csr_rows rounds k up to a multiple of 64 and flips to
+    uint32 indices past the uint16 feature range — the raw-csr estimate
+    underestimated ~13x at low density and could admit a feed that OOMs the
+    chip (ADVICE r05)."""
+    # k=3 max nnz/row -> padded kk=64; f=100 -> uint16 (2B) indices + f32 values
+    rows = np.zeros((10, 100), np.float32)
+    rows[:, :3] = 1.0
+    small = sp.csr_matrix(rows)
+    assert resident_bytes(small) == 10 * 64 * (2 + 4)
+    # labels ride along as int32, one per row (labels2 doubles it)
+    labels = np.zeros(10, np.int32)
+    assert resident_bytes(small, labels) == 10 * 64 * (2 + 4) + 10 * 4
+    assert resident_bytes(small, labels, labels) == 10 * 64 * (2 + 4) + 2 * 10 * 4
+    # feature count past the uint16 range -> 4-byte indices
+    big = sp.csr_matrix((np.ones(3, np.float32), np.array([0, 70000, 70001]),
+                         np.array([0, 3])), shape=(1, 70002))
+    assert resident_bytes(big) == 1 * 64 * (4 + 4)
+    # the estimate must match build_resident's real allocation exactly
+    res = build_resident(small)
+    actual = sum(np.asarray(v).nbytes for v in res.values())
+    assert resident_bytes(small) == actual
+
+
+def test_resident_never_active_on_multi_device(workdir):
+    """A mesh (or n_devices>1) fit must keep the mesh-sharded step: the
+    resident scan is single-device and would silently train on one chip while
+    the rest idle (ADVICE r05)."""
+    rng = np.random.default_rng(0)
+    x, _labels = _data(rng)
+    model = DenoisingAutoencoder(
+        model_name="md", main_dir="md", n_components=6, num_epochs=1,
+        batch_size=10, seed=1, verbose=False, use_tensorboard=False,
+        resident_feed=True, results_root=str(workdir / "results"))
+    assert model._resident_active(x) is True  # single-device: forced on
+    model.n_devices = 2
+    assert model._resident_active(x) is False
+    model.n_devices = 1
+    model.mesh = object()  # any mesh sentinel disqualifies
+    assert model._resident_active(x) is False
+
+
+def test_resident_fit_multi_device_keeps_mesh_step(workdir):
+    """End to end: an 8-virtual-device fit with resident_feed=True must run
+    the mesh-sharded path, not the single-device scan."""
+    rng = np.random.default_rng(0)
+    x, labels = _data(rng, n=40)
+    model = DenoisingAutoencoder(
+        model_name="md8", main_dir="md8", n_components=6, num_epochs=1,
+        batch_size=8, seed=1, verbose=False, use_tensorboard=False,
+        resident_feed=True, n_devices=8,
+        results_root=str(workdir / "results"))
+    model.fit(x, train_set_label=labels)
+    assert model._last_fit_resident is False
+    assert model._last_fit_feed == "stream"
+
+
+def test_moe_never_enters_resident_path(workdir):
+    """The MoE estimator overrides _loss_fn with the mixture objective and
+    [E,F,D] params; a resident scan would train the WRONG objective on an
+    incompatible gather layout — forced resident must fall back to streaming
+    (ADVICE r05)."""
+    from dae_rnn_news_recommendation_tpu.models import MoEDenoisingAutoencoder
+
+    rng = np.random.default_rng(0)
+    x = (rng.uniform(size=(48, 32)) < 0.2).astype(np.float32)
+    labels = rng.integers(0, 4, 48).astype(np.int32)
+    model = MoEDenoisingAutoencoder(
+        n_experts=4, model_name="moe_res", main_dir="moe_res", n_components=6,
+        num_epochs=1, batch_size=16, seed=1, triplet_strategy="none",
+        corr_type="masking", corr_frac=0.3, verbose=False,
+        use_tensorboard=False, resident_feed=True,
+        results_root=str(workdir / "results"))
+    assert model._resident_active(x) is False
+    model.fit(x, train_set_label=labels)
+    assert model._last_fit_resident is False
+    # the mixture params survived the fit (a resident scan would have crashed
+    # or silently trained the base objective)
+    assert np.asarray(model.params["W"]).ndim == 3
+
+
 def test_resident_auto_is_off_on_cpu(workdir):
     """`auto` must not flip CPU fits onto the scan path (keeps existing CPU
     evidence byte-stable); explicit True forces it anywhere."""
