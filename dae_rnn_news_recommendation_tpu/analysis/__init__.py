@@ -1,0 +1,41 @@
+"""jaxcheck — static analysis for the silent JAX hazard classes this repo has
+actually been bitten by, plus a runtime compile-budget guard.
+
+Round 5's hardest lessons were all invisible in review: `block_until_ready`
+lying under the axon tunnel inflated bench claims 5x (fixed by fetch fences),
+a ragged `lax.scan` tail recompiled inside a timed section, and buffer
+donation (`train/step.make_train_step(donate_batch=True)`) opened the
+use-after-donate bug class. Each is a *graph-level* invariant a human can't
+reliably eyeball across a growing tree — the same observation that motivates
+graph-level checking in large training systems (TF system paper §4; XLA's own
+donation/aliasing verifier). jaxcheck encodes them as review-time rules:
+
+    R1  host-sync calls reachable inside jit-traced code
+    R2  timed regions in bench/evidence code without a fetch fence
+    R3  use-after-donate on donated arguments
+    R4  recompile hazards (per-iteration Python scalars, ragged stacking)
+    R5  PRNG key reuse without an intervening split
+
+CLI:    python -m dae_rnn_news_recommendation_tpu.analysis [paths] [--json]
+        (no paths: the package + bench.py + evidence/; exit 0 = clean)
+Runtime: `compile_guard(max_compiles=N)` — a context manager counting XLA
+        backend compiles via `jax.monitoring`, so tests can pin an upper
+        bound on jit variants (e.g. the pipelined feed's shape buckets).
+
+Suppressions are first-class but must carry a reason:
+
+    x = donated_batch["x"]  # jaxcheck: disable=R3 (copied out before the step)
+
+A reasonless disable is itself reported (rule SUP). Rule catalog with
+in-repo examples: docs/jaxcheck.md.
+"""
+
+from .core import (Finding, analyze_file, analyze_paths, default_targets,
+                   iter_python_files, RULES)
+from .runtime import CompileBudgetExceeded, CompileWatcher, compile_guard
+
+__all__ = [
+    "Finding", "analyze_file", "analyze_paths", "default_targets",
+    "iter_python_files", "RULES",
+    "CompileBudgetExceeded", "CompileWatcher", "compile_guard",
+]

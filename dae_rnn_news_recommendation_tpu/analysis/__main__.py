@@ -1,0 +1,58 @@
+"""CLI: python -m dae_rnn_news_recommendation_tpu.analysis [paths] [--json]
+
+No paths: analyzes the self-clean contract set (the package + bench.py +
+evidence/). Exit codes: 0 clean, 1 unsuppressed findings, 2 usage error.
+"""
+
+import argparse
+import json
+import sys
+
+from .core import RULES, analyze_paths, default_targets, repo_root
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m dae_rnn_news_recommendation_tpu.analysis",
+        description="jaxcheck: JAX tracing-hygiene, sync-fence and donation "
+        "static analysis (rules: %s)" % ", ".join(sorted(RULES)))
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to analyze (default: the "
+                        "package, bench.py, and evidence/)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit a JSON report (findings + suppressed with "
+                        "reasons) instead of text")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return 0 if e.code == 0 else 2
+
+    if args.paths:
+        root, targets = repo_root(), args.paths
+    else:
+        root, targets = default_targets()
+    findings, suppressed, n_files = analyze_paths(targets, root=root)
+    if n_files == 0:
+        print("jaxcheck: no Python files found under the given paths",
+              file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps({
+            "files_analyzed": n_files,
+            "findings": [f.to_json() for f in findings],
+            "suppressed": [f.to_json() for f in suppressed],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        tail = (f"jaxcheck: {len(findings)} finding(s) in {n_files} files"
+                if findings else
+                f"jaxcheck: clean ({n_files} files, "
+                f"{len(suppressed)} reasoned suppression(s))")
+        print(tail, file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

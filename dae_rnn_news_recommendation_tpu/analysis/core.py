@@ -1,0 +1,217 @@
+"""jaxcheck core: findings, suppression parsing, rule registry, file walking.
+
+Design notes
+------------
+* Pure `ast` — the analyzer never imports the code it checks, so it can walk
+  fixture files with planted violations (and broken code) safely, and runs in
+  milliseconds inside tier-1.
+* Rules are registered via the `@rule` decorator and receive a `FileContext`;
+  each returns a list of `Finding`s. A rule may declare a path `scope`
+  predicate (R2 only makes sense for bench/evidence timing code).
+* Suppressions: `# jaxcheck: disable=R3 (reason)` on the offending line, or
+  standalone on the line directly above it. The reason is MANDATORY — a
+  disable without one is reported as rule `SUP` and cannot itself be
+  suppressed (otherwise `disable=SUP` would launder reasonless disables).
+"""
+
+import ast
+import dataclasses
+import os
+import re
+
+# rule id -> (title, checker, scope_predicate_or_None); populated by @rule
+RULES = {}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxcheck:\s*disable=([A-Za-z0-9_,\s]+?)\s*(?:\((.*)\))?\s*$")
+
+
+def rule(rule_id, title, scope=None):
+    """Register a checker. `scope(relpath) -> bool` limits which files the
+    rule sees (None = every file)."""
+
+    def register(fn):
+        RULES[rule_id] = (title, fn, scope)
+        return fn
+
+    return register
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def location(self):
+        return f"{self.path}:{self.line}"
+
+    def render(self):
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+    def to_json(self):
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int          # line the comment sits on
+    rules: tuple       # rule ids, or ("all",)
+    reason: str
+
+    def covers(self, finding_line, rule_id):
+        # a suppression comment governs its own line and the line below it
+        # (the standalone-comment-above style)
+        if finding_line not in (self.line, self.line + 1):
+            return False
+        return "all" in self.rules or rule_id in self.rules
+
+
+class FileContext:
+    """Everything a rule needs about one file: source, AST, repo-relative
+    path, and per-line suppressions."""
+
+    def __init__(self, path, relpath, source, tree):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.suppressions = parse_suppressions(self.lines)
+        self.current_rule = None  # set by analyze_file around each checker
+
+    def finding(self, node_or_line, message, rule_id=None):
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(rule_id or self.current_rule, self.relpath, line,
+                       message)
+
+
+def parse_suppressions(lines):
+    out = []
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = tuple(s.strip() for s in m.group(1).split(",") if s.strip())
+        reason = (m.group(2) or "").strip()
+        out.append(Suppression(line=i, rules=ids, reason=reason))
+    return out
+
+
+def _suppression_findings(ctx):
+    """Rule SUP: every disable must carry a non-empty parenthesized reason."""
+    out = []
+    for sup in ctx.suppressions:
+        if not sup.reason:
+            out.append(ctx.finding(
+                sup.line,
+                "jaxcheck suppression without a reason — write "
+                "`# jaxcheck: disable=<RULE> (why this is safe)`",
+                rule_id="SUP"))
+        unknown = [r for r in sup.rules if r != "all" and r not in RULES]
+        if unknown:
+            out.append(ctx.finding(
+                sup.line,
+                f"suppression names unknown rule(s): {', '.join(unknown)}",
+                rule_id="SUP"))
+    return out
+
+
+def analyze_file(path, root=None):
+    """Run every applicable rule on one file.
+
+    Returns (findings, suppressed) — `findings` are actionable (exit-code
+    relevant), `suppressed` carry their reasons for the JSON report.
+    """
+    relpath = os.path.relpath(path, root) if root else path
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("AST", relpath, e.lineno or 1,
+                        f"file does not parse: {e.msg}")], []
+    ctx = FileContext(path, relpath, source, tree)
+
+    raw = []
+    for rule_id, (_, checker, scope) in RULES.items():
+        if scope is not None and not scope(relpath):
+            continue
+        ctx.current_rule = rule_id
+        raw.extend(checker(ctx))
+    ctx.current_rule = None
+    # SUP findings are generated outside the registry so they can never be
+    # masked by a scope predicate or another suppression
+    sup_findings = _suppression_findings(ctx)
+
+    findings, suppressed = [], []
+    for f in sorted(raw, key=lambda f: (f.line, f.rule)):
+        sup = next((s for s in ctx.suppressions if s.covers(f.line, f.rule)),
+                   None)
+        if sup is not None and sup.reason:
+            f.suppressed = True
+            f.suppress_reason = sup.reason
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    findings.extend(sup_findings)
+    findings.sort(key=lambda f: (f.line, f.rule))
+    return findings, suppressed
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "results"}
+
+
+def iter_python_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS
+                                 and not d.startswith(".")
+                                 and d != "fixtures")
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def repo_root():
+    """The repo checkout containing this package (package dir's parent)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def default_targets():
+    """The self-clean contract's file set: the package, bench.py, and
+    evidence/ (tests and their planted-violation fixtures excluded)."""
+    root = repo_root()
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    targets = [pkg]
+    for extra in ("bench.py", "evidence"):
+        p = os.path.join(root, extra)
+        if os.path.exists(p):
+            targets.append(p)
+    return root, targets
+
+
+def analyze_paths(paths, root=None):
+    """Analyze every .py under `paths`. Returns (findings, suppressed,
+    n_files)."""
+    findings, suppressed = [], []
+    n = 0
+    for path in iter_python_files(paths):
+        n += 1
+        f, s = analyze_file(path, root=root)
+        findings.extend(f)
+        suppressed.extend(s)
+    return findings, suppressed, n
+
+
+# importing rules registers them (kept last: rules import helpers from here)
+from . import rules  # noqa: E402,F401

@@ -1,0 +1,803 @@
+"""jaxcheck rules R1-R5 — AST checkers for the JAX hazard classes this repo
+has been bitten by (see docs/jaxcheck.md for the catalog with in-repo
+examples of each).
+
+Every rule is heuristic by construction: Python is too dynamic for proof, so
+each checker aims for the precision sweet spot where true findings from this
+codebase's real bug history are caught (tests/fixtures/jaxcheck plants one of
+each) while the repo's legitimate patterns pass without noise. Anything a
+rule cannot see (a guard in a caller, a fence inside an imported helper) is
+handled with a reasoned `# jaxcheck: disable=...` at the site — the reason
+requirement keeps those honest.
+"""
+
+import ast
+
+# ---------------------------------------------------------------- helpers
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_SCAN_NAMES = {"lax.scan", "jax.lax.scan"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+# call prefixes whose results live on device (R1 dataflow seeds)
+_DEVICE_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "lax.", "jax.nn.",
+                    "jax.random.", "jax.scipy.", "jax.ops.")
+_HOST_MATERIALIZERS = {"np.asarray", "np.array", "numpy.asarray",
+                       "numpy.array", "onp.asarray", "onp.array"}
+_DEVICE_GET = {"jax.device_get", "device_get"}
+_TIMER_CALLS = {"time.time", "time.perf_counter"}
+_FENCE_ATTRS = {"block_until_ready"}
+_FENCE_NAMES = {"_hard_sync"} | _DEVICE_GET
+_STACK_NAMES = {"np.stack", "jnp.stack", "numpy.stack", "jax.numpy.stack"}
+_KEY_MAKERS = {"jax.random.PRNGKey", "random.PRNGKey", "jr.PRNGKey",
+               "jax.random.key", "jax.random.fold_in", "random.fold_in"}
+_KEY_SPLITS = {"jax.random.split", "random.split", "jr.split"}
+
+from .core import rule  # noqa: E402  (registry lives in core)
+
+
+def dotted(node):
+    """'jax.random.split' for Name/Attribute chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_name(node):
+    return dotted(node.func) if isinstance(node, ast.Call) else None
+
+
+def assign_target_names(stmt):
+    """Every dotted name (re)bound by this statement's targets."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.For):
+        targets = [stmt.target]
+    names = set()
+    for t in targets:
+        for node in ast.walk(t):
+            d = dotted(node)
+            if d:
+                names.add(d)
+    return names
+
+
+def names_in(node):
+    """All dotted names loaded anywhere under `node`."""
+    found = set()
+    for n in ast.walk(node):
+        d = dotted(n)
+        if d:
+            found.add(d)
+    return found
+
+
+def func_defs(tree):
+    """name -> list of FunctionDef nodes (module-wide, any nesting)."""
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def body_lists(root):
+    """Every statement list under `root` (function/loop/if/with/try bodies),
+    without descending into nested function defs."""
+    out = []
+
+    def visit(node):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, field, None)
+            if isinstance(stmts, list) and stmts and \
+                    isinstance(stmts[0], ast.stmt):
+                out.append(stmts)
+                for s in stmts:
+                    if not isinstance(s, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        visit(s)
+        handlers = getattr(node, "handlers", None)
+        if handlers:
+            for h in handlers:
+                visit(h)
+
+    visit(root)
+    return out
+
+
+# ------------------------------------------------------------- jit index
+
+def traced_roots(tree):
+    """Function/lambda nodes whose bodies run under trace: @jit-decorated,
+    passed to jax.jit(...), or carried by lax.scan. Plus the transitive
+    closure of same-module functions they call (host-sync is a bug anywhere
+    *reachable* from traced code)."""
+    defs = func_defs(tree)
+    direct, seen = [], set()
+
+    def add(node):
+        if node is not None and id(node) not in seen:
+            seen.add(id(node))
+            direct.append(node)
+
+    def resolve(arg):
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name) and arg.id in defs:
+            return defs[arg.id][0]
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dotted(dec) or call_name(dec)
+                if d in _JIT_NAMES:
+                    add(node)
+                elif isinstance(dec, ast.Call) and \
+                        dotted(dec.func) in _PARTIAL_NAMES and dec.args and \
+                        dotted(dec.args[0]) in _JIT_NAMES:
+                    add(node)
+        elif isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name in _JIT_NAMES and node.args:
+                add(resolve(node.args[0]))
+            elif name in _SCAN_NAMES and node.args:
+                add(resolve(node.args[0]))
+
+    # transitive closure over same-module calls (weak contexts: no param
+    # assumptions, just "this body may run under trace")
+    closure = []
+    work = list(direct)
+    while work:
+        fn = work.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                callee = node.func.id
+                for d in defs.get(callee, []):
+                    if id(d) not in seen:
+                        seen.add(id(d))
+                        closure.append(d)
+                        work.append(d)
+    return direct, closure
+
+
+# ------------------------------------------------------------------- R1
+
+@rule("R1", "host-sync call reachable inside jit-traced code")
+def check_r1(ctx):
+    direct, closure = traced_roots(ctx.tree)
+    out = []
+    for root in direct + closure:
+        out.extend(_r1_walk_root(ctx, root))
+    return out
+
+
+def _involves(node, device_vals):
+    return bool(names_in(node) & device_vals)
+
+
+def _is_device_call(node, device_vals):
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    if name and (name.startswith(_DEVICE_PREFIXES)):
+        return True
+    # method call on a device value (h.sum(), x.astype(...))
+    if isinstance(node.func, ast.Attribute) and \
+            _involves(node.func.value, device_vals):
+        return True
+    return False
+
+
+def _r1_walk_root(ctx, root):
+    findings = []
+    device_vals = set()
+
+    def value_is_device(value):
+        if _is_device_call(value, device_vals):
+            return True
+        if isinstance(value, (ast.BinOp, ast.UnaryOp, ast.Subscript,
+                              ast.IfExp, ast.Tuple, ast.List)):
+            return _involves(value, device_vals)
+        if isinstance(value, ast.Name) and value.id in device_vals:
+            return True
+        return False
+
+    def check_call(node):
+        name = call_name(node)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("item", "tolist") and not node.args:
+            findings.append(ctx.finding(
+                node, f".{node.func.attr}() forces a device->host sync; "
+                "inside traced code it breaks tracing (and under async "
+                "dispatch it stalls the pipeline) — return the array and "
+                "fetch on host"))
+        elif name in _HOST_MATERIALIZERS:
+            findings.append(ctx.finding(
+                node, f"{name}(...) materializes on host inside traced code "
+                "— use jnp equivalents so the value stays a tracer"))
+        elif name in _DEVICE_GET:
+            findings.append(ctx.finding(
+                node, "jax.device_get inside traced code is a host sync — "
+                "hoist it out of the jitted/scanned function"))
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _FENCE_ATTRS:
+            findings.append(ctx.finding(
+                node, ".block_until_ready() has no meaning under trace — "
+                "it is a host-side fence; remove it from traced code"))
+        elif name in ("float", "int", "bool", "complex") and node.args and \
+                _involves(node.args[0], device_vals):
+            findings.append(ctx.finding(
+                node, f"{name}() on a traced value concretizes it "
+                "(ConcretizationTypeError at trace time, or a silent host "
+                "sync) — keep it an array"))
+
+    def check_test(stmt, test):
+        if isinstance(test, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return  # `x is None` never calls __bool__ on a tracer
+        if _involves(test, device_vals):
+            findings.append(ctx.finding(
+                stmt, "branching on a traced value calls __bool__ on a "
+                "tracer (TracerBoolConversionError) — use lax.cond/jnp.where "
+                "or hoist the predicate to host"))
+
+    def visit(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node is not root:
+            return  # nested defs are separate closure entries
+        if isinstance(node, ast.Call):
+            check_call(node)
+        if isinstance(node, (ast.If, ast.While)):
+            check_test(node, node.test)
+        elif isinstance(node, ast.Assert):
+            check_test(node, node.test)
+        if isinstance(node, ast.Assign) and value_is_device(node.value):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    d = dotted(n)
+                    if d:
+                        device_vals.add(d)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    if isinstance(root, ast.Lambda):
+        visit(root.body)
+    else:
+        for stmt in root.body:
+            visit(stmt)
+    return findings
+
+
+# ------------------------------------------------------------------- R2
+
+def _r2_scope(relpath):
+    import os
+
+    base = os.path.basename(relpath)
+    parts = relpath.replace("\\", "/").split("/")
+    return base.startswith("bench") or "evidence" in parts
+
+
+@rule("R2", "timed region without a fetch fence", scope=_r2_scope)
+def check_r2(ctx):
+    """time.time()/perf_counter() deltas in bench/evidence code must have a
+    device fetch between start and read, or the timer measures dispatch, not
+    compute (the round-5 `block_until_ready`-lies lesson). Watchdog/deadline
+    arithmetic uses time.monotonic() in this repo and is exempt by that
+    convention."""
+    fence_fns = _fence_functions(ctx.tree)
+    out = []
+    roots = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))]
+    seen_lines = set()
+    for root in roots:
+        for stmts in body_lists(root):
+            out.extend(_r2_scan_body(ctx, stmts, fence_fns, seen_lines))
+    return out
+
+
+def _fence_functions(tree):
+    """Local function names whose bodies fence directly (one hop): calling
+    them inside a timed region counts as fencing it."""
+    fences = set()
+    for name, nodes in func_defs(tree).items():
+        for fn in nodes:
+            for node in ast.walk(fn):
+                if _is_fence_call(node):
+                    fences.add(name)
+    return fences
+
+
+def _is_fence_call(node, fence_fns=()):
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    if name in _FENCE_NAMES or name in fence_fns:
+        return True
+    if name and name.split(".")[-1] in ("device_get", "block_until_ready"):
+        return True
+    return isinstance(node.func, ast.Attribute) and \
+        node.func.attr in _FENCE_ATTRS
+
+
+def _timer_reads(stmt, timers):
+    """Timer names read as `time.X() - t0` anywhere in this statement."""
+    reads = set()
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) and \
+                call_name(node.left) in _TIMER_CALLS:
+            d = dotted(node.right)
+            if d in timers:
+                reads.add(d)
+    return reads
+
+
+def _r2_scan_body(ctx, stmts, fence_fns, seen_lines):
+    out = []
+    timers = {}  # name -> index of the start statement
+    for i, stmt in enumerate(stmts):
+        for name in _timer_reads(stmt, timers):
+            region = stmts[timers[name][0] + 1: i + 1]
+            fenced = any(_is_fence_call(n, fence_fns)
+                         for s in region for n in ast.walk(s))
+            if not fenced and stmt.lineno not in seen_lines:
+                seen_lines.add(stmt.lineno)
+                out.append(ctx.finding(
+                    stmt, f"timed region ({name} started at line "
+                    f"{timers[name][1]}) is read without a device fetch "
+                    "fence — under async dispatch the delta measures "
+                    "enqueue, not compute; end the region with "
+                    "_hard_sync/jax.device_get"))
+            del timers[name]
+        if isinstance(stmt, ast.Assign) and \
+                call_name(stmt.value) in _TIMER_CALLS:
+            for t in stmt.targets:
+                d = dotted(t)
+                if d:
+                    timers[d] = (i, stmt.lineno)
+    return out
+
+
+# ------------------------------------------------------------------- R3
+
+# factories in this repo that return jitted callables with donated argnums;
+# positions are of the *returned* callable's signature
+_DONATING_FACTORIES = {
+    "make_train_step": "train_step",   # (params, opt_state, key, batch)
+    "make_epoch_fn": "epoch",          # (params, opt_state, key, ...)
+    "make_parallel_train_step": "pstep",
+    "make_moe_train_step": "pstep",
+}
+
+
+def _kw(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _const(node, default=None):
+    return node.value if isinstance(node, ast.Constant) else default
+
+
+def _donated_positions(call):
+    """Donated argnums for the callable produced by `call`, else None."""
+    name = call_name(call)
+    if name is None:
+        return None
+    short = name.split(".")[-1]
+    if name in _JIT_NAMES:
+        argnums = _kw(call, "donate_argnums")
+        if isinstance(argnums, (ast.Tuple, ast.List)):
+            pos = tuple(_const(e) for e in argnums.elts)
+            if all(isinstance(p, int) for p in pos):
+                return pos
+        elif isinstance(argnums, ast.Constant) and \
+                isinstance(argnums.value, int):
+            return (argnums.value,)
+        return None
+    if short in _DONATING_FACTORIES:
+        if _const(_kw(call, "donate"), True) is False:
+            base = ()
+        else:
+            base = (0, 1)
+        if short == "make_train_step" and \
+                _const(_kw(call, "donate_batch"), False) is True:
+            base = base + (3,)
+        return base or None
+    return None
+
+
+def scope_walk(root):
+    """Walk `root` without crossing into nested function definitions, so a
+    name bound in one function never leaks into another scope's analysis."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _donating_callables(root):
+    """dotted-name -> donated positions, from assignments in THIS scope only
+    (covers `step = make_train_step(...)` and
+    `self._train_step = jax.jit(f, donate_argnums=...)`). Scoping matters:
+    two functions can both name their step `step` with different donation
+    settings."""
+    out = {}
+    for node in scope_walk(root):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _donated_positions(node.value)
+            if pos:
+                for t in node.targets:
+                    d = dotted(t)
+                    if d:
+                        out[d] = pos
+    return out
+
+
+@rule("R3", "use-after-donate")
+def check_r3(ctx):
+    module_donators = _donating_callables(ctx.tree)
+    out = []
+    for root in [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                              if isinstance(n, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))]:
+        if root is ctx.tree:
+            donators = module_donators
+        else:
+            donators = {**module_donators, **_donating_callables(root)}
+        if not donators:
+            continue
+        body = root.body if hasattr(root, "body") else []
+        if body and isinstance(body[0], ast.stmt):
+            out.extend(_r3_scan(ctx, body, donators, stale={}))
+    # findings can repeat when a body is reachable from module+function walk;
+    # dedupe on (line, message)
+    uniq = {}
+    for f in out:
+        uniq[(f.line, f.message)] = f
+    return list(uniq.values())
+
+
+def _donations_in(stmt, donators):
+    """(donated_name, call_line) pairs for donating calls in this statement,
+    excluding names immediately rebound by the statement's own targets."""
+    rebound = assign_target_names(stmt)
+    found = []
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in donators:
+                for pos in donators[name]:
+                    if pos < len(node.args):
+                        d = dotted(node.args[pos])
+                        if d and d not in rebound:
+                            found.append((d, node.lineno))
+    return found
+
+
+def _r3_scan(ctx, stmts, donators, stale):
+    """Linear scan of one body: donated-and-not-rebound names become stale;
+    a later load of a stale name is use-after-donate. Loop bodies: a name
+    donated inside the loop must be rebound inside it, or iteration 2 passes
+    a deleted buffer."""
+    out = []
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # separate scope (closures over donated refs are rare)
+        # loads happen before this statement's own donations take effect
+        if stale:
+            call_funcs = {dotted(n.func) for n in ast.walk(stmt)
+                          if isinstance(n, ast.Call)}
+            for name in sorted(names_in(stmt) & set(stale)):
+                if name in call_funcs:
+                    continue  # calling the step again is not reading a buffer
+                out.append(ctx.finding(
+                    stmt, f"`{name}` was donated at line {stale[name]} and "
+                    "read here — the buffer may already be deleted/aliased "
+                    "by XLA; copy what you need before the donating call or "
+                    "drop the donation"))
+                del stale[name]
+        if isinstance(stmt, (ast.For, ast.While)):
+            loop_donated = {}
+            body_out = _r3_scan(ctx, stmt.body, donators, loop_donated)
+            out.extend(body_out)
+            rebound_in_loop = set()
+            for s in ast.walk(stmt):
+                rebound_in_loop |= assign_target_names(s)
+            for name, line in loop_donated.items():
+                if name in rebound_in_loop:
+                    stale[name] = line  # stale after the loop exits
+                else:
+                    out.append(ctx.finding(
+                        line, f"`{name}` is donated inside this loop but "
+                        "never rebound in the loop body — the next "
+                        "iteration passes an already-deleted buffer"))
+            continue
+        for name, line in _donations_in(stmt, donators):
+            stale[name] = line
+        for name in assign_target_names(stmt):
+            stale.pop(name, None)
+    return out
+
+
+# ------------------------------------------------------------------- R4
+
+def _jitted_callables(tree):
+    """dotted-name -> set of static positional indices, for names assigned
+    from jax.jit(...) or a known step factory."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            name = call_name(call)
+            if name is None:
+                continue
+            is_jit = name in _JIT_NAMES
+            is_factory = name.split(".")[-1] in _DONATING_FACTORIES or \
+                name.split(".")[-1] in ("make_eval_step", "make_encode_fn")
+            if not (is_jit or is_factory):
+                continue
+            statics = set()
+            argnums = _kw(call, "static_argnums")
+            if isinstance(argnums, (ast.Tuple, ast.List)):
+                statics = {_const(e) for e in argnums.elts}
+            elif isinstance(argnums, ast.Constant):
+                statics = {argnums.value}
+            for t in node.targets:
+                d = dotted(t)
+                if d:
+                    out[d] = statics
+    return out
+
+
+def _scalar_of(expr, var):
+    """True when `expr` is a bare Python scalar built from `var` and
+    constants (i, i+1, 2*i...) — the shape/hash changes every iteration."""
+    if isinstance(expr, ast.Name):
+        return expr.id == var
+    if isinstance(expr, ast.Constant):
+        return False  # constants alone are cached after the first call
+    if isinstance(expr, ast.BinOp):
+        return ((_scalar_of(expr.left, var) or _scalar_of(expr.right, var))
+                and all(isinstance(s, (ast.Name, ast.Constant, ast.BinOp,
+                                       ast.UnaryOp))
+                        for s in (expr.left, expr.right)))
+    if isinstance(expr, ast.UnaryOp):
+        return _scalar_of(expr.operand, var)
+    return False
+
+
+@rule("R4", "recompile hazard")
+def check_r4(ctx):
+    jitted = _jitted_callables(ctx.tree)
+    out = []
+    # R4a: jitted callable fed a per-iteration Python scalar
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.For):
+            continue
+        it = call_name(node.iter)
+        if it == "range":
+            loop_vars = [node.target.id] if isinstance(node.target, ast.Name) \
+                else []
+        elif it == "enumerate" and isinstance(node.target, ast.Tuple) and \
+                node.target.elts and isinstance(node.target.elts[0], ast.Name):
+            loop_vars = [node.target.elts[0].id]
+        else:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and call_name(sub) in jitted:
+                statics = jitted[call_name(sub)]
+                for pos, arg in enumerate(sub.args):
+                    if pos in statics:
+                        continue
+                    for var in loop_vars:
+                        if _scalar_of(arg, var):
+                            out.append(ctx.finding(
+                                sub, f"jitted callable `{call_name(sub)}` "
+                                f"receives the Python loop scalar `{var}` at "
+                                f"position {pos} — every iteration retraces "
+                                "and recompiles; mark it static_argnums, "
+                                "pass a device array, or hoist it"))
+    # R4b: stacking variable-bound list slices feeds jit/scan a shape that
+    # goes ragged on the tail group (the round-5 bench recompile)
+    for fn in [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                            if isinstance(n, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))]:
+        has_guard = _has_mod_assert(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                    node is not fn:
+                continue
+            if isinstance(node, ast.Call) and call_name(node) in _STACK_NAMES \
+                    and node.args and _is_ragged_slice_source(node.args[0]):
+                if not has_guard:
+                    out.append(ctx.finding(
+                        node, "stacking variable-bound list slices: a ragged "
+                        "tail group changes the stacked leading dim and "
+                        "recompiles any jit/scan consuming it — assert "
+                        "divisibility, pad to a bucket "
+                        "(train/pipeline.bucket_pad), or drop the tail "
+                        "explicitly"))
+    # dedupe (module walk + per-function walk can see the same node)
+    uniq = {}
+    for f in out:
+        uniq[(f.line, f.message)] = f
+    return list(uniq.values())
+
+
+def _has_mod_assert(fn):
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assert):
+            for b in ast.walk(n.test):
+                if isinstance(b, ast.BinOp) and isinstance(b.op, ast.Mod):
+                    return True
+    return False
+
+
+def _is_ragged_slice_source(arg):
+    """`feeds[g:g+group]` directly, or a comprehension over such slices."""
+
+    def var_slice(node):
+        return (isinstance(node, ast.Subscript) and
+                isinstance(node.slice, ast.Slice) and
+                any(b is not None and not isinstance(b, ast.Constant)
+                    for b in (node.slice.lower, node.slice.upper)))
+
+    if var_slice(arg):
+        return True
+    if isinstance(arg, (ast.ListComp, ast.GeneratorExp)):
+        return var_slice(arg.elt)
+    return False
+
+
+# ------------------------------------------------------------------- R5
+
+@rule("R5", "PRNG key reused without split")
+def check_r5(ctx):
+    out = []
+    roots = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))]
+    for root in roots:
+        body = getattr(root, "body", [])
+        if body and isinstance(body[0], ast.stmt):
+            state = _KeyState()
+            if isinstance(root, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for a in root.args.args + root.args.kwonlyargs:
+                    if _looks_like_key(a.arg):
+                        state.keys.add(a.arg)
+            out.extend(_r5_scan(ctx, body, state, loop_vars=set()))
+    uniq = {}
+    for f in out:
+        uniq[(f.line, f.message)] = f
+    return list(uniq.values())
+
+
+def _looks_like_key(name):
+    """Parameters named like PRNG keys are tracked as keys on entry."""
+    return name in ("key", "rng", "rng_key", "prng_key", "keys") or \
+        name.endswith("_key")
+
+
+class _KeyState:
+    def __init__(self):
+        self.keys = set()      # names known to hold PRNG keys / key arrays
+        self.used = {}         # key id -> line of first consumption
+
+
+def _key_ids_in_call(call, state, loop_vars):
+    """Key ids consumed by this call: bare key names, or subscripts of a key
+    array (`keys[0]`); subscripts indexed by a loop variable vary per
+    iteration and get a per-iteration id of None (exempt)."""
+    ids = []
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        d = dotted(arg)
+        if d and d in state.keys:
+            ids.append(d)
+        elif isinstance(arg, ast.Subscript):
+            base = dotted(arg.value)
+            if base in state.keys:
+                idx_names = names_in(arg.slice)
+                if idx_names & loop_vars:
+                    continue  # keys[i] in a loop: a fresh key each pass
+                ids.append(ast.unparse(arg))
+    return ids
+
+
+def _r5_consume(ctx, node, state, loop_vars):
+    """Mark keys consumed by calls under `node`; reconsumption is a finding."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            for key_id in _key_ids_in_call(sub, state, loop_vars):
+                if key_id in state.used:
+                    out.append(ctx.finding(
+                        sub, f"PRNG key `{key_id}` consumed again "
+                        f"(first used at line {state.used[key_id]}) "
+                        "without an intervening jax.random.split — "
+                        "both consumers draw identical randomness"))
+                else:
+                    state.used[key_id] = sub.lineno
+    return out
+
+
+def _r5_scan(ctx, stmts, state, loop_vars):
+    out = []
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(stmt, ast.If):
+            # exclusive branches never both run: consumptions in one arm must
+            # not count against the other (the corrupt() dispatch pattern)
+            out.extend(_r5_consume(ctx, stmt.test, state, loop_vars))
+            survivors = []
+            for arm in (stmt.body, stmt.orelse):
+                branch = _KeyState()
+                branch.keys = set(state.keys)
+                branch.used = dict(state.used)
+                out.extend(_r5_scan(ctx, arm, branch, loop_vars))
+                # an arm ending in return/raise never falls through: its
+                # consumptions don't exist on the path that continues (the
+                # `if t == "x": return f(key)` dispatch chain)
+                if not (arm and isinstance(arm[-1], (ast.Return, ast.Raise,
+                                                     ast.Break,
+                                                     ast.Continue))):
+                    survivors.append(branch)
+            if survivors:
+                state.keys = set.union(*[b.keys for b in survivors])
+                merged = {}
+                for b in reversed(survivors):
+                    merged.update(b.used)
+                state.used = merged
+            continue
+        if isinstance(stmt, (ast.For, ast.While)):
+            inner_loop_vars = set(loop_vars)
+            if isinstance(stmt, ast.For):
+                inner_loop_vars |= names_in(stmt.target)
+            before_used = dict(state.used)
+            body_findings = _r5_scan(ctx, stmt.body, state, inner_loop_vars)
+            out.extend(body_findings)
+            rebound = set()
+            for s in ast.walk(stmt):
+                rebound |= assign_target_names(s)
+            for key_id, line in state.used.items():
+                if key_id in before_used:
+                    continue  # consumed before the loop, not by it
+                base = key_id.split("[")[0]
+                if base not in rebound and key_id not in rebound:
+                    out.append(ctx.finding(
+                        line, f"PRNG key `{key_id}` is consumed inside this "
+                        "loop but never re-split/rebound in the loop body — "
+                        "every iteration draws the same randomness"))
+            continue
+        # consumption first (uses in this statement see the pre-state)
+        out.extend(_r5_consume(ctx, stmt, state, loop_vars))
+        # then (re)bindings: a fresh value clears the used mark
+        targets = assign_target_names(stmt)
+        for t in targets:
+            state.used.pop(t, None)
+            state.used = {k: v for k, v in state.used.items()
+                          if k.split("[")[0] != t}
+        if isinstance(stmt, ast.Assign):
+            vname = call_name(stmt.value)
+            if vname in _KEY_MAKERS or vname in _KEY_SPLITS:
+                state.keys |= targets
+            elif isinstance(stmt.value, (ast.Name, ast.Subscript)):
+                d = dotted(stmt.value) or dotted(
+                    getattr(stmt.value, "value", None))
+                if d and d.split("[")[0] in {k.split("[")[0]
+                                             for k in state.keys}:
+                    state.keys |= targets  # alias of a key keeps key-ness
+    return out

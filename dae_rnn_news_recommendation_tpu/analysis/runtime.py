@@ -1,0 +1,101 @@
+"""Runtime companion to the static rules: a compile-budget guard.
+
+R4 catches recompile *hazards* syntactically; `compile_guard` pins the actual
+count at runtime. XLA backend compiles are the multi-second events that wreck
+step-time claims (the round-5 ragged-scan tail recompiled inside a timed
+section), and `jax.monitoring` exposes each one as a duration event — so a
+test can wrap a workload and assert "this path compiles at most N variants":
+
+    with compile_guard(max_compiles=len(buckets)) as guard:
+        for batch in feed:
+            params, opt_state, metrics = step(params, opt_state, key, batch)
+    assert guard.count <= len(buckets)
+
+The guard raises `CompileBudgetExceeded` on exit when the budget is blown
+(not mid-run: listeners fire inside jax's dispatch path, where raising would
+corrupt unrelated state). Guards nest; each counts independently.
+"""
+
+import contextlib
+import threading
+
+# the event jax's dispatch layer records around every backend_compile call
+# (jax._src.dispatch / pxla both funnel through this name)
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class CompileBudgetExceeded(AssertionError):
+    """More XLA backend compiles happened under a guard than budgeted."""
+
+
+class CompileWatcher:
+    """Counts XLA backend-compile events while active.
+
+    Listener registration in `jax.monitoring` is append-only in older jax
+    releases, so the callback stays registered but no-ops once `stop()` has
+    run; where the private unregister hook exists we use it.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = False
+        self._registered = False
+        self.count = 0
+        self.events = []  # durations (secs) of each compile seen
+
+    def _listener(self, event, duration_secs, **kwargs):
+        if event != BACKEND_COMPILE_EVENT:
+            return
+        with self._lock:
+            if self._active:
+                self.count += 1
+                self.events.append(duration_secs)
+
+    def start(self):
+        import jax.monitoring
+
+        with self._lock:
+            self.count = 0
+            self.events = []
+            self._active = True
+        if not self._registered:
+            jax.monitoring.register_event_duration_secs_listener(
+                self._listener)
+            self._registered = True
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._active = False
+        if self._registered:
+            try:
+                from jax._src import monitoring as _m
+
+                _m._unregister_event_duration_listener_by_callback(
+                    self._listener)
+                self._registered = False
+            except Exception:
+                pass  # stays registered but inactive; harmless
+        return self.count
+
+
+@contextlib.contextmanager
+def compile_guard(max_compiles=None):
+    """Context manager asserting an upper bound on XLA compiles inside it.
+
+    `max_compiles=None` just counts (inspect `.count` after). Any overrun
+    raises `CompileBudgetExceeded` on exit with the observed count and the
+    per-compile durations, which usually identify the shape that retraced.
+    """
+    watcher = CompileWatcher()
+    watcher.start()
+    try:
+        yield watcher
+    finally:
+        count = watcher.stop()
+        if max_compiles is not None and count > max_compiles:
+            durs = ", ".join(f"{d:.3f}s" for d in watcher.events)
+            raise CompileBudgetExceeded(
+                f"{count} XLA backend compiles observed, budget was "
+                f"{max_compiles} (durations: {durs}) — an input shape or "
+                "Python-scalar arg is varying across calls; see jaxcheck R4")

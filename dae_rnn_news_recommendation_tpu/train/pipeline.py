@@ -3,7 +3,8 @@
 Why this exists: the streaming fit path hands HOST numpy batches straight to
 jit, so every step pays its host->device transfer synchronously inside the
 dispatch — over a thin link (the axon TPU tunnel: ~15-60 MB/s effective,
-bench.py `h2d_bandwidth_mbps`) the chip idles while bytes trickle in, which is
+bench.py `h2d_bandwidth_mbytes_per_sec`) the chip idles while bytes trickle
+in, which is
 exactly the measured stream-vs-resident gap (BENCH_r05: 30.9k vs 65.4k
 articles/sec). The resident path (train/resident.py) closes that gap only when
 the whole corpus fits the HBM budget; a production news corpus (millions of

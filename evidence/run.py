@@ -386,6 +386,7 @@ def main(argv=None):
         def _ref():
             t_ref = time.time()
             model, out = main_autoencoder(REFSCALE_ARGS)
+            # jaxcheck: disable=R2 (whole-pipeline wall clock, not a device timing: `out` holds host-side auroc floats, so everything is fetched)
             return {"aurocs": out, "wall": time.time() - t_ref,
                     "figures": _export_figures(model.plot_dir, "refscale",
                                                platform)}
@@ -398,6 +399,7 @@ def main(argv=None):
         def _refstory():
             t_rs = time.time()
             _, out = main_autoencoder(REFSTORY_ARGS)
+            # jaxcheck: disable=R2 (whole-pipeline wall clock, not a device timing: `out` holds host-side auroc floats, so everything is fetched)
             return {"aurocs": out, "wall": time.time() - t_rs}
 
         refstory = staged("reference-scale run, story-mined "
@@ -576,6 +578,7 @@ def main(argv=None):
           "random candidate made the metric hostage to a single draw; "
           "measured 0.884 at the round-4 calibration)")
 
+    # jaxcheck: disable=R2 (end-to-end harness wall clock for the whole evidence run; every stage fetches its aurocs to host before this point)
     payload = {
         "generated": datetime.datetime.now(datetime.timezone.utc).isoformat(),
         "platform": platform_claim,

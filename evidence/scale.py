@@ -66,6 +66,7 @@ def main(argv=None):
         _, aurocs = main_autoencoder(ARGS)
     finally:
         os.chdir(cwd)
+    # jaxcheck: disable=R2 (whole-run wall clock: `aurocs` are host floats already, nothing is still in flight)
     wall = time.time() - t0
 
     checks = {}
@@ -80,6 +81,12 @@ def main(argv=None):
           "(train + encode + 10^10-pair streaming AUROC)")
     check("scale_encoded_above_chance", enc_vl > 0.55,
           f"encoded(Category) validate AUROC {enc_vl:.4f} > 0.55 at 100k rows")
+    story_vl = aurocs["similarity_boxplot_encoded_validate(Story)"]
+    check("scale_story_chance_by_construction", 0.40 <= story_vl <= 0.62,
+          f"encoded(Story) validate AUROC {story_vl:.4f} within the chance "
+          "band [0.40, 0.62]: this run's batch_hard mining is keyed on "
+          "Category alone, so the embedding carries no Story signal by "
+          "construction (same treatment as RESULTS.md's triplet Story cells)")
 
     try:
         import subprocess
@@ -124,6 +131,14 @@ def main(argv=None):
     ]
     for k, v in payload["aurocs"].items():
         lines.append(f"| {k} | {v:.4f} |")
+    lines += [
+        "",
+        "The at-chance Story cells are expected, not a failure: this run's "
+        "batch_hard mining is keyed on Category alone, so the embedding "
+        "carries no Story signal by construction — the bounded check below "
+        "asserts those cells stay inside the chance band instead of leaving "
+        "them unexplained.",
+    ]
     lines += ["", "## Checks", ""]
     for name, c in checks.items():
         lines.append(f"- **{'PASS' if c['pass'] else 'FAIL'}** {name}: "

@@ -1,0 +1,33 @@
+"""Planted R2 violation: a timed region with no fetch fence before the read.
+
+Named bench_* so it falls inside R2's bench/evidence scope. The fenced twin
+below must NOT be flagged.
+"""
+
+import time
+
+import jax
+
+
+def timed_unfenced(step, params, batch):
+    t0 = time.perf_counter()
+    for _ in range(10):
+        params = step(params, batch)
+    dt = time.perf_counter() - t0  # planted: R2
+    return params, dt
+
+
+def timed_fenced(step, params, batch):
+    t0 = time.perf_counter()
+    for _ in range(10):
+        params = step(params, batch)
+    jax.device_get(params)
+    dt = time.perf_counter() - t0
+    return params, dt
+
+
+def watchdog_ok(deadline):
+    # time.monotonic is this repo's watchdog convention, outside R2's scope
+    start = time.monotonic()
+    while time.monotonic() - start < deadline:
+        pass

@@ -1,0 +1,49 @@
+"""Planted R1 violations: host syncs reachable inside traced code.
+
+Each line the analyzer must flag carries a trailing planted-rule marker.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def bad_item(params, x):
+    h = jnp.dot(params["w"], x)
+    v = h.item()  # planted: R1
+    return v
+
+
+@jax.jit
+def bad_branch(params, x):
+    h = jnp.tanh(jnp.dot(params["w"], x))
+    if h.sum() > 0:  # planted: R1
+        h = -h
+    return h
+
+
+@jax.jit
+def bad_float(params, x):
+    h = jnp.dot(params["w"], x)
+    scale = float(h)  # planted: R1
+    return h * scale
+
+
+def scan_body(carry, x):
+    y = np.asarray(x)  # planted: R1
+    return carry + 1, y
+
+
+def run_scan(xs):
+    return lax.scan(scan_body, 0, xs)
+
+
+@jax.jit
+def ok_none_guard(params, x):
+    # `is None` never calls __bool__ on a tracer — must NOT be flagged
+    h = jnp.dot(params["w"], x)
+    if params.get("bias") is None:
+        return h
+    return h + params["bias"]

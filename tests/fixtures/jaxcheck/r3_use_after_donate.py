@@ -1,0 +1,44 @@
+"""Planted R3 violations: reading a name after its buffer was donated."""
+
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu.train.step import make_train_step
+
+
+def train_step(params, opt_state, key, batch):
+    return params, opt_state
+
+
+step = jax.jit(train_step, donate_argnums=(0, 1))
+
+
+def read_after_donate(params, opt_state, key, batch):
+    new_params, new_opt = step(params, opt_state, key, batch)
+    norm = jnp.linalg.norm(params["w"])  # planted: R3
+    return new_params, new_opt, norm
+
+
+def donate_in_loop(params, opt_state, key, batches):
+    local_step = jax.jit(train_step, donate_argnums=(0, 1))
+    for batch in batches:
+        out = local_step(params, opt_state, key, batch)  # planted: R3,R5
+    return out
+
+
+def factory_donated_batch(config, optimizer, init, batches):
+    fit_step = make_train_step(config, optimizer, donate_batch=True)
+    params, opt_state = init()
+    key = jax.random.PRNGKey(0)
+    stash = batches[0]
+    params, opt_state, metrics = fit_step(params, opt_state, key, stash)
+    x = stash["x"]  # planted: R3
+    return params, x
+
+
+def rebound_ok(params, opt_state, key, batches):
+    # donated names rebound from the call's results every iteration: clean
+    for batch in batches:
+        key, sub = jax.random.split(key)
+        params, opt_state = step(params, opt_state, sub, batch)
+    return params, opt_state

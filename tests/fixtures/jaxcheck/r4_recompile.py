@@ -1,0 +1,35 @@
+"""Planted R4 violations: per-iteration retrace/recompile hazards."""
+
+import jax
+import numpy as np
+
+
+def encode(params, n):
+    return params
+
+
+enc = jax.jit(encode)
+enc_static = jax.jit(encode, static_argnums=(1,))
+
+
+def sweep(params):
+    for i in range(10):
+        out = enc(params, i)  # planted: R4
+    return out
+
+
+def stack_ragged(feeds, group):
+    return [np.stack(feeds[g:g + group]) for g in range(0, len(feeds), group)]  # planted: R4
+
+
+def sweep_static_ok(params):
+    # static_argnums(1) hashes the scalar into the cache key: only flagged
+    # if the cache churns, which a static analyzer can't see — not reported
+    for i in range(10):
+        out = enc_static(params, i)
+    return out
+
+
+def stack_guarded_ok(feeds, group):
+    assert len(feeds) % group == 0
+    return [np.stack(feeds[g:g + group]) for g in range(0, len(feeds), group)]

@@ -1,0 +1,33 @@
+"""Planted R5 violations: PRNG keys consumed twice without a split."""
+
+import jax
+
+
+def double_draw(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # planted: R5
+    return a + b
+
+
+def loop_reuse(key, xs):
+    total = 0.0
+    for x in xs:
+        total += float(jax.random.normal(key, ()))  # planted: R5
+    return total
+
+
+def split_ok(key, xs):
+    out = []
+    for x in xs:
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, ()))
+    return out
+
+
+def indexed_ok(key, xs):
+    # keys[i] varies per iteration: a fresh key each pass, not a reuse
+    keys = jax.random.split(key, len(xs))
+    out = []
+    for i in range(len(xs)):
+        out.append(jax.random.normal(keys[i], ()))
+    return out

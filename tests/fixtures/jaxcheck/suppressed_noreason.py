@@ -1,0 +1,11 @@
+"""A reasonless disable: the suppression itself is a finding (rule SUP) and
+the underlying violation is still reported."""
+
+import jax
+
+
+def double_draw(key):
+    a = jax.random.normal(key, (4,))
+    # jaxcheck: disable=R5
+    b = jax.random.uniform(key, (4,))  # planted: R5
+    return a, b

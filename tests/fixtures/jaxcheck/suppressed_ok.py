@@ -1,0 +1,10 @@
+"""A real violation silenced by a suppression WITH a reason: clean file."""
+
+import jax
+
+
+def antithetic_pair(key):
+    a = jax.random.normal(key, (4,))
+    # jaxcheck: disable=R5 (deliberate identical draw: the pair must share the key)
+    b = jax.random.uniform(key, (4,))
+    return a, b

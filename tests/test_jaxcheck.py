@@ -1,0 +1,206 @@
+"""jaxcheck wiring into tier-1.
+
+Three contracts:
+  * seeded  — every planted violation in tests/fixtures/jaxcheck/ is found
+              (and nothing else: the fixtures' clean twins must stay clean);
+  * self-clean — the repo's own contract set (package + bench.py + evidence/)
+              has zero unsuppressed findings, and every suppression that
+              silences something carries a reason;
+  * runtime — compile_guard counts real XLA backend compiles, and the
+              pipelined-feed bucketing path compiles at most len(buckets)
+              step variants per epoch (PR 1's shape-bucket invariant).
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+
+from dae_rnn_news_recommendation_tpu.analysis import (
+    RULES, analyze_file, analyze_paths, default_targets,
+    CompileBudgetExceeded, compile_guard)
+from dae_rnn_news_recommendation_tpu.analysis.__main__ import main as cli_main
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "jaxcheck")
+_PLANTED_RE = re.compile(r"#\s*planted:\s*([A-Z0-9,\s]+)")
+
+
+def planted_markers(path):
+    """(line, rule) pairs declared by `# planted: R1[,R5]` comments."""
+    pairs = set()
+    with open(path, encoding="utf-8") as f:
+        for lineno, text in enumerate(f, start=1):
+            m = _PLANTED_RE.search(text)
+            if m:
+                for rule_id in m.group(1).split(","):
+                    pairs.add((lineno, rule_id.strip()))
+    return pairs
+
+
+def fixture_files():
+    return sorted(p for p in os.listdir(FIXTURE_DIR) if p.endswith(".py"))
+
+
+# ------------------------------------------------------------------ seeded
+
+def test_every_rule_has_a_fixture():
+    planted = set()
+    for name in fixture_files():
+        planted |= {r for _, r in
+                    planted_markers(os.path.join(FIXTURE_DIR, name))}
+    assert {"R1", "R2", "R3", "R4", "R5"} <= planted
+
+
+@pytest.mark.parametrize("name", fixture_files())
+def test_planted_violations_detected(name):
+    path = os.path.join(FIXTURE_DIR, name)
+    planted = planted_markers(path)
+    findings, _ = analyze_file(path, root=FIXTURE_DIR)
+    found = {(f.line, f.rule) for f in findings}
+    missed = planted - found
+    assert not missed, f"planted violations not detected: {sorted(missed)}"
+
+
+@pytest.mark.parametrize("name", fixture_files())
+def test_no_unplanted_findings(name):
+    """The fixtures' clean twins (fenced timers, rebound donations, split
+    keys, static_argnums) must NOT be flagged — false-positive regression."""
+    path = os.path.join(FIXTURE_DIR, name)
+    planted = planted_markers(path)
+    findings, _ = analyze_file(path, root=FIXTURE_DIR)
+    extra = {(f.line, f.rule) for f in findings
+             if f.rule in RULES} - planted
+    assert not extra, f"unplanted findings (false positives): {sorted(extra)}"
+
+
+# ------------------------------------------------------------- suppressions
+
+def test_reasoned_suppression_silences():
+    path = os.path.join(FIXTURE_DIR, "suppressed_ok.py")
+    findings, suppressed = analyze_file(path, root=FIXTURE_DIR)
+    assert findings == []
+    assert [s.rule for s in suppressed] == ["R5"]
+    assert suppressed[0].suppress_reason  # the reason travels with it
+
+
+def test_reasonless_suppression_is_a_finding():
+    path = os.path.join(FIXTURE_DIR, "suppressed_noreason.py")
+    findings, _ = analyze_file(path, root=FIXTURE_DIR)
+    rules = [f.rule for f in findings]
+    assert "SUP" in rules          # the bad disable itself
+    assert "R5" in rules           # and it did NOT silence the violation
+
+
+def test_sup_cannot_be_suppressed(tmp_path):
+    p = tmp_path / "laundering.py"
+    p.write_text("import jax\n"
+                 "def f(key):\n"
+                 "    a = jax.random.normal(key, (2,))\n"
+                 "    # jaxcheck: disable=R5,SUP\n"
+                 "    b = jax.random.normal(key, (2,))\n"
+                 "    return a + b\n")
+    findings, _ = analyze_file(str(p), root=str(tmp_path))
+    assert any(f.rule == "SUP" for f in findings)
+
+
+# -------------------------------------------------------------- self-clean
+
+def test_repo_is_self_clean():
+    """Zero unsuppressed findings on the package + bench.py + evidence/,
+    every suppression reasoned — the acceptance criterion, as a test."""
+    root, targets = default_targets()
+    findings, suppressed, n_files = analyze_paths(targets, root=root)
+    assert n_files > 30  # the walk actually covered the tree
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert all(s.suppress_reason for s in suppressed)
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_cli_json_mode(capsys):
+    rc = cli_main(["--json", os.path.join(FIXTURE_DIR, "r5_key_reuse.py")])
+    out = capsys.readouterr().out
+    report = json.loads(out)
+    assert rc == 1
+    assert report["files_analyzed"] == 1
+    assert {f["rule"] for f in report["findings"]} == {"R5"}
+    assert all(set(f) >= {"rule", "path", "line", "message"}
+               for f in report["findings"])
+
+
+def test_cli_clean_exit_zero(capsys):
+    rc = cli_main([os.path.join(FIXTURE_DIR, "suppressed_ok.py")])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "clean" in captured.err
+
+
+# ------------------------------------------------------------ compile_guard
+
+def test_compile_guard_counts_and_raises():
+    @jax.jit
+    def f(x):
+        return x * 2.0 + 1.0
+
+    with pytest.raises(CompileBudgetExceeded) as e:
+        with compile_guard(max_compiles=1):
+            f(np.ones(4, np.float32))   # shape (4,): compile 1
+            f(np.ones(8, np.float32))   # shape (8,): compile 2 — over budget
+    assert "2 XLA backend compiles" in str(e.value)
+
+    # both shapes now cached: a fresh guard over the same calls sees zero
+    with compile_guard(max_compiles=0) as guard:
+        f(np.ones(4, np.float32))
+        f(np.ones(8, np.float32))
+    assert guard.count == 0
+
+
+def test_pipelined_feed_compiles_at_most_bucket_variants():
+    """Satellite regression for PR 1's invariant: with bucket padding on, a
+    full epoch (ragged tail included) compiles at most len(buckets) step
+    variants — the ragged tail pads up instead of tracing its own program.
+    A second epoch compiles nothing."""
+    from dae_rnn_news_recommendation_tpu.data.batcher import (
+        SparseIngestBatcher)
+    from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+    from dae_rnn_news_recommendation_tpu.train import make_optimizer
+    from dae_rnn_news_recommendation_tpu.train.pipeline import (
+        PipelinedFeed, bucket_sizes)
+    from dae_rnn_news_recommendation_tpu.train.step import make_train_step
+
+    config = DAEConfig(n_features=24, n_components=4, enc_act_func="tanh",
+                       dec_act_func="none", loss_func="mean_squared",
+                       corr_type="masking", corr_frac=0.3,
+                       triplet_strategy="none")
+    optimizer = make_optimizer("ada_grad", 0.1)
+    params = init_params(jax.random.PRNGKey(0), config)
+    opt_state = optimizer.init(params)
+    step = make_train_step(config, optimizer, donate_batch=True)
+    buckets = bucket_sizes(8, n_buckets=2, floor=4)  # (4, 8)
+
+    rng = np.random.default_rng(0)
+    x = sp.csr_matrix((rng.uniform(size=(33, 24)) < 0.3).astype(np.float32))
+    key = jax.random.PRNGKey(1)
+    key, _ = jax.random.split(key)  # pre-warm split's own compile
+
+    def one_epoch(params, opt_state, key):
+        batcher = SparseIngestBatcher(8, shuffle=False)
+        feed = PipelinedFeed(batcher.epoch(x), depth=2, buckets=buckets)
+        for batch in feed:  # 33 rows @ 8: shapes 8,8,8,8 then 1 -> padded 4
+            key, sub = jax.random.split(key)
+            params, opt_state, metrics = step(params, opt_state, sub, batch)
+        jax.block_until_ready(metrics["cost"])
+        return params, opt_state, key
+
+    with compile_guard(max_compiles=len(buckets)) as first:
+        params, opt_state, key = one_epoch(params, opt_state, key)
+    assert 1 <= first.count <= len(buckets)
+
+    with compile_guard(max_compiles=0) as second:
+        params, opt_state, key = one_epoch(params, opt_state, key)
+    assert second.count == 0
