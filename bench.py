@@ -554,6 +554,44 @@ def _measure_h2d_bandwidth(jax, mb=4, n=10):
     }
 
 
+def _measure_feed_transfers(jax, sz, workload=None):
+    """Fence-measured H2D accounting for the real feed path: ONE feed-only
+    pass of the pipelined feed with telemetry on, so each batch's device_put
+    is a fenced `feed/h2d` span (train/pipeline.py -> telemetry.record_transfer)
+    landing in the `transfer/h2d` counter with its byte count. The derived
+    MBytes/s is the per-batch, fence-included figure the report CLI reconciles
+    against the bulk `h2d_feed_bandwidth_mbytes_per_sec` probe — the gap
+    between the two is per-transfer dispatch overhead at feed batch sizes.
+    No train step runs: the feed is drained so the spans time transfers, not
+    compute overlap."""
+    from dae_rnn_news_recommendation_tpu import telemetry
+    from dae_rnn_news_recommendation_tpu.data.batcher import SparseIngestBatcher
+    from dae_rnn_news_recommendation_tpu.train.pipeline import PipelinedFeed
+
+    wl = workload or _fit_workload(jax, sz)
+    batcher = SparseIngestBatcher(sz["stream_batch"], seed=0)
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        feed = PipelinedFeed(batcher.epoch(wl["data"], wl["labels"]), depth=4)
+        for batch in feed:
+            del batch  # already fenced host-side by the feed/h2d span's exit
+        counters = telemetry.counters()
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+    h2d = counters.get("transfer/h2d")
+    if not h2d or not h2d.get("total_s"):
+        return None
+    mbytes = h2d.get("bytes", 0) / 1e6
+    return {
+        "batches": h2d["count"],
+        "mbytes": round(mbytes, 3),
+        "busy_s": round(h2d["total_s"], 6),
+        "h2d_feed_measured_mbytes_per_sec": round(mbytes / h2d["total_s"], 1),
+    }
+
+
 def _bench_fit_resident(jax, sz):
     """The resident-epoch fit hot loop (train/resident.py): train set uploaded
     once, each epoch ONE lax.scan dispatch over the permuted minibatches —
@@ -617,6 +655,13 @@ def child_main():
         jax.config.update("jax_platforms", "cpu")
 
     from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+    from dae_rnn_news_recommendation_tpu.telemetry import XlaEventListener
+
+    # passive compile accounting for the whole child run: registered before
+    # the first device touch so every XLA backend compile lands in the bench
+    # record (extra.xla_events); at this jax version the listener only fires
+    # on compile-path events, so the hot loops pay nothing
+    listener = XlaEventListener().start()
 
     dev = jax.devices()[0]
     platform = dev.platform
@@ -719,6 +764,13 @@ def child_main():
     except Exception as e:
         extra["fit_pipelined_error"] = repr(e)[-300:]
     try:
+        _phase("feed: fenced H2D transfer accounting pass")
+        xfer = _measure_feed_transfers(jax, sz, workload=fit_wl)
+        if xfer:
+            extra["transfer_events"] = xfer
+    except Exception as e:
+        extra["transfer_events_error"] = repr(e)[-300:]
+    try:
         extra["fit_resident_articles_per_sec"] = round(
             _bench_fit_resident(jax, sz), 1)
     except Exception as e:
@@ -756,6 +808,18 @@ def child_main():
     extra["roofline"] = _roofline(
         platform, dev.device_kind, encode_aps, train_aps, sz["train_batch"],
         encode_strategy=extra.get("encode_strategy", "gather-accumulate"))
+
+    try:
+        # provenance + whole-run compile counters: every bench record now
+        # says which code/backend produced it, and `telemetry report --bench`
+        # can reconcile the h2d probes against the fenced feed transfers
+        from dae_rnn_news_recommendation_tpu.telemetry import build_manifest
+
+        extra["xla_events"] = listener.stop().summary()
+        extra["manifest"] = build_manifest(
+            feed_mode="bench", extra={"sizes": {k: sz[k] for k in sorted(sz)}})
+    except Exception as e:
+        extra["provenance_error"] = repr(e)[-300:]
 
     print(json.dumps({
         "metric": "encode_articles_per_sec",

@@ -321,10 +321,33 @@ def _is_fence_call(node, fence_fns=()):
     name = call_name(node)
     if name in _FENCE_NAMES or name in fence_fns:
         return True
-    if name and name.split(".")[-1] in ("device_get", "block_until_ready"):
+    if name and name.split(".")[-1] in ("device_get", "block_until_ready",
+                                        "device_fence"):
+        return True
+    if _is_fenced_span_call(node):
         return True
     return isinstance(node.func, ast.Attribute) and \
         node.func.attr in _FENCE_ATTRS
+
+
+# telemetry/ entry points whose presence means a region ends with a real
+# device fetch (tracer.py: span exit runs device_fence unless fence=False,
+# instrument() fences each call on its result unless fence_result=False)
+_SPAN_FENCES = {"span", "instrument", "fence_on"}
+
+
+def _is_fenced_span_call(node):
+    name = call_name(node)
+    if not name:
+        return False
+    short = name.split(".")[-1]
+    if short not in _SPAN_FENCES:
+        return False
+    if short == "span":
+        return _const(_kw(node, "fence"), True) is not False
+    if short == "instrument":
+        return _const(_kw(node, "fence_result"), True) is not False
+    return True  # sp.fence_on(x): nominates the fence target explicitly
 
 
 def _timer_reads(stmt, timers):
@@ -800,4 +823,50 @@ def _r5_scan(ctx, stmts, state, loop_vars):
                 if d and d.split("[")[0] in {k.split("[")[0]
                                              for k in state.keys}:
                     state.keys |= targets  # alias of a key keeps key-ness
+    return out
+
+
+# ------------------------------------------------------------------- R6
+
+@rule("R6", "fence=False span wrapping un-fenced device work")
+def check_r6(ctx):
+    """A `telemetry.span(..., fence=False)` declares "this region is
+    host-only, its duration needs no device fence". If the span body then
+    dispatches device work (jnp/lax calls, or a call to a known jitted
+    callable) without any fence of its own, the span's recorded duration
+    measures enqueue — the exact lie R2 catches for raw timers, recurring
+    through the telemetry API. Fix: drop fence=False (spans fence by
+    default), nominate a target with sp.fence_on(out), or end the body
+    with jax.device_get."""
+    jitted = set(_jitted_callables(ctx.tree))
+    fence_fns = _fence_functions(ctx.tree)
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            call = item.context_expr
+            if not isinstance(call, ast.Call):
+                continue
+            name = call_name(call)
+            if not name or name.split(".")[-1] != "span":
+                continue
+            if _const(_kw(call, "fence"), True) is not False:
+                continue  # default-fenced span: clean by construction
+            has_device = has_fence = False
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if _is_fence_call(sub, fence_fns):
+                        has_fence = True
+                    elif isinstance(sub, ast.Call):
+                        cn = call_name(sub)
+                        if cn and (cn.startswith(_DEVICE_PREFIXES) or
+                                   cn in jitted):
+                            has_device = True
+            if has_device and not has_fence:
+                out.append(ctx.finding(
+                    call, "span(..., fence=False) wraps device work with no "
+                    "fence in the body — the recorded duration measures "
+                    "enqueue, not compute; drop fence=False, call "
+                    "sp.fence_on(out), or end with jax.device_get"))
     return out

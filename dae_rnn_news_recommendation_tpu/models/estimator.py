@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
+from .. import telemetry
 from ..data.batcher import PaddedBatcher, densify_rows, prefetch
 from ..train.optimizers import make_optimizer
 from ..train.step import loss_and_metrics, make_encode_fn, make_eval_step, make_train_step
@@ -65,7 +66,7 @@ class DenoisingAutoencoder:
                  use_tensorboard=True, n_components=None, profile=False,
                  prefetch_depth=2, keep_checkpoint_max=0, sparse_feed=True,
                  weight_update_sharding=False, resident_feed="auto",
-                 resident_budget_bytes=2 << 30, feed=None):
+                 resident_budget_bytes=2 << 30, feed=None, trace=False):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
 
         :param n_components: explicit code size; overrides the compress_factor
@@ -147,6 +148,13 @@ class DenoisingAutoencoder:
         # actually ran.
         assert feed in (None, "auto", "stream", "pipelined", "resident"), feed
         self.feed = feed
+        # span-level telemetry (telemetry/): fit runs under the fenced span
+        # tracer and exports a Chrome trace (self.trace_path) next to the TB
+        # events. Distinct from `profile` (XProf device trace): spans cost a
+        # device fence each, so this is a diagnosis mode, not a bench mode.
+        self.trace = trace
+        self.trace_path = None
+        self.run_manifest_path = None
 
         assert isinstance(self.verbose_step, int)
         assert self.verbose >= 0
@@ -349,6 +357,10 @@ class DenoisingAutoencoder:
         if not proc_sub:
             write_parameter_file(self.parameter_file, self._parameter_dict(),
                                  append=restore_previous_model)
+        # run manifest (telemetry/manifest.py): written once the feed mode is
+        # resolved in _train_loop_inner, so the artifact records what RAN
+        self.run_manifest_path = os.path.join(
+            self.tf_summary_dir, proc_sub + "manifest.json")
 
         train_writer = MetricsWriter(
             os.path.join(self.tf_summary_dir, proc_sub + "train/"),
@@ -385,16 +397,31 @@ class DenoisingAutoencoder:
 
     def _train_loop(self, train_set, train_set_label, validation_set,
                     validation_set_label, batcher, extremes, train_writer, val_writer):
-        # shared by the triplet subclass's fit too — profiling lives here so
-        # profile=True works for every estimator
+        # shared by the triplet subclass's fit too — profiling and span
+        # tracing live here so profile=True / trace=True work for every
+        # estimator. This fit owns the tracer only if it turned it on (a
+        # caller may have enabled tracing around several fits).
         if self.profile:
             jax.profiler.start_trace(os.path.join(self.tf_summary_dir, "profile"))
+        tele_owner = self.trace and not telemetry.enabled()
+        if tele_owner:
+            telemetry.enable()
         try:
             with self._graceful_stop():
                 self._train_loop_inner(train_set, train_set_label, validation_set,
                                        validation_set_label, batcher, extremes,
                                        train_writer, val_writer)
         finally:
+            if tele_owner:
+                tracer = telemetry.disable()
+                if tracer is not None:
+                    try:
+                        meta = {"manifest_path": self.run_manifest_path}
+                        self.trace_path = tracer.export(
+                            os.path.join(self.tf_summary_dir, "trace.json"),
+                            metadata=meta)
+                    except OSError:
+                        pass  # telemetry must never kill a finished fit
             if self.profile:
                 jax.profiler.stop_trace()
 
@@ -456,6 +483,17 @@ class DenoisingAutoencoder:
         self._last_fit_feed = feed_mode
         resident_mode = feed_mode == "resident"
         self._last_fit_resident = resident_mode
+        if self.run_manifest_path:
+            try:  # provenance logging must never kill a fit
+                telemetry.write_manifest(self.run_manifest_path, telemetry.build_manifest(
+                    config=self.config, feed_mode=feed_mode,
+                    buckets=(b,) if feed_mode == "pipelined" else None,
+                    extra={"model": type(self).__name__, "batch_size": b,
+                           "n_batches": n_batches,
+                           "num_epochs": self.num_epochs,
+                           "seed": self._resolved_seed}))
+            except OSError:
+                pass
         if resident_mode:
             from ..train import resident as resident_mod
 
@@ -493,60 +531,65 @@ class DenoisingAutoencoder:
             self.num_triplet_batch = []
             t0 = time.time()
 
-            if resident_mode:
-                # whole epoch in ONE dispatch: scan over the same permuted
-                # batches the streaming path would emit (train/resident.py)
-                from ..train.resident import stack_epoch_indices
+            # fence=False is sound here: every branch below already ends with
+            # a real host fetch (jax.device_get of the epoch's metrics), which
+            # is what jaxcheck R6 checks for inside unfenced spans
+            with telemetry.span("fit/epoch", fence=False,
+                                args={"epoch": epoch, "feed": feed_mode}):
+                if resident_mode:
+                    # whole epoch in ONE dispatch: scan over the same permuted
+                    # batches the streaming path would emit (train/resident.py)
+                    from ..train.resident import stack_epoch_indices
 
-                perm, rvalid = stack_epoch_indices(batcher, n_rows)
-                (self.params, self.opt_state, self._key, stacked) = epoch_fn(
-                    self.params, self.opt_state, self._key, resident_data,
-                    perm, rvalid, extremes)
-                host = jax.device_get(stacked)
-                host_metrics = [{k: v[i] for k, v in host.items()}
-                                for i in range(perm.shape[0])]
-                self.train_time = time.time() - t0
-            elif pipelined_mode:
-                # overlapped feed (train/pipeline.py): a background worker
-                # device_puts staged batches up to depth ahead; the step
-                # consumes device-resident refs (and donates them on the
-                # single-device path). Same batcher, same PRNG chain as
-                # streaming — parity is tested, overlap is measured.
-                feed_stats.reset()
-                device_metrics = []
-                feed = PipelinedFeed(
-                    batcher.epoch(train_set, labels, labels2),
-                    depth=max(2, self.prefetch_depth), place=place,
-                    extremes=extremes, buckets=(b,), stats=feed_stats)
-                for batch in feed:
-                    self._key, sub = jax.random.split(self._key)
-                    self.params, self.opt_state, metrics = pipe_step(
-                        self.params, self.opt_state, sub, batch)
-                    device_metrics.append(metrics)
+                    perm, rvalid = stack_epoch_indices(batcher, n_rows)
+                    (self.params, self.opt_state, self._key, stacked) = epoch_fn(
+                        self.params, self.opt_state, self._key, resident_data,
+                        perm, rvalid, extremes)
+                    host = jax.device_get(stacked)
+                    host_metrics = [{k: v[i] for k, v in host.items()}
+                                    for i in range(perm.shape[0])]
+                    self.train_time = time.time() - t0
+                elif pipelined_mode:
+                    # overlapped feed (train/pipeline.py): a background worker
+                    # device_puts staged batches up to depth ahead; the step
+                    # consumes device-resident refs (and donates them on the
+                    # single-device path). Same batcher, same PRNG chain as
+                    # streaming — parity is tested, overlap is measured.
+                    feed_stats.reset()
+                    device_metrics = []
+                    feed = PipelinedFeed(
+                        batcher.epoch(train_set, labels, labels2),
+                        depth=max(2, self.prefetch_depth), place=place,
+                        extremes=extremes, buckets=(b,), stats=feed_stats)
+                    for batch in feed:
+                        self._key, sub = jax.random.split(self._key)
+                        self.params, self.opt_state, metrics = pipe_step(
+                            self.params, self.opt_state, sub, batch)
+                        device_metrics.append(metrics)
 
-                host_metrics = jax.device_get(device_metrics)
-                self.train_time = time.time() - t0
-                feed_stats.finish(self.train_time)
-                self.feed_stats_epochs.append(feed_stats.summary())
-                train_writer.feed_stats(feed_stats, epoch)
-            else:
-                # accumulate device arrays only — converting per step would force a
-                # host-device sync each batch and stall the async dispatch pipeline
-                step_in_epoch = 0
-                device_metrics = []
-                for batch in prefetch(batcher.epoch(train_set, labels, labels2),
-                                      self.prefetch_depth):
-                    batch.update(extremes)
-                    batch = self._place_batch(batch)
-                    self._key, sub = jax.random.split(self._key)
-                    self.params, self.opt_state, metrics = self._train_step(
-                        self.params, self.opt_state, sub, batch)
-                    step_in_epoch += 1
-                    device_metrics.append(metrics)
+                    host_metrics = jax.device_get(device_metrics)
+                    self.train_time = time.time() - t0
+                    feed_stats.finish(self.train_time)
+                    self.feed_stats_epochs.append(feed_stats.summary())
+                    train_writer.feed_stats(feed_stats, epoch)
+                else:
+                    # accumulate device arrays only — converting per step would force a
+                    # host-device sync each batch and stall the async dispatch pipeline
+                    step_in_epoch = 0
+                    device_metrics = []
+                    for batch in prefetch(batcher.epoch(train_set, labels, labels2),
+                                          self.prefetch_depth):
+                        batch.update(extremes)
+                        batch = self._place_batch(batch)
+                        self._key, sub = jax.random.split(self._key)
+                        self.params, self.opt_state, metrics = self._train_step(
+                            self.params, self.opt_state, sub, batch)
+                        step_in_epoch += 1
+                        device_metrics.append(metrics)
 
-                # one sync per epoch: pull all step metrics, then log/record on host
-                host_metrics = jax.device_get(device_metrics)
-                self.train_time = time.time() - t0
+                    # one sync per epoch: pull all step metrics, then log/record on host
+                    host_metrics = jax.device_get(device_metrics)
+                    self.train_time = time.time() - t0
             for i, m in enumerate(host_metrics):
                 m = {k: float(v) for k, v in m.items()}
                 # reference step key: (epoch-1)*num_batches + i (autoencoder.py:245)
@@ -567,7 +610,10 @@ class DenoisingAutoencoder:
             else:
                 ran_validation = False
             if self.checkpoint_every and epoch % self.checkpoint_every == 0:
-                self._save(epoch, blocking=False)
+                # fence=False: the save path device_gets the host copy itself
+                with telemetry.span("fit/checkpoint", fence=False,
+                                    args={"epoch": epoch}):
+                    self._save(epoch, blocking=False)
             self._last_epoch = epoch
             if getattr(self, "_stop_requested", False):
                 print(f"fit: stopping early after epoch {epoch} "
@@ -733,13 +779,16 @@ class DenoisingAutoencoder:
             return
 
         sums, rows = {}, 0.0
-        for batch in self._validation_batches(validation_set, validation_set_label):
-            batch = self._place_batch(batch)
-            metrics = self._eval_step(self.params, batch)
-            n = float(batch["row_valid"].sum())
-            for k, v in metrics.items():
-                sums[k] = sums.get(k, 0.0) + float(v) * n
-            rows += n
+        # default fence: the eval steps inside are device work
+        with telemetry.span("fit/validation", args={"epoch": epoch}):
+            for batch in self._validation_batches(validation_set,
+                                                  validation_set_label):
+                batch = self._place_batch(batch)
+                metrics = self._eval_step(self.params, batch)
+                n = float(batch["row_valid"].sum())
+                for k, v in metrics.items():
+                    sums[k] = sums.get(k, 0.0) + float(v) * n
+                rows += n
         means = {k: v / max(rows, 1.0) for k, v in sums.items()}
         val_writer.scalars(means, epoch)
 
@@ -787,10 +836,14 @@ class DenoisingAutoencoder:
         gather-accumulate. Dense inputs take the dense encode path unchanged."""
         if from_checkpoint or self.params is None:
             self._restore_latest()
-        if sp.issparse(data):
-            out = self._transform_sparse(data, batch_size)
-        else:
-            out = self._dense_encode_loop(data, batch_size)
+        # fence=False: both encode loops below copy their results to host
+        # numpy before returning, which is already a full device sync
+        with telemetry.span("transform", fence=False,
+                            args={"rows": int(data.shape[0])}):
+            if sp.issparse(data):
+                out = self._transform_sparse(data, batch_size)
+            else:
+                out = self._dense_encode_loop(data, batch_size)
         if save:
             np.save(os.path.join(self.data_dir, name), out)
             np.save(os.path.join(self.data_dir, "weights"), np.asarray(self.params["W"]))

@@ -24,6 +24,7 @@ import jax
 import numpy as np
 import scipy.sparse as sp
 
+from .. import telemetry
 from ..parallel.ep import (make_moe_train_step, moe_forward_dense,
                            moe_init_params, moe_loss_and_metrics)
 from ..train.optimizers import make_optimizer
@@ -111,8 +112,9 @@ class MoEDenoisingAutoencoder(DenoisingAutoencoder):
         # the [E, F, D] params fit a single device at this model family's scale
         self._eval_step = make_eval_step(self.config, loss_fn=self._loss_fn)
         config = self.config
-        self._encode_fn = jax.jit(
-            lambda p, x: moe_forward_dense(p, x, config)[0])
+        self._encode_fn = telemetry.instrument(
+            jax.jit(lambda p, x: moe_forward_dense(p, x, config)[0]),
+            "train/encode")
         self._sparse_encode_fn = None
 
     def _transform_sparse(self, data, batch_size):
@@ -145,8 +147,9 @@ class MoEDenoisingAutoencoder(DenoisingAutoencoder):
                                       self.n_experts)
         self.opt_state = self.optimizer.init(self.params)
         config = self.config
-        self._encode_fn = jax.jit(
-            lambda p, x: moe_forward_dense(p, x, config)[0])
+        self._encode_fn = telemetry.instrument(
+            jax.jit(lambda p, x: moe_forward_dense(p, x, config)[0]),
+            "train/encode")
         self._sparse_encode_fn = None
         path, _ = latest_checkpoint(model_path)
         self.params = load_params(path or model_path, self.params)

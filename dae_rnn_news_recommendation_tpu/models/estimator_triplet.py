@@ -64,6 +64,9 @@ class DenoisingAutoencoderTriplet(DenoisingAutoencoder):
         self._build(n_features, restore_previous_model)
         write_parameter_file(self.parameter_file, self._parameter_dict(),
                              append=restore_previous_model)
+        # run manifest, same contract as the base fit (telemetry/manifest.py)
+        self.run_manifest_path = os.path.join(self.tf_summary_dir,
+                                              "manifest.json")
 
         train_writer = MetricsWriter(os.path.join(self.tf_summary_dir, "train/"),
                                      self.use_tensorboard)

@@ -22,6 +22,7 @@ annotations; in 'shard' mode we pmean explicitly inside shard_map.
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import telemetry
 from ..train.step import loss_and_metrics
 from .mesh import get_mesh  # noqa: F401  (re-exported for the estimator)
 
@@ -104,15 +105,20 @@ def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
             raise ValueError("weight_update_sharding shards opt state over the "
                              "data axis; with a model axis the state already "
                              "shards with W — use one or the other")
-        return _make_global_step(config, optimizer, mesh, loss_fn, data_axis,
-                                 model_axis, donate,
-                                 weight_update_sharding=weight_update_sharding)
+        return telemetry.instrument(
+            _make_global_step(config, optimizer, mesh, loss_fn, data_axis,
+                              model_axis, donate,
+                              weight_update_sharding=weight_update_sharding),
+            "train/step")
     if mining_scope == "shard":
         if weight_update_sharding:
             raise ValueError("weight_update_sharding requires the jit/global "
                              "path (XLA derives the reduce_scatter); "
                              "mining_scope='shard' runs inside shard_map")
-        return _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate)
+        return telemetry.instrument(
+            _make_shard_step(config, optimizer, mesh, loss_fn, data_axis,
+                             donate),
+            "train/step")
     raise ValueError(f"unknown mining_scope: {mining_scope!r}")
 
 
@@ -216,7 +222,7 @@ def make_parallel_eval_step(config, mesh, mining_scope="global",
                 local_metrics, mesh=mesh, in_specs=(P(), specs), out_specs=P(),
             )(params, batch)
 
-        return shard_eval
+        return telemetry.instrument(shard_eval, "train/eval_step")
 
     if mining_scope != "global":
         raise ValueError(f"unknown mining_scope: {mining_scope!r}")
@@ -237,4 +243,4 @@ def make_parallel_eval_step(config, mesh, mining_scope="global",
                                  out_shardings=None)
         return cache[sig](params, batch)
 
-    return wrapper
+    return telemetry.instrument(wrapper, "train/eval_step")

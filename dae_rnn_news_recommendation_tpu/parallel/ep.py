@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .. import telemetry
 from ..models import dae_core
 from ..ops import losses, triplet
 from ..ops.initializers import xavier_init
@@ -313,7 +314,8 @@ def make_moe_train_step(config, optimizer, mesh, capacity_factor=2.0,
         params = jax.tree_util.tree_map(lambda a, u: a + u, params, updates)
         return params, opt_state, metrics
 
-    return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    return telemetry.instrument(
+        jax.jit(step, donate_argnums=(0, 1) if donate else ()), "train/step")
 
 
 def make_moe_encode_fn(config, mesh=None, capacity_factor=2.0, axis_name="expert"):

@@ -1,0 +1,42 @@
+"""Runtime telemetry: fenced span tracing, XLA event capture, run manifests.
+
+Quick tour (full story in docs/observability.md):
+
+    from dae_rnn_news_recommendation_tpu import telemetry
+
+    telemetry.enable()                      # start tracing + XLA listener
+    with telemetry.span("fit/epoch") as sp: # fenced timed region
+        out = step(params, opt, batch)
+        sp.fence_on(out)                    # span ends when `out` is real
+    tracer = telemetry.disable()
+    tracer.export("trace.json")             # Chrome trace; open in Perfetto
+
+    python -m dae_rnn_news_recommendation_tpu.telemetry report trace.json
+
+Spans default to ending with a device fence (a real host round trip), so a
+span's duration is compute time, not dispatch time — the jaxcheck R2
+invariant, built in. `telemetry.span(..., fence=False)` marks host-only
+regions; jaxcheck R6 flags device work inside them.
+"""
+
+from .manifest import build_manifest, read_manifest, write_manifest
+from .tracer import (Tracer, counters, current_tracer, device_fence, disable,
+                     enable, enabled, instrument, record_transfer, span)
+from .xla_events import XlaEventListener
+
+__all__ = [
+    "Tracer",
+    "XlaEventListener",
+    "build_manifest",
+    "counters",
+    "current_tracer",
+    "device_fence",
+    "disable",
+    "enable",
+    "enabled",
+    "instrument",
+    "read_manifest",
+    "record_transfer",
+    "span",
+    "write_manifest",
+]

@@ -1,0 +1,48 @@
+"""CLI: `python -m dae_rnn_news_recommendation_tpu.telemetry report ...`
+
+    report <trace.json> [--metrics PATH] [--bench PATH] [--json]
+
+Prints the per-span p50/p95/total table (with feed-stall and compile-count
+columns) from a trace exported by a traced fit; optionally joins metrics.jsonl
+scalars and reconciles a bench record's H2D probes against measured transfer
+counters. Exit codes: 0 report rendered, 1 trace had no span events,
+2 usage / unreadable input.
+"""
+
+import argparse
+import sys
+
+from .report import report
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m dae_rnn_news_recommendation_tpu.telemetry",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="command", required=True)
+    rep = sub.add_parser("report", help="render a per-span table from a "
+                                        "Chrome trace exported by a fit")
+    rep.add_argument("trace", help="trace.json exported by a traced fit")
+    rep.add_argument("--metrics", default=None,
+                     help="metrics.jsonl (or its directory) from the same "
+                          "run, for the FeedStats cross-check")
+    rep.add_argument("--bench", default=None,
+                     help="bench stdout JSON line or evidence sidecar, for "
+                          "the h2d probe-vs-measured reconciliation")
+    rep.add_argument("--json", action="store_true",
+                     help="emit the report as JSON instead of a table")
+    args = parser.parse_args(argv)
+
+    try:
+        text, code = report(args.trace, metrics_path=args.metrics,
+                            bench_path=args.bench, as_json=args.json)
+    except (OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    print(text)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

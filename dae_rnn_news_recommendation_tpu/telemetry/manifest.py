@@ -1,0 +1,95 @@
+"""Run manifests: make every metrics.jsonl / trace / bench artifact
+self-describing.
+
+A manifest is one JSON object written at fit start (models/estimator.py) and
+embedded in bench records (bench.py): enough provenance — config, device
+topology, library versions, git sha, feed mode, bucket set — that a number
+found in an artifact six months later can be tied to the code and hardware
+that produced it. Schema (versioned via the "schema" key; see
+docs/observability.md):
+
+    schema            int, currently 1
+    created_utc       ISO-8601 UTC timestamp
+    git_rev           HEAD sha of the repo checkout (or "unknown")
+    jax_version / numpy_version / python_version
+    backend           jax.default_backend() ("cpu" | "tpu" | ...)
+    process_index / process_count
+    devices           [{id, platform, kind}] for jax.devices()
+    feed_mode         "stream" | "pipelined" | "resident" | None
+    buckets           shape-bucket tuple the pipelined feed pads to, or None
+    config            the DAEConfig as a dict, or None
+    ...               anything passed via extra= (model class, batch size...)
+"""
+
+import dataclasses
+import json
+import os
+import platform as _platform
+import subprocess
+
+
+def _git_rev():
+    """HEAD sha of the checkout containing this package (same recipe as
+    bench.py's sidecar provenance); 'unknown' outside a git checkout."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        out = subprocess.run(["git", "-C", here, "rev-parse", "HEAD"],
+                             capture_output=True, text=True, timeout=15)
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def build_manifest(config=None, feed_mode=None, buckets=None, extra=None):
+    """Assemble the manifest dict. Device/topology fields degrade to None
+    rather than raising if the backend is unreachable — a manifest must never
+    be the thing that kills a run."""
+    import jax
+    import numpy as np
+
+    manifest = {
+        "schema": 1,
+        "git_rev": _git_rev(),
+        "jax_version": jax.__version__,
+        "numpy_version": np.__version__,
+        "python_version": _platform.python_version(),
+        "feed_mode": feed_mode,
+        "buckets": list(buckets) if buckets else None,
+    }
+    import datetime
+
+    manifest["created_utc"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    try:
+        manifest["backend"] = jax.default_backend()
+        manifest["process_index"] = jax.process_index()
+        manifest["process_count"] = jax.process_count()
+        manifest["devices"] = [
+            {"id": d.id, "platform": d.platform, "kind": d.device_kind}
+            for d in jax.devices()]
+    except Exception:
+        manifest.setdefault("backend", None)
+        manifest.setdefault("devices", None)
+    if config is not None:
+        manifest["config"] = (dataclasses.asdict(config)
+                              if dataclasses.is_dataclass(config)
+                              else dict(config))
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def write_manifest(path, manifest):
+    """Write `manifest` as JSON (atomic replace). Returns `path`."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(path):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)

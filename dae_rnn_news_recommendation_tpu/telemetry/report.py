@@ -1,0 +1,276 @@
+"""`telemetry report` — join a Chrome trace with metrics.jsonl and bench JSON.
+
+Reads the trace exported by a traced fit (models/estimator.py `trace=True` ->
+<tf_summary_dir>/trace.json) and prints a per-span table:
+
+    span        count  total s  p50 ms  p95 ms  stall%  compiles
+
+* stall% — fraction of the span's wall time the consumer spent blocked on the
+  feed queue: the overlap of `feed/wait` spans with this span's intervals
+  (the trace-side view of FeedStats.feed_stall_fraction).
+* compiles — XLA backend compiles whose event midpoint falls inside the span
+  (the captured jax.monitoring events; see xla_events.py).
+
+`--metrics` joins the per-epoch `feed/*` scalars from metrics.jsonl so the
+trace-derived stall can be cross-checked against the FeedStats numbers logged
+by the same run. `--bench` reconciles a bench record's
+`h2d_bandwidth_mbytes_per_sec` probes against the fence-measured transfer
+counters captured during that run (`extra.transfer_events`) — the measured
+answer to the README Performance stream-vs-probe discrepancy.
+"""
+
+import json
+import os
+
+
+# ------------------------------------------------------------------ loading
+
+def load_trace(path):
+    with open(path, encoding="utf-8") as f:
+        trace = json.load(f)
+    if isinstance(trace, list):  # bare-array Chrome trace flavor
+        trace = {"traceEvents": trace, "metadata": {}}
+    return trace
+
+
+def load_metrics(path):
+    """Records from metrics.jsonl. `path` may be the file itself or a
+    directory (looks for metrics.jsonl, then train/metrics.jsonl)."""
+    if os.path.isdir(path):
+        for sub in ("metrics.jsonl", os.path.join("train", "metrics.jsonl")):
+            cand = os.path.join(path, sub)
+            if os.path.exists(cand):
+                path = cand
+                break
+    records = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # a torn tail line must not kill the report
+    return records
+
+
+def load_bench(path):
+    """The `extra` dict of a bench record: accepts the bench stdout JSON line
+    (a {"metric", ..., "extra"} object), the evidence sidecar ({"record":
+    ...}), or a file of JSON lines containing either."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    candidates = []
+    try:
+        candidates.append(json.loads(text))
+    except json.JSONDecodeError:
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    candidates.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    for obj in candidates:
+        if "record" in obj and isinstance(obj["record"], dict):
+            obj = obj["record"]
+        if "extra" in obj:
+            return obj["extra"]
+    return None
+
+
+# -------------------------------------------------------------- aggregation
+
+def _percentile(sorted_vals, q):
+    """Nearest-rank percentile over a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(q / 100.0 * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+def _overlap_s(intervals, others):
+    """Total seconds of `others` intervals overlapping `intervals` (both in
+    µs)."""
+    total = 0.0
+    for a0, a1 in intervals:
+        for b0, b1 in others:
+            lo, hi = max(a0, b0), min(a1, b1)
+            if hi > lo:
+                total += hi - lo
+    return total / 1e6
+
+
+def span_table(trace):
+    """Aggregate the trace's X events into per-span rows (sorted by total
+    time, descending)."""
+    spans = [e for e in trace.get("traceEvents", []) if e.get("ph") == "X"]
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    wait_iv = [(e["ts"], e["ts"] + e["dur"])
+               for e in by_name.get("feed/wait", [])]
+    compile_mid = [e["ts"] + e["dur"] / 2.0
+                   for e in by_name.get("xla/backend_compile", [])]
+    rows = []
+    for name, events in by_name.items():
+        durs_ms = sorted(e["dur"] / 1e3 for e in events)
+        iv = [(e["ts"], e["ts"] + e["dur"]) for e in events]
+        total_s = sum(durs_ms) / 1e3
+        stall = (_overlap_s(iv, wait_iv) / total_s) if (
+            wait_iv and total_s > 0 and name != "feed/wait") else None
+        compiles = sum(1 for m in compile_mid
+                       if any(a0 <= m <= a1 for a0, a1 in iv))
+        rows.append({
+            "span": name, "count": len(events),
+            "total_s": round(total_s, 4),
+            "p50_ms": round(_percentile(durs_ms, 50), 3),
+            "p95_ms": round(_percentile(durs_ms, 95), 3),
+            "stall_fraction": (round(stall, 4)
+                               if stall is not None else None),
+            "compiles": compiles,
+        })
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
+def metrics_summary(records):
+    """Per-epoch feed scalars + cost trajectory out of metrics.jsonl."""
+    feed_stall = [(r["step"], r["value"]) for r in records
+                  if r.get("tag") == "feed/feed_stall_fraction"]
+    costs = [(r["step"], r["value"]) for r in records
+             if r.get("tag") == "cost"]
+    out = {"n_records": len(records)}
+    if feed_stall:
+        vals = [v for _, v in feed_stall]
+        out["feed_stall_fraction_mean"] = round(sum(vals) / len(vals), 4)
+        out["feed_stall_epochs"] = len(vals)
+    if costs:
+        out["cost_first"] = round(costs[0][1], 6)
+        out["cost_last"] = round(costs[-1][1], 6)
+    return out
+
+
+def bench_reconciliation(extra):
+    """The h2d story of one bench record, probes vs fence-measured feed.
+
+    `h2d_bandwidth_mbytes_per_sec` / `h2d_feed_bandwidth_mbytes_per_sec` are
+    synthetic device_put probes (bench._measure_h2d_bandwidth);
+    `encode_stream_implied_mbytes_per_sec` is what the encode stream's
+    throughput implies it moved; `transfer_events` is what the instrumented
+    pipelined feed *measured* moving its real batches (fenced spans,
+    bench._measure_feed_transfers)."""
+    if not extra:
+        return None
+    out = {}
+    for key in ("h2d_bandwidth_mbytes_per_sec",
+                "h2d_feed_bandwidth_mbytes_per_sec",
+                "encode_stream_implied_mbytes_per_sec"):
+        if key in extra:
+            out[key] = extra[key]
+    transfers = extra.get("transfer_events")
+    if transfers:
+        out["transfer_events"] = transfers
+        measured = transfers.get("h2d_feed_measured_mbytes_per_sec")
+        probe = extra.get("h2d_feed_bandwidth_mbytes_per_sec")
+        if measured and probe:
+            out["measured_vs_feed_probe"] = round(measured / probe, 3)
+    if "xla_events" in extra:
+        compiles = extra["xla_events"].get("xla/backend_compile", {})
+        out["xla_backend_compiles"] = compiles.get("count", 0)
+    if "manifest" in extra:
+        m = extra["manifest"]
+        out["provenance"] = {k: m.get(k) for k in
+                             ("git_rev", "backend", "created_utc")}
+    return out or None
+
+
+# ---------------------------------------------------------------- rendering
+
+_COLS = ("span", "count", "total_s", "p50_ms", "p95_ms",
+         "stall_fraction", "compiles")
+_HEADS = ("span", "count", "total s", "p50 ms", "p95 ms", "stall", "compiles")
+
+
+def _fmt_row(values, widths):
+    cells = []
+    for i, v in enumerate(values):
+        text = "-" if v is None else (f"{v:.3f}" if isinstance(v, float)
+                                      else str(v))
+        cells.append(text.ljust(widths[i]) if i == 0 else text.rjust(widths[i]))
+    return "  ".join(cells).rstrip()
+
+
+def render_text(rows, counters=None, manifest=None, metrics=None, bench=None):
+    lines = []
+    if manifest:
+        lines.append("run: git %s  backend=%s  feed=%s  created %s" % (
+            str(manifest.get("git_rev", "unknown"))[:12],
+            manifest.get("backend"), manifest.get("feed_mode"),
+            manifest.get("created_utc")))
+    table = [tuple(r[c] for c in _COLS) for r in rows]
+    widths = [max([len(_HEADS[i])] +
+                  [len("-" if v is None else
+                       (f"{v:.3f}" if isinstance(v, float) else str(v)))
+                   for v in (row[i] for row in table)])
+              for i in range(len(_COLS))]
+    lines.append(_fmt_row(_HEADS, widths))
+    for row in table:
+        lines.append(_fmt_row(row, widths))
+    if counters:
+        lines.append("")
+        lines.append("counters:")
+        for name, c in counters.items():
+            extra_bytes = (f"  {c['bytes'] / 1e6:.2f} MB"
+                           if "bytes" in c else "")
+            lines.append(f"  {name}: count={c['count']} "
+                         f"total={c['total_s']:.4f}s{extra_bytes}")
+    if metrics:
+        lines.append("")
+        lines.append("metrics.jsonl join:")
+        for k, v in metrics.items():
+            lines.append(f"  {k}: {v}")
+        stall_m = metrics.get("feed_stall_fraction_mean")
+        trace_stall = next((r["total_s"] for r in rows
+                            if r["span"] == "feed/wait"), None)
+        fit_total = next((r["total_s"] for r in rows
+                          if r["span"] == "fit/epoch"), None)
+        if stall_m is not None and trace_stall is not None and fit_total:
+            lines.append(
+                f"  trace-derived stall (feed/wait / fit/epoch): "
+                f"{trace_stall / fit_total:.4f} vs FeedStats {stall_m:.4f}")
+    if bench:
+        lines.append("")
+        lines.append("bench h2d reconciliation:")
+        for k, v in bench.items():
+            lines.append(f"  {k}: {v}")
+    return "\n".join(lines)
+
+
+def report(trace_path, metrics_path=None, bench_path=None, as_json=False):
+    """Build the report. Returns (text, exit_code)."""
+    trace = load_trace(trace_path)
+    rows = span_table(trace)
+    meta = trace.get("metadata", {}) or {}
+    counters = meta.get("counters") or None
+    manifest = meta.get("manifest") if isinstance(meta.get("manifest"), dict) \
+        else None
+    if manifest is None and isinstance(meta.get("manifest_path"), str):
+        try:
+            from .manifest import read_manifest
+
+            manifest = read_manifest(meta["manifest_path"])
+        except Exception:
+            manifest = None
+    metrics = metrics_summary(load_metrics(metrics_path)) if metrics_path \
+        else None
+    bench = bench_reconciliation(load_bench(bench_path)) if bench_path \
+        else None
+    if as_json:
+        return json.dumps({"spans": rows, "counters": counters,
+                           "manifest": manifest, "metrics": metrics,
+                           "bench": bench}, indent=2, default=str), 0
+    if not rows:
+        return "no span events in trace", 1
+    return render_text(rows, counters=counters, manifest=manifest,
+                       metrics=metrics, bench=bench), 0

@@ -1,0 +1,352 @@
+"""Fenced span tracer: nestable, thread-aware timed regions with Chrome-trace
+export.
+
+Why fencing is the core design point: under async dispatch a naive
+`perf_counter()` pair around device work measures *enqueue*, not compute —
+and on this repo's axon TPU tunnel even `block_until_ready` has been observed
+returning before the work finished (bench.py `_hard_sync`, measured
+2026-08-02). So every span here ends, by default, with a real host round trip
+(`jax.device_get` of a tiny slice): either of a value the span body nominated
+via `sp.fence_on(out)`, or of a one-element jitted token op enqueued at span
+exit (single-device executions complete in dispatch order, so fetching the
+token fences everything dispatched before it). That makes spans
+jaxcheck-R2-clean by construction, and jaxcheck recognizes `telemetry.span`
+as a fence (analysis/rules.py). `fence=False` opts a span out — for host-only
+regions (padding, queue waits); rule R6 flags `fence=False` spans that wrap
+device work without their own fence.
+
+Overhead when disabled: `span()` returns a shared null object and decorated
+functions take one extra `if` per call — no clock reads, no fence, no
+allocation. Tracing is a diagnosis mode: when enabled, fenced spans serialize
+with the device (that is what makes the numbers honest), so enable it to ask
+"where did the time go", not while benchmarking peak throughput.
+
+Thread-awareness: each span records the thread it ran on (`tid`), and thread
+names (e.g. the pipelined feed's "pipelined-feed" worker vs the consumer
+"MainThread") become Chrome-trace thread_name metadata — producer and
+consumer land on separate tracks in Perfetto.
+"""
+
+import functools
+import json
+import os
+import threading
+import time
+
+# virtual track for events that are not tied to a Python thread (XLA compile
+# durations reported by jax.monitoring); real thread idents are pointer-sized
+# so a tiny constant can never collide
+XLA_TRACK_TID = 1
+
+
+class Tracer:
+    """Collects Chrome-trace "X" (complete) events; thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._origin = time.perf_counter()
+        self._events = []
+        self._thread_names = {XLA_TRACK_TID: "xla-events"}
+        self.pid = os.getpid()
+        # filled by telemetry.disable() from the active XlaEventListener so an
+        # exported trace carries its counters; {} until then
+        self.counters = {}
+
+    def now_us(self):
+        return (time.perf_counter() - self._origin) * 1e6
+
+    def note_thread(self, tid, name):
+        if tid not in self._thread_names:
+            with self._lock:
+                self._thread_names.setdefault(tid, name)
+
+    def record_span(self, name, ts_us, dur_us, tid, cat="span", args=None):
+        event = {"name": name, "cat": cat, "ph": "X",
+                 "ts": round(ts_us, 3), "dur": round(dur_us, 3),
+                 "pid": self.pid, "tid": tid}
+        if args:
+            event["args"] = args
+        with self._lock:
+            self._events.append(event)
+
+    def record_xla_event(self, name, duration_s, args=None):
+        """A duration reported after the fact (jax.monitoring fires when the
+        event *ends*): place it at [now - duration, now] on the XLA track."""
+        dur_us = duration_s * 1e6
+        self.record_span(name, self.now_us() - dur_us, dur_us,
+                         XLA_TRACK_TID, cat="xla", args=args)
+
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def chrome_trace(self, metadata=None):
+        """The trace as a Chrome-trace-event JSON object (Perfetto-loadable):
+        thread_name/process_name "M" metadata first, then the "X" events
+        sorted by ts."""
+        with self._lock:
+            events = sorted(self._events,
+                            key=lambda e: (e["ts"], -e["dur"]))
+            names = dict(self._thread_names)
+        meta = [{"ph": "M", "pid": self.pid, "tid": 0,
+                 "name": "process_name", "args": {"name": "dae-telemetry"}}]
+        for tid, name in sorted(names.items()):
+            meta.append({"ph": "M", "pid": self.pid, "tid": tid,
+                         "name": "thread_name", "args": {"name": name}})
+        out = {"traceEvents": meta + events, "displayTimeUnit": "ms",
+               "metadata": {"counters": self.counters}}
+        if metadata:
+            out["metadata"].update(metadata)
+        return out
+
+    def export(self, path, metadata=None):
+        """Write the Chrome trace JSON (atomic replace) and return `path`."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(metadata), f)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+
+# ------------------------------------------------------------- module state
+
+_state_lock = threading.Lock()
+_enabled = False   # read on every span()/instrument() call: keep it a plain bool
+_tracer = None
+_listener = None
+_fence_fn = None
+
+
+def enabled():
+    return _enabled
+
+
+def current_tracer():
+    """The active Tracer, or None when tracing is disabled."""
+    return _tracer if _enabled else None
+
+
+def enable(tracer=None, xla_events=True):
+    """Turn tracing on. Returns the active Tracer (a fresh one unless given).
+    Idempotent: enabling while enabled returns the current tracer untouched.
+    `xla_events=True` also registers a `jax.monitoring` listener so XLA
+    compile durations land in the trace and in named counters."""
+    global _enabled, _tracer, _listener
+    with _state_lock:
+        if _enabled:
+            return _tracer
+        _tracer = tracer or Tracer()
+        if xla_events:
+            from .xla_events import XlaEventListener
+
+            _listener = XlaEventListener(tracer=_tracer).start()
+        _enabled = True
+        return _tracer
+
+
+def disable():
+    """Turn tracing off and return the Tracer (with `.counters` filled from
+    the XLA listener). No-op returning None when already disabled."""
+    global _enabled, _tracer, _listener
+    with _state_lock:
+        if not _enabled:
+            return None
+        _enabled = False
+        tracer, _tracer = _tracer, None
+        listener, _listener = _listener, None
+    if listener is not None:
+        listener.stop()
+        tracer.counters = listener.summary()
+    return tracer
+
+
+def counters():
+    """The active listener's counter dict ({} when tracing is off)."""
+    listener = _listener
+    return listener.summary() if (_enabled and listener) else {}
+
+
+def record_transfer(direction, duration_s, nbytes):
+    """Account a fence-measured host<->device transfer ('h2d'/'d2h') into the
+    active listener's counters. This jax version emits no transfer events via
+    jax.monitoring, so the pipelined feed's fenced H2D spans call this with
+    their measured durations instead (train/pipeline.py). No-op when tracing
+    is off or the span was unfenced (duration_s None)."""
+    listener = _listener
+    if listener is not None and duration_s is not None:
+        listener.record_transfer(direction, duration_s, nbytes)
+
+
+# ------------------------------------------------------------------ fencing
+
+def _fence_token():
+    """A tiny jitted op on the default device; fetching its output fences all
+    work dispatched to that device before it (single-device executions
+    complete in dispatch order — the bench.py `_hard_sync` lesson)."""
+    global _fence_fn
+    import jax
+    import jax.numpy as jnp
+
+    if _fence_fn is None:
+        _fence_fn = jax.jit(lambda: jnp.zeros((), jnp.int32) + 1)
+    return _fence_fn()
+
+
+def device_fence(x=None):
+    """Force device completion with a real host round trip.
+
+    With `x`: fetch a one-element slice of its last array leaf (the whole
+    executable that produced it completes atomically, so one element fences
+    the lot). Without: enqueue and fetch the token op. Never raises — a
+    telemetry fence must not be able to kill training."""
+    try:
+        import jax
+
+        if x is not None:
+            leaves = [leaf for leaf in jax.tree_util.tree_leaves(x)
+                      if hasattr(leaf, "dtype")]
+            if leaves:
+                leaf = leaves[-1]
+                jax.device_get(leaf.ravel()[:1] if getattr(leaf, "ndim", 0)
+                               else leaf)
+                return
+        jax.device_get(_fence_token())
+    except Exception:
+        pass
+
+
+# -------------------------------------------------------------------- spans
+
+class _NullSpan:
+    """What span() hands out while tracing is disabled: every operation is a
+    no-op, `fence_on` passes its value through, and decorating with it yields
+    a wrapper that re-checks enablement at call time (so decoration at import
+    time doesn't bake the disabled state in — the wrapper keeps the span's
+    name and fence mode for when tracing turns on). One instance per
+    (name, fence) pair, cached forever: span names are a static vocabulary,
+    so the disabled hot path is a dict hit, not an allocation."""
+
+    __slots__ = ("name", "fence")
+    duration_s = None
+
+    def __init__(self, name=None, fence=True):
+        self.name = name
+        self.fence = fence
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def fence_on(self, x):
+        return x
+
+    def set_args(self, **kw):
+        return self
+
+    def __call__(self, fn):
+        return _wrap(fn, self.name, self.fence)
+
+
+_null_spans = {}
+
+
+class _Span:
+    """One timed region: context manager and decorator.
+
+    `fence=True` (default): exit runs `device_fence` — on the value nominated
+    via `fence_on(x)` if any, else the token op. `fence=False`: host-only
+    region, no fence, and jaxcheck R6 will flag device work inside it.
+    `duration_s` holds the fenced duration after exit."""
+
+    __slots__ = ("name", "fence", "args", "_tracer", "_tid", "_ts_us", "_t0",
+                 "_fence_target", "duration_s")
+
+    def __init__(self, tracer, name, fence=True, args=None):
+        self.name = name
+        self.fence = fence
+        self.args = dict(args) if args else None
+        self._tracer = tracer
+        self._fence_target = None
+        self.duration_s = None
+
+    def __enter__(self):
+        self._tid = threading.get_ident()
+        self._tracer.note_thread(self._tid, threading.current_thread().name)
+        self._ts_us = self._tracer.now_us()
+        self._t0 = time.perf_counter()
+        return self
+
+    def fence_on(self, x):
+        """Nominate the device value whose completion defines this span's end
+        (e.g. the step's metrics, the staged batch). Returns `x`."""
+        self._fence_target = x
+        return x
+
+    def set_args(self, **kw):
+        self.args = {**(self.args or {}), **kw}
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.fence:
+            device_fence(self._fence_target)
+        self._fence_target = None  # never outlive the span (donation safety)
+        self.duration_s = time.perf_counter() - self._t0
+        args = self.args
+        if exc_type is not None:
+            args = {**(args or {}), "error": exc_type.__name__}
+        self._tracer.record_span(self.name, self._ts_us,
+                                 self.duration_s * 1e6, self._tid, args=args)
+        return False  # exceptions propagate; the span still recorded
+
+    def __call__(self, fn):
+        return _wrap(fn, self.name, self.fence)
+
+
+def span(name, fence=True, args=None):
+    """`with telemetry.span("fit/epoch") as sp:` — or `@telemetry.span(...)`.
+
+    Near-zero cost while tracing is disabled (returns a cached null object).
+    When enabled, the region ends with a device fence unless `fence=False`;
+    call `sp.fence_on(out)` inside the body to fence on a specific value."""
+    if not _enabled:
+        try:
+            return _null_spans[name, fence]
+        except KeyError:
+            return _null_spans.setdefault((name, fence),
+                                          _NullSpan(name, fence))
+    return _Span(_tracer, name, fence=fence, args=args)
+
+
+def _wrap(fn, name, fence):
+    span_name = name or getattr(fn, "__qualname__", repr(fn))
+
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        if not _enabled:
+            return fn(*a, **kw)
+        with _Span(_tracer, span_name, fence=fence):
+            return fn(*a, **kw)
+    return wrapper
+
+
+def instrument(fn, name, fence_result=True):
+    """Wrap a callable (typically a jitted step) so each call becomes a span
+    fenced on its *result* — the span measures compute, not dispatch. The
+    wrapper holds no reference to the call's arguments after it returns, so
+    donated inputs stay donation-safe. One extra `if` per call when tracing
+    is off."""
+
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        if not _enabled:
+            return fn(*a, **kw)
+        with _Span(_tracer, name, fence=fence_result) as sp:
+            out = fn(*a, **kw)
+            if fence_result:
+                sp.fence_on(out)
+            return out
+    return wrapper

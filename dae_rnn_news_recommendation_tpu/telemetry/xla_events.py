@@ -1,0 +1,116 @@
+"""jax.monitoring listener: XLA compile (and transfer) accounting.
+
+Generalizes `analysis/runtime.CompileWatcher` (which counts exactly one event
+kind for budget assertions) into named counters over *every* duration event
+jax reports, optionally mirrored into a Tracer as Chrome-trace events on a
+dedicated "xla-events" track.
+
+What this jax version (0.4.37) actually emits as duration events: the
+compile-path trio — jaxpr tracing, MLIR lowering, XLA backend compile
+(jax/_src/dispatch.py: /jax/core/compile/*_duration) — plus compilation-cache
+timings. It emits NO H2D/D2H transfer duration events; transfer accounting
+therefore comes from the pipelined feed's *fenced* `feed/h2d` spans
+(train/pipeline.py), which report their measured durations and byte counts
+here via `record_transfer`, landing in the same counter namespace
+(`transfer/h2d`). If a future jax adds transfer monitoring events, the
+catch-all listener picks them up with no code change.
+
+Counter shape: {name: {"count": n, "total_s": secs[, "bytes": n]}}. The
+compile trio also keeps short names (xla/backend_compile, xla/jaxpr_trace,
+xla/lower_to_mlir) for trace events and the report CLI's compile column.
+"""
+
+import threading
+
+from ..analysis.runtime import BACKEND_COMPILE_EVENT
+
+# jax/_src/dispatch.py event names -> short trace/report names
+EVENT_SHORT_NAMES = {
+    BACKEND_COMPILE_EVENT: "xla/backend_compile",
+    "/jax/core/compile/jaxpr_trace_duration": "xla/jaxpr_trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "xla/lower_to_mlir",
+}
+
+
+class XlaEventListener:
+    """Accumulate every jax.monitoring duration event into named counters.
+
+    Same registration mechanics as CompileWatcher: registration is
+    append-only in older jax, so the callback no-ops once stopped and the
+    private unregister hook is used where it exists. Listeners fire inside
+    jax's dispatch path — the callback must never raise."""
+
+    def __init__(self, tracer=None):
+        self._lock = threading.Lock()
+        self._active = False
+        self._registered = False
+        self._tracer = tracer
+        self._counters = {}
+
+    # -- accounting
+
+    def _note(self, name, duration_s, nbytes=None):
+        with self._lock:
+            c = self._counters.setdefault(name, {"count": 0, "total_s": 0.0})
+            c["count"] += 1
+            c["total_s"] += float(duration_s)
+            if nbytes is not None:
+                c["bytes"] = c.get("bytes", 0) + int(nbytes)
+
+    def _listener(self, event, duration_secs, **kwargs):
+        if not self._active:
+            return
+        try:
+            short = EVENT_SHORT_NAMES.get(event)
+            self._note(short or event, duration_secs)
+            if self._tracer is not None and short is not None:
+                self._tracer.record_xla_event(short, duration_secs)
+        except Exception:
+            pass  # never propagate into jax's dispatch path
+
+    def record_transfer(self, direction, duration_s, nbytes):
+        """Fence-measured transfer accounting (see module docstring):
+        `direction` is 'h2d' or 'd2h'; lands under counter transfer/<dir>."""
+        self._note(f"transfer/{direction}", duration_s, nbytes=nbytes)
+
+    # -- introspection
+
+    @property
+    def compile_count(self):
+        with self._lock:
+            return self._counters.get("xla/backend_compile",
+                                      {}).get("count", 0)
+
+    def summary(self):
+        """Counters as plain data, total_s rounded for JSON artifacts."""
+        with self._lock:
+            return {name: {**c, "total_s": round(c["total_s"], 6)}
+                    for name, c in sorted(self._counters.items())}
+
+    # -- lifecycle
+
+    def start(self):
+        import jax.monitoring
+
+        with self._lock:
+            self._counters = {}
+            self._active = True
+        if not self._registered:
+            jax.monitoring.register_event_duration_secs_listener(
+                self._listener)
+            self._registered = True
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._active = False
+        if self._registered:
+            try:
+                from jax._src import monitoring as _m
+
+                _m._unregister_event_duration_listener_by_callback(
+                    self._listener)
+                self._registered = False
+            except Exception:
+                pass  # stays registered but inactive; harmless
+        return self

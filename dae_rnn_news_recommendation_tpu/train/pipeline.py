@@ -52,6 +52,8 @@ import time
 import jax
 import numpy as np
 
+from .. import telemetry
+
 # Keys whose padding rows must be flagged invalid rather than zero-filled
 # (PaddedBatcher contract: padded labels never share a class with real rows).
 _PAD_MINUS_ONE = ("labels", "labels2")
@@ -205,15 +207,25 @@ class PipelinedFeed:
         """Host batch -> staged device batch (runs on the worker thread)."""
         if self._extremes:
             host_batch = {**host_batch, **self._extremes}
-        if self._buckets:
-            host_batch = bucket_pad(host_batch, self._buckets)
-        if self.stats is not None:
-            self.stats.note_bytes(sum(
-                np.asarray(v).nbytes for v in host_batch.values()))
+        with telemetry.span("feed/pad", fence=False):  # host-only work
+            if self._buckets:
+                host_batch = bucket_pad(host_batch, self._buckets)
+            nbytes = None
+            if self.stats is not None or telemetry.enabled():
+                nbytes = sum(np.asarray(v).nbytes
+                             for v in host_batch.values())
+            if self.stats is not None:
+                self.stats.note_bytes(nbytes)
         # device_put dispatches the H2D copy asynchronously; by the time the
         # consumer's step consumes this batch, the bytes are already (or still
-        # becoming) resident — that overlap is the whole point
-        return self._place(host_batch)
+        # becoming) resident — that overlap is the whole point. The span fences
+        # on the staged batch, so when tracing is on it measures the actual
+        # copy (and feeds the transfer/h2d counter); when tracing is off the
+        # dispatch stays fully async.
+        with telemetry.span("feed/h2d") as sp:
+            staged = sp.fence_on(self._place(host_batch))
+        telemetry.record_transfer("h2d", sp.duration_s, nbytes)
+        return staged
 
     def __iter__(self):
         q = queue.Queue(maxsize=self.depth)
@@ -245,7 +257,8 @@ class PipelinedFeed:
         try:
             while True:
                 t0 = time.perf_counter()
-                item = q.get()
+                with telemetry.span("feed/wait", fence=False):  # host block
+                    item = q.get()
                 if self.stats is not None and item is not end:
                     self.stats.note_wait(time.perf_counter() - t0)
                 if item is end:

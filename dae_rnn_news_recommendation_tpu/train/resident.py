@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
+from .. import telemetry
 from .step import loss_and_metrics
 
 # resident sparse feeds reuse the streaming feed's padded layout
@@ -150,4 +151,5 @@ def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics):
             body, (params, opt_state, key), (perm, row_valid))
         return params, opt_state, key, metrics
 
-    return jax.jit(epoch_fn, donate_argnums=(0, 1))
+    return telemetry.instrument(
+        jax.jit(epoch_fn, donate_argnums=(0, 1)), "train/resident_epoch")

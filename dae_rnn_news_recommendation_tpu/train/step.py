@@ -22,6 +22,7 @@ num_triplet (+ hardest pos/neg dot products for batch_hard).
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..models import dae_core
 from ..ops import corruption, losses, triplet
 
@@ -198,7 +199,11 @@ def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True,
 
         warnings.filterwarnings(
             "ignore", message="Some donated buffers were not usable")
-    return jax.jit(step, donate_argnums=donate_argnums)
+    # instrument() fences each call on its result (the returned params/opt
+    # state/metrics), so a traced span measures compute, not dispatch; when
+    # tracing is off the wrapper is one `if` per call
+    return telemetry.instrument(
+        jax.jit(step, donate_argnums=donate_argnums), "train/step")
 
 
 def make_eval_step(config, loss_fn=loss_and_metrics):
@@ -217,7 +222,7 @@ def make_eval_step(config, loss_fn=loss_and_metrics):
         _, metrics = loss_fn(params, batch, jax.random.PRNGKey(0), eval_cfg)
         return metrics
 
-    return jax.jit(step)
+    return telemetry.instrument(jax.jit(step), "train/eval_step")
 
 
 def make_encode_fn(config, donate=False):
@@ -226,4 +231,4 @@ def make_encode_fn(config, donate=False):
     def run(params, x):
         return dae_core.encode(params, x, config)
 
-    return jax.jit(run)
+    return telemetry.instrument(jax.jit(run), "train/encode")

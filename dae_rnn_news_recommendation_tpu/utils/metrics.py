@@ -52,23 +52,41 @@ class MetricsWriter:
 
     def histogram(self, tag, values, step):
         """Summary-stats histogram (the reference logs full TB histograms; JSONL keeps
-        min/max/mean/std/percentiles, TB sink keeps the full histogram)."""
-        v = np.asarray(values).ravel()
-        rec = {
-            "tag": tag, "step": int(step), "ts": time.time(),
-            "hist": {
-                "min": float(v.min()), "max": float(v.max()),
-                "mean": float(v.mean()), "std": float(v.std()),
-                "p5": float(np.percentile(v, 5)), "p50": float(np.percentile(v, 50)),
-                "p95": float(np.percentile(v, 95)), "n": int(v.size),
-            },
-        }
+        min/max/mean/std/percentiles, TB sink keeps the full histogram).
+
+        NaN/Inf entries are dropped from the stats (their count is recorded as
+        n_nonfinite) and an all-empty/all-nonfinite input logs a null hist —
+        a logging call must never kill training."""
+        v = np.asarray(values, np.float64).ravel()
+        finite = v[np.isfinite(v)]
+        if finite.size:
+            hist = {
+                "min": float(finite.min()), "max": float(finite.max()),
+                "mean": float(finite.mean()), "std": float(finite.std()),
+                "p5": float(np.percentile(finite, 5)),
+                "p50": float(np.percentile(finite, 50)),
+                "p95": float(np.percentile(finite, 95)), "n": int(finite.size),
+            }
+        else:
+            hist = {"min": None, "max": None, "mean": None, "std": None,
+                    "p5": None, "p50": None, "p95": None, "n": 0}
+        if finite.size != v.size:
+            hist["n_nonfinite"] = int(v.size - finite.size)
+        rec = {"tag": tag, "step": int(step), "ts": time.time(), "hist": hist}
         self._f.write(json.dumps(rec) + "\n")
         if self._tb is not None:
             self._tb.add_histogram(tag, v, int(step))
 
+    def flush(self):
+        if not self._f.closed:
+            self._f.flush()
+
     def close(self):
-        self._f.close()
+        """Flush and close both sinks; idempotent (fit paths close in
+        `finally:` and a later explicit close must not raise)."""
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
         if self._tb is not None:
             self._tb.close()
 

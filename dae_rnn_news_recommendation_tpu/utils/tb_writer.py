@@ -102,8 +102,17 @@ def _scalar_value(tag, value):
 
 def _histogram_proto(values, bins=30):
     """HistogramProto: min=1 max=2 num=3 sum=4 sum_squares=5 (doubles),
-    bucket_limit=6 bucket=7 (packed doubles)."""
+    bucket_limit=6 bucket=7 (packed doubles).
+
+    Non-finite entries are dropped before binning (np.histogram raises on
+    them) and an empty/all-nonfinite input encodes as a single empty bucket
+    — a logging call must never kill training."""
     v = np.asarray(values, np.float64).ravel()
+    v = v[np.isfinite(v)]
+    if v.size == 0:
+        return (_double(1, 0.0) + _double(2, 0.0) + _double(3, 0)
+                + _double(4, 0.0) + _double(5, 0.0)
+                + _packed_doubles(6, [1.0]) + _packed_doubles(7, [0.0]))
     counts, edges = np.histogram(v, bins=bins)
     return (
         _double(1, v.min()) + _double(2, v.max()) + _double(3, v.size)
@@ -146,6 +155,10 @@ class EventFileWriter:
             self._f.flush()
 
     def add_scalar(self, tag, value, step):
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            return  # unconvertible value: drop the point, never kill training
         self._write(_event(step=step, summary_value=_scalar_value(tag, value)))
 
     def add_histogram(self, tag, values, step, bins=30):

@@ -26,6 +26,20 @@ def timed_fenced(step, params, batch):
     return params, dt
 
 
+def timed_span_fenced(step, params, batch):
+    # a default-fenced telemetry span counts as the region's fence: its exit
+    # runs a real device fetch (telemetry/tracer.py), so no raw device_get
+    from dae_rnn_news_recommendation_tpu import telemetry
+
+    t0 = time.perf_counter()
+    with telemetry.span("bench/steps") as sp:
+        for _ in range(10):
+            params = step(params, batch)
+        sp.fence_on(params)
+    dt = time.perf_counter() - t0
+    return params, dt
+
+
 def watchdog_ok(deadline):
     # time.monotonic is this repo's watchdog convention, outside R2's scope
     start = time.monotonic()

@@ -1,0 +1,54 @@
+"""Planted R6 violation: a fence=False span wrapping device work with no
+fence in its body — the span's duration measures enqueue, not compute.
+
+The clean twins below must NOT be flagged: default-fenced spans, fence=False
+spans that end with their own device fetch, host-only regions, and spans in
+bench-style code whose timed region fences via the span itself.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu import telemetry
+
+
+def bad_unfenced_span(x):
+    with telemetry.span("step", fence=False):  # planted: R6
+        y = jnp.dot(x, x)
+    return y
+
+
+def ok_default_fence(x):
+    # fence defaults to True: span exit runs device_fence on the nominated out
+    with telemetry.span("step") as sp:
+        y = sp.fence_on(jnp.dot(x, x))
+    return y
+
+
+def ok_explicit_fetch(x):
+    # fence=False, but the body ends with its own host round trip
+    with telemetry.span("step", fence=False):
+        y = jnp.dot(x, x)
+        host = jax.device_get(y)
+    return host
+
+
+def ok_host_only(rows):
+    # fence=False on genuinely host-only work is exactly what the flag is for
+    with telemetry.span("feed/pad", fence=False):
+        padded = [r + [0] * (8 - len(r)) for r in rows]
+    return padded
+
+
+def ok_span_fences_timer(step, params, batch):
+    # R2 companion: the default-fenced span inside the timed region counts as
+    # the region's fence (no raw device_get needed)
+    t0 = time.perf_counter()
+    with telemetry.span("bench/steps") as sp:
+        for _ in range(10):
+            params = step(params, batch)
+        sp.fence_on(params)
+    dt = time.perf_counter() - t0
+    return params, dt

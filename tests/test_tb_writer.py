@@ -79,3 +79,77 @@ def test_metrics_writer_emits_tb_events(tmp_path):
     files = [p.name for p in tmp_path.iterdir()]
     assert "metrics.jsonl" in files
     assert any(f.startswith("events.out.tfevents.") for f in files)
+
+
+# --------------------------------------------------- hardening regressions
+
+def test_histogram_empty_and_nonfinite_do_not_raise(tmp_path):
+    """A logging call must never kill training: empty and NaN/Inf inputs
+    write well-framed records instead of raising (np.histogram raises on
+    both without the guard)."""
+    w = EventFileWriter(str(tmp_path))
+    w.add_histogram("empty", np.array([]), 1)
+    w.add_histogram("all_nan", np.full(4, np.nan), 2)
+    w.add_histogram("mixed", np.array([1.0, np.inf, 2.0, np.nan]), 3)
+    w.close()
+    [path] = tmp_path.iterdir()
+    blob = path.read_bytes()
+    off = n_records = 0
+    while off < len(blob):  # every record still frames + checksums cleanly
+        (length,) = struct.unpack("<Q", blob[off : off + 8])
+        payload = blob[off + 12 : off + 12 + length]
+        (data_crc,) = struct.unpack(
+            "<I", blob[off + 12 + length : off + 16 + length])
+        assert data_crc == masked_crc32c(payload)
+        off += 16 + length
+        n_records += 1
+    assert n_records == 4  # file_version + the three histograms
+
+
+def test_mixed_nonfinite_histogram_keeps_finite_stats(tmp_path):
+    loader_mod = pytest.importorskip(
+        "tensorboard.backend.event_processing.event_file_loader")
+
+    w = EventFileWriter(str(tmp_path))
+    w.add_histogram("mixed", np.array([1.0, np.inf, 3.0, np.nan]), 1)
+    w.close()
+    [path] = tmp_path.iterdir()
+    events = list(loader_mod.LegacyEventFileLoader(str(path)).Load())
+    [h] = [v.histo for e in events for v in e.summary.value
+           if v.HasField("histo")]
+    assert h.min == 1.0 and h.max == 3.0 and h.num == 2  # non-finite dropped
+
+
+def test_add_scalar_unconvertible_value_is_dropped(tmp_path):
+    w = EventFileWriter(str(tmp_path))
+    w.add_scalar("bad", None, 1)
+    w.add_scalar("bad", "not-a-number", 2)
+    w.add_scalar("good", 1.5, 3)
+    w.close()
+    [path] = tmp_path.iterdir()
+    blob = path.read_bytes()
+    off = n_records = 0
+    while off < len(blob):
+        (length,) = struct.unpack("<Q", blob[off : off + 8])
+        off += 16 + length
+        n_records += 1
+    assert n_records == 2  # file_version + the one good scalar
+
+
+def test_metrics_writer_histogram_hardening_and_idempotent_close(tmp_path):
+    import json
+
+    from dae_rnn_news_recommendation_tpu.utils import MetricsWriter
+
+    mw = MetricsWriter(str(tmp_path))
+    mw.histogram("empty", np.array([]), 1)
+    mw.histogram("mixed", np.array([1.0, np.nan, 3.0]), 2)
+    mw.flush()
+    records = [json.loads(line) for line in
+               (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    empty, mixed = records[0]["hist"], records[1]["hist"]
+    assert empty["n"] == 0 and empty["min"] is None
+    assert mixed["n"] == 2 and mixed["n_nonfinite"] == 1
+    assert mixed["min"] == 1.0 and mixed["max"] == 3.0
+    mw.close()
+    mw.close()  # idempotent: the fit paths close in finally + explicitly

@@ -1,0 +1,325 @@
+"""Telemetry subsystem (telemetry/): fenced span tracing, XLA event capture,
+run manifests, and the report CLI.
+
+Contracts pinned here:
+  * spans — nesting, decorator form, exception survival (the span records
+    with args.error and the exception propagates);
+  * disabled mode — span() hands out one shared null object and the
+    per-call overhead is unmeasurably small (a fit with trace off must not
+    pay for the instrumentation);
+  * export — the trace is valid Chrome-trace JSON: M metadata first, X
+    events with ts/dur/pid/tid, sorted by ts; Perfetto loads this shape;
+  * acceptance — a traced pipelined fit produces producer AND consumer
+    tracks, >= 1 captured XLA backend-compile event, and a manifest; the
+    report CLI renders the p50/p95 table from it (exit 0);
+  * counters — record_transfer lands under transfer/<dir> with bytes;
+  * manifest — build/write/read round trip with the documented schema keys.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+
+from dae_rnn_news_recommendation_tpu import telemetry
+from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+from dae_rnn_news_recommendation_tpu.telemetry.__main__ import main as cli_main
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off_guard():
+    """Every test must leave the module state disabled (fit paths disable in
+    `finally`; a leak here would silently slow every later test)."""
+    yield
+    assert not telemetry.enabled()
+    telemetry.disable()  # defensive: no-op when the assert above held
+
+
+# ------------------------------------------------------------------- spans
+
+def test_span_records_nested_regions_with_args():
+    tracer = telemetry.enable(xla_events=False)
+    try:
+        with telemetry.span("outer", fence=False, args={"k": 1}):
+            with telemetry.span("inner", fence=False):
+                time.sleep(0.001)
+    finally:
+        telemetry.disable()
+    by_name = {e["name"]: e for e in tracer.events()}
+    assert set(by_name) == {"outer", "inner"}
+    outer, inner = by_name["outer"], by_name["inner"]
+    assert outer["args"] == {"k": 1}
+    assert outer["ph"] == inner["ph"] == "X"
+    # containment: inner starts after outer and ends before it
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert inner["dur"] >= 1e3  # the 1ms sleep, in microseconds
+
+
+def test_span_decorator_and_instrument():
+    calls = []
+
+    @telemetry.span("decorated", fence=False)
+    def work(v):
+        calls.append(v)
+        return v * 2
+
+    stepped = telemetry.instrument(lambda x: x + 1, "stepped",
+                                   fence_result=False)
+    assert work(3) == 6 and stepped(1) == 2  # disabled: plain passthrough
+    tracer = telemetry.enable(xla_events=False)
+    try:
+        assert work(4) == 8
+        assert stepped(2) == 3
+    finally:
+        telemetry.disable()
+    names = [e["name"] for e in tracer.events()]
+    assert names == ["decorated", "stepped"]
+    assert calls == [3, 4]
+
+
+def test_span_survives_exception_and_propagates():
+    tracer = telemetry.enable(xla_events=False)
+    try:
+        with pytest.raises(ValueError):
+            with telemetry.span("doomed", fence=False):
+                raise ValueError("boom")
+    finally:
+        telemetry.disable()
+    [event] = tracer.events()
+    assert event["name"] == "doomed"
+    assert event["args"]["error"] == "ValueError"
+
+
+def test_fenced_span_measures_device_work():
+    """A default-fenced span around a jitted call must include the compute,
+    not just the enqueue: duration_s is a real positive fenced wall time and
+    fence_on returns its argument unchanged."""
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = np.ones((64, 64), np.float32)
+    f(x)  # compile outside the span
+    telemetry.enable(xla_events=False)
+    try:
+        with telemetry.span("device") as sman:
+            out = sman.fence_on(f(x))
+    finally:
+        telemetry.disable()
+    assert float(out) == 64.0 * 64 * 64
+    assert sman.duration_s is not None and sman.duration_s > 0
+
+
+# ----------------------------------------------------------- disabled mode
+
+def test_disabled_span_is_shared_null_and_cheap():
+    assert telemetry.span("a") is telemetry.span("a")  # cached, no alloc
+    sman = telemetry.span("c")
+    assert sman.fence_on("x") == "x"  # passthrough
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with telemetry.span("hot"):
+            pass
+    dt = time.perf_counter() - t0
+    # generous bound: ~5us/iter would still pass; the point is "no clock
+    # reads, no fence, no allocation" — a regression to per-call Span
+    # construction lands well above this
+    assert dt < 1.0, f"{n} disabled spans took {dt:.3f}s"
+
+
+def test_untraced_fit_writes_no_trace(workdir):
+    rng = np.random.default_rng(0)
+    x = (rng.uniform(size=(30, 24)) < 0.25).astype(np.float32)
+    labels = rng.integers(0, 4, 30).astype(np.int32)
+    m = DenoisingAutoencoder(
+        model_name="notrace", main_dir="notrace", n_components=6,
+        num_epochs=1, batch_size=10, seed=7, corr_type="masking",
+        corr_frac=0.3, loss_func="mean_squared", opt="ada_grad",
+        learning_rate=0.1, verbose=False, use_tensorboard=False,
+        results_root=str(workdir / "results"))
+    m.fit(x, train_set_label=labels)
+    assert m.trace_path is None
+    assert not telemetry.enabled()
+    # the manifest is written regardless: every run self-describes
+    assert m.run_manifest_path and os.path.exists(m.run_manifest_path)
+
+
+# ------------------------------------------------------------------ export
+
+def test_export_is_valid_sorted_chrome_trace(tmp_path):
+    tracer = telemetry.enable(xla_events=False)
+    try:
+        def worker():
+            with telemetry.span("producer", fence=False):
+                time.sleep(0.002)
+
+        t = threading.Thread(target=worker, name="feed-worker")
+        with telemetry.span("consumer", fence=False):
+            t.start()
+            t.join()
+    finally:
+        telemetry.disable()
+    path = tracer.export(str(tmp_path / "trace.json"), metadata={"run": "t"})
+    with open(path, encoding="utf-8") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert meta and xs and len(meta) + len(xs) == len(events)
+    assert {m["name"] for m in meta} >= {"process_name", "thread_name"}
+    thread_names = {m["args"]["name"] for m in meta
+                    if m["name"] == "thread_name"}
+    assert "feed-worker" in thread_names
+    for e in xs:  # every X event is a complete, placeable rectangle
+        assert {"name", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0
+    assert [e["ts"] for e in xs] == sorted(e["ts"] for e in xs)
+    # producer and consumer landed on distinct tracks
+    tids = {e["name"]: e["tid"] for e in xs}
+    assert tids["producer"] != tids["consumer"]
+    assert trace["metadata"]["run"] == "t"
+
+
+# -------------------------------------------------- traced fit + report CLI
+
+@pytest.fixture(scope="module")
+def traced_fit(tmp_path_factory):
+    """One traced pipelined fit shared by the acceptance tests below.
+    n_features=26 is unique to this module so the step compiles fresh here
+    and the trace captures >= 1 backend-compile event even when the whole
+    tier-1 suite shares the process."""
+    workdir = tmp_path_factory.mktemp("traced_fit")
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        rng = np.random.default_rng(0)
+        x = sp.csr_matrix(
+            (rng.uniform(size=(37, 26)) < 0.25).astype(np.float32))
+        labels = rng.integers(0, 4, 37).astype(np.int32)
+        m = DenoisingAutoencoder(
+            model_name="traced", main_dir="traced", n_components=6,
+            num_epochs=2, batch_size=10, seed=7, corr_type="masking",
+            corr_frac=0.3, loss_func="mean_squared", opt="ada_grad",
+            learning_rate=0.1, verbose=False, use_tensorboard=False,
+            feed="pipelined", trace=True,
+            results_root=str(workdir / "results"))
+        m.fit(x, train_set_label=labels, validation_set=x[:10],
+              validation_set_label=labels[:10])
+        with open(m.trace_path, encoding="utf-8") as f:
+            trace = json.load(f)
+        yield m, trace
+    finally:
+        os.chdir(cwd)
+
+
+def test_traced_pipelined_fit_has_producer_and_consumer_tracks(traced_fit):
+    m, trace = traced_fit
+    assert not telemetry.enabled()  # fit disabled tracing in finally
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    by_name = {}
+    for e in xs:
+        by_name.setdefault(e["name"], []).append(e)
+    # the whole path is covered: feed worker, consumer, epoch, validation
+    for required in ("fit/epoch", "feed/wait", "feed/pad", "feed/h2d",
+                     "train/step", "fit/validation", "train/eval_step"):
+        assert required in by_name, f"missing span {required}"
+    assert len(by_name["fit/epoch"]) == 2
+    # producer spans (worker thread) on a different track than the consumer
+    producer_tids = {e["tid"] for e in by_name["feed/h2d"]}
+    consumer_tids = {e["tid"] for e in by_name["train/step"]}
+    assert producer_tids and consumer_tids
+    assert producer_tids.isdisjoint(consumer_tids)
+    # >= 1 captured XLA compile event (fresh 26-feature step shape)
+    assert len(by_name.get("xla/backend_compile", [])) >= 1
+    # the fenced h2d spans accounted real transfers into the counters
+    h2d = trace["metadata"]["counters"].get("transfer/h2d")
+    assert h2d and h2d["count"] >= 1 and h2d["bytes"] > 0
+
+
+def test_traced_fit_writes_manifest(traced_fit):
+    m, trace = traced_fit
+    manifest = telemetry.read_manifest(m.run_manifest_path)
+    assert manifest["schema"] == 1
+    assert manifest["feed_mode"] == "pipelined"
+    assert manifest["buckets"] == [10]
+    assert manifest["jax_version"] == jax.__version__
+    assert manifest["config"]["n_components"] == 6
+    assert manifest["model"] == "DenoisingAutoencoder"
+    assert trace["metadata"]["manifest_path"] == m.run_manifest_path
+
+
+def test_report_cli_renders_table(traced_fit, capsys):
+    m, _ = traced_fit
+    metrics_dir = os.path.dirname(m.trace_path)
+    rc = cli_main(["report", m.trace_path, "--metrics", metrics_dir])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # table header + the load-bearing spans + the manifest provenance line
+    assert "p50 ms" in out and "compiles" in out
+    assert "train/step" in out and "feed/h2d" in out
+    assert "feed=pipelined" in out
+    assert "counters:" in out and "transfer/h2d" in out
+
+
+def test_report_cli_json_mode(traced_fit, capsys):
+    m, _ = traced_fit
+    rc = cli_main(["report", m.trace_path, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    spans = {r["span"] for r in report["spans"]}
+    assert {"fit/epoch", "train/step", "feed/h2d"} <= spans
+    assert report["manifest"]["feed_mode"] == "pipelined"
+
+
+def test_report_cli_error_exits(tmp_path, capsys):
+    assert cli_main(["report", str(tmp_path / "missing.json")]) == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text('{"traceEvents": []}')
+    assert cli_main(["report", str(empty)]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------- counters
+
+def test_record_transfer_counters():
+    telemetry.record_transfer("h2d", 0.5, 100)  # disabled: silent no-op
+    telemetry.enable()
+    try:
+        telemetry.record_transfer("h2d", 0.25, 1000)
+        telemetry.record_transfer("h2d", 0.25, 1000)
+        telemetry.record_transfer("d2h", 0.1, 10)
+        telemetry.record_transfer("h2d", None, 10)  # unfenced span: dropped
+        counters = telemetry.counters()
+    finally:
+        tracer = telemetry.disable()
+    assert counters["transfer/h2d"] == {
+        "count": 2, "total_s": 0.5, "bytes": 2000}
+    assert counters["transfer/d2h"]["count"] == 1
+    # disable() snapshots the counters onto the tracer for export
+    assert tracer.counters["transfer/h2d"]["bytes"] == 2000
+    assert telemetry.counters() == {}
+
+
+# ---------------------------------------------------------------- manifest
+
+def test_manifest_round_trip(tmp_path):
+    manifest = telemetry.build_manifest(
+        config={"n_components": 4}, feed_mode="stream",
+        extra={"note": "test"})
+    for key in ("schema", "created_utc", "git_rev", "jax_version",
+                "numpy_version", "python_version", "backend", "devices"):
+        assert key in manifest, key
+    assert manifest["feed_mode"] == "stream" and manifest["note"] == "test"
+    path = telemetry.write_manifest(str(tmp_path / "m.json"), manifest)
+    assert telemetry.read_manifest(path) == manifest
