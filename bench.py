@@ -303,9 +303,13 @@ def _bench_encode(jax, params, config, sz, via_dense=False, feeds=None,
 
 
 def _bench_train(jax, sz, batch_override=None, steps_override=None,
-                 triplet=True):
+                 triplet=True, extra_out=None):
     """Steady-state fit() hot loop: batch_all mining at the reference default
     shape. `batch_override` runs the same step at a different batch.
+    `extra_out`, when given, receives the final step's health/* sentinel
+    flags under "train_health" (fetched once, outside the timed region): a
+    NaN'd bench run must say so in its own record instead of reporting a
+    healthy-looking throughput.
     `triplet=False` drops the mining term: batch_all costs O(B^2) FLOPs per
     article, so at large B mining dominates and the large-batch figure must be
     reconstruction-only to say anything about the MXU matmul path."""
@@ -350,6 +354,11 @@ def _bench_train(jax, sz, batch_override=None, steps_override=None,
         params, opt_state, metrics = step(params, opt_state, sub, batch)
     _hard_sync(jax, metrics)
     dt = time.perf_counter() - t0
+    if extra_out is not None:
+        host_health = jax.device_get(
+            {k: v for k, v in metrics.items() if k.startswith("health/")})
+        extra_out["train_health"] = {k: round(float(v), 6)
+                                     for k, v in host_health.items()}
     return n_steps * tb / dt
 
 
@@ -722,7 +731,7 @@ def child_main():
                          "headline when evidence/bench_tpu.json exists")
     train_aps = None
     try:
-        train_aps = _bench_train(jax, sz)
+        train_aps = _bench_train(jax, sz, extra_out=extra)
         extra["train_articles_per_sec"] = round(train_aps, 1)
         extra["train_shape"] = (f"batch {sz['train_batch']}, {F}->{D}, "
                                 "batch_all+adagrad")

@@ -1,4 +1,4 @@
-"""jaxcheck rules R1-R5 — AST checkers for the JAX hazard classes this repo
+"""jaxcheck rules R1-R7 — AST checkers for the JAX hazard classes this repo
 has been bitten by (see docs/jaxcheck.md for the catalog with in-repo
 examples of each).
 
@@ -870,3 +870,133 @@ def check_r6(ctx):
                     "enqueue, not compute; drop fence=False, call "
                     "sp.fence_on(out), or end with jax.device_get"))
     return out
+
+
+# ------------------------------------------------------------------- R7
+
+_HOST_SCALAR_CASTS = {"float", "int", "bool"}
+
+
+def _r7_conversions(ctx, node, tainted):
+    """Findings for host conversions of tainted names anywhere under `node`
+    (one expression or one simple statement). Comprehensions over a tainted
+    container taint their element variables (`float(v) for k, v in
+    metrics.items()` is still a per-step sync)."""
+    if not tainted:
+        return []
+    local = set(tainted)
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            for gen in sub.generators:
+                if names_in(gen.iter) & local:
+                    local |= names_in(gen.target)
+    out = []
+    fix = ("accumulate the device metrics and fetch once per epoch with "
+           "jax.device_get")
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        if isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in ("item", "tolist") and \
+                names_in(sub.func.value) & local:
+            out.append(ctx.finding(
+                sub, f"per-step `.{sub.func.attr}()` on a jitted-step output "
+                f"inside the training loop blocks on the device every "
+                f"iteration — {fix}"))
+            continue
+        name = call_name(sub)
+        if name in (_HOST_SCALAR_CASTS | _HOST_MATERIALIZERS) and sub.args \
+                and names_in(sub.args[0]) & local:
+            out.append(ctx.finding(
+                sub, f"per-step `{name}()` on a jitted-step output inside "
+                f"the training loop forces a device sync every iteration, "
+                f"stalling async dispatch — {fix}"))
+    return out
+
+
+def _r7_scan(ctx, stmts, jitted, tainted):
+    """Linear taint scan over one loop body. Seeds: an Assign whose value
+    calls a jitted callable with carried state (some target name is also an
+    argument — `params, opt_state, metrics = step(params, opt_state, ...)`),
+    the signature of an async-dispatch training loop. Assignment from
+    jax.device_get is the sanctioned batched fetch and clears its targets."""
+    out = []
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(stmt, (ast.For, ast.While, ast.If, ast.With, ast.Try)):
+            headers = []
+            if isinstance(stmt, ast.For):
+                headers = [stmt.iter]
+            elif isinstance(stmt, (ast.While, ast.If)):
+                headers = [stmt.test]
+            elif isinstance(stmt, ast.With):
+                headers = [i.context_expr for i in stmt.items]
+            for h in headers:
+                out.extend(_r7_conversions(ctx, h, tainted))
+            inner = tainted
+            if isinstance(stmt, ast.For) and names_in(stmt.iter) & tainted:
+                # iterating a tainted container taints the loop variable
+                inner = tainted | names_in(stmt.target)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list) and sub and \
+                        isinstance(sub[0], ast.stmt):
+                    out.extend(_r7_scan(ctx, sub, jitted, inner))
+            for h in getattr(stmt, "handlers", None) or []:
+                out.extend(_r7_scan(ctx, h.body, jitted, inner))
+            continue
+        out.extend(_r7_conversions(ctx, stmt, tainted))
+        if not isinstance(stmt, ast.Assign):
+            continue
+        value = stmt.value
+        targets = assign_target_names(stmt)
+        vname = call_name(value)
+        short = vname.split(".")[-1] if vname else None
+        if vname in _DEVICE_GET or short == "device_get":
+            tainted -= targets  # the sanctioned once-per-epoch fetch
+        elif vname in jitted:
+            arg_names = set()
+            for a in value.args:
+                d = dotted(a)
+                if d:
+                    arg_names.add(d)
+            if targets & arg_names:
+                tainted |= targets  # carried state: async pipeline to protect
+            else:
+                tainted -= targets
+        elif names_in(value) & tainted:
+            tainted |= targets  # propagation through plain rebinding
+        else:
+            tainted -= targets
+    return out
+
+
+@rule("R7", "per-step host conversion of jitted-step outputs in a training "
+            "loop")
+def check_r7(ctx):
+    """A loop that threads state through a jitted step
+    (`params, opt_state, metrics = step(params, opt_state, key, batch)`)
+    runs ahead of the device: the returned metrics are async futures.
+    Converting them to host values (`float()`, `int()`, `np.asarray`,
+    `.item()`, `.tolist()`) INSIDE the loop forces a device->host sync every
+    step — the stall the in-graph sentinel (telemetry/health.py) exists to
+    avoid. Fix: append the device metrics to a list and `jax.device_get`
+    the whole list once per epoch (that assignment clears the taint here);
+    the health flags ride the same fetch for free."""
+    jitted = set(_jitted_callables(ctx.tree))
+    if not jitted:
+        return []
+    out = []
+    roots = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))]
+    for root in roots:
+        for node in scope_walk(root):
+            if isinstance(node, (ast.For, ast.While)):
+                out.extend(_r7_scan(ctx, node.body, jitted, set()))
+    uniq = {}
+    for f in out:
+        uniq[(f.line, f.message)] = f
+    return list(uniq.values())

@@ -66,7 +66,9 @@ class DenoisingAutoencoder:
                  use_tensorboard=True, n_components=None, profile=False,
                  prefetch_depth=2, keep_checkpoint_max=0, sparse_feed=True,
                  weight_update_sharding=False, resident_feed="auto",
-                 resident_budget_bytes=2 << 30, feed=None, trace=False):
+                 resident_budget_bytes=2 << 30, feed=None, trace=False,
+                 health_abort=False, health_window=256,
+                 health_divergence=10.0):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
 
         :param n_components: explicit code size; overrides the compress_factor
@@ -155,6 +157,19 @@ class DenoisingAutoencoder:
         self.trace = trace
         self.trace_path = None
         self.run_manifest_path = None
+        # model-health flight recorder (telemetry/recorder.py): every fit
+        # feeds its per-step metrics (which carry the in-graph sentinel flags,
+        # telemetry/health.py) into a bounded ring; on NaN/Inf, divergence
+        # (cost > health_divergence x EMA), or an uncaught exception, a
+        # diagnostics bundle lands at health_bundle_path. health_abort=True
+        # additionally stops fit at the epoch boundary where the anomaly is
+        # detected (detection granularity == the once-per-epoch metric fetch);
+        # the default only records, so training behavior is unchanged.
+        self.health_abort = health_abort
+        self.health_window = health_window
+        self.health_divergence = health_divergence
+        self.health_bundle_path = None
+        self.health_status = None
 
         assert isinstance(self.verbose_step, int)
         assert self.verbose >= 0
@@ -406,11 +421,23 @@ class DenoisingAutoencoder:
         tele_owner = self.trace and not telemetry.enabled()
         if tele_owner:
             telemetry.enable()
+        # fresh flight recorder per fit — anomaly state must not leak between
+        # runs of the same estimator instance
+        self._recorder = telemetry.FlightRecorder(
+            capacity=self.health_window,
+            divergence_factor=self.health_divergence)
+        self._health_stop = False
         try:
             with self._graceful_stop():
                 self._train_loop_inner(train_set, train_set_label, validation_set,
                                        validation_set_label, batcher, extremes,
                                        train_writer, val_writer)
+        except Exception as exc:
+            # crash path: the bundle is often the only artifact a dead run
+            # leaves behind — dump it, then re-raise unchanged
+            self._recorder.note_exception(exc)
+            self._dump_health_bundle()
+            raise
         finally:
             if tele_owner:
                 tracer = telemetry.disable()
@@ -424,6 +451,29 @@ class DenoisingAutoencoder:
                         pass  # telemetry must never kill a finished fit
             if self.profile:
                 jax.profiler.stop_trace()
+
+    def _dump_health_bundle(self, reason=None):
+        """Write the flight-recorder diagnostics bundle next to the TB events
+        (telemetry/recorder.py). Attaches the run manifest and, when tracing
+        is live, the trace tail. Never raises — called from crash paths."""
+        rec = getattr(self, "_recorder", None)
+        if rec is None:
+            return None
+        trace_tail = None
+        tracer = telemetry.current_tracer()
+        if tracer is not None:
+            try:
+                trace_tail = tracer.events()[-64:]
+            except Exception:
+                trace_tail = None
+        path = rec.dump(
+            os.path.join(self.tf_summary_dir, "health_bundle.json"),
+            reason=reason, manifest_path=self.run_manifest_path,
+            trace_tail=trace_tail)
+        if path is not None:
+            self.health_bundle_path = path
+        self.health_status = rec.status
+        return path
 
     def _graceful_stop(self):
         """SIGTERM/SIGINT during fit request a graceful stop: the current epoch
@@ -562,6 +612,9 @@ class DenoisingAutoencoder:
                         depth=max(2, self.prefetch_depth), place=place,
                         extremes=extremes, buckets=(b,), stats=feed_stats)
                     for batch in feed:
+                        if self._recorder.batch_signature is None:
+                            # device-resident here: shape/dtype only
+                            self._recorder.note_batch_signature(batch)
                         self._key, sub = jax.random.split(self._key)
                         self.params, self.opt_state, metrics = pipe_step(
                             self.params, self.opt_state, sub, batch)
@@ -580,6 +633,10 @@ class DenoisingAutoencoder:
                     for batch in prefetch(batcher.epoch(train_set, labels, labels2),
                                           self.prefetch_depth):
                         batch.update(extremes)
+                        if self._recorder.batch_signature is None:
+                            # host-side batch stats while the arrays are still
+                            # numpy (once per fit; ties a bundle to its feed)
+                            self._recorder.note_batch_signature(batch)
                         batch = self._place_batch(batch)
                         self._key, sub = jax.random.split(self._key)
                         self.params, self.opt_state, metrics = self._train_step(
@@ -594,6 +651,17 @@ class DenoisingAutoencoder:
                 m = {k: float(v) for k, v in m.items()}
                 # reference step key: (epoch-1)*num_batches + i (autoencoder.py:245)
                 gstep = (epoch - 1) * n_batches + i + 1
+                bad = self._recorder.record(gstep, m)
+                if bad is not None:
+                    # first anomaly of the fit: dump the bundle now, while the
+                    # ring still holds the steps leading into it
+                    self._dump_health_bundle(bad)
+                    if self.verbose:
+                        print(f"fit: health anomaly detected — {bad} "
+                              f"(bundle: {self.health_bundle_path})",
+                              flush=True)
+                    if self.health_abort:
+                        self._health_stop = True
                 self.train_cost_batch[0].append(m["cost"])
                 if "triplet_loss" in m:
                     self.train_cost_batch[1].append(m.get("autoencoder_loss", m["cost"]))
@@ -615,6 +683,11 @@ class DenoisingAutoencoder:
                                     args={"epoch": epoch}):
                     self._save(epoch, blocking=False)
             self._last_epoch = epoch
+            if getattr(self, "_health_stop", False):
+                print(f"fit: aborting after epoch {epoch} (health_abort: "
+                      f"{self._recorder.first_bad_reason}); checkpointing",
+                      flush=True)
+                break
             if getattr(self, "_stop_requested", False):
                 print(f"fit: stopping early after epoch {epoch} "
                       "(signal received); checkpointing", flush=True)
@@ -806,22 +879,25 @@ class DenoisingAutoencoder:
         restore wait for in-flight writes first."""
         state = {"params": self.params, "opt_state": self.opt_state,
                  "epoch": np.asarray(epoch)}
+        rec = getattr(self, "_recorder", None)
+        health = rec.snapshot() if rec is not None else None
         if getattr(self, "_multiprocess", False):
             # pod path: one SHARED checkpoint dir, every process participates
             # in the collective orbax save of the global arrays (blocking —
             # a background thread must not issue collectives out of order)
             if getattr(self, "_async_ckpt", None) is not None:
                 self._async_ckpt.wait()
-            save_checkpoint(self.model_path, state, epoch, multiprocess=True)
+            save_checkpoint(self.model_path, state, epoch, multiprocess=True,
+                            health=health)
             return
         if getattr(self, "_async_ckpt", None) is None:
             self._async_ckpt = AsyncCheckpointer()
         if not blocking:
             self._async_ckpt.save(self.model_path, state, epoch,
-                                  keep=self.keep_checkpoint_max)
+                                  keep=self.keep_checkpoint_max, health=health)
             return
         self._async_ckpt.wait()
-        save_checkpoint(self.model_path, state, epoch)
+        save_checkpoint(self.model_path, state, epoch, health=health)
         if self.keep_checkpoint_max:
             prune_checkpoints(self.model_path, self.keep_checkpoint_max)
 

@@ -121,8 +121,9 @@ class StackedDenoisingAutoencoder:
                     loop_key, sub = jax.random.split(loop_key)
                     params, opt_state, metrics = step(params, opt_state, sub, batch)
             if self.verbose:
+                final_cost = jax.device_get(metrics["cost"])
                 print(f"layer {li}: {n_in}->{n_out} trained in "
-                      f"{time.time()-t0:.1f}s, final cost {float(metrics['cost']):.4f}")
+                      f"{time.time()-t0:.1f}s, final cost {float(final_cost):.4f}")
             self.configs.append(cfg)
             self.params.append(params)
             rep = self._encode_layer(li, rep)
@@ -202,7 +203,8 @@ class StackedDenoisingAutoencoder:
             for batch in batcher.epoch(X):
                 layer_params, opt_state, last = step(layer_params, opt_state, batch)
             if self.verbose and last is not None:
-                print(f"finetune epoch {epoch+1}: loss={float(last):.4f}")
+                loss_host = jax.device_get(last)
+                print(f"finetune epoch {epoch+1}: loss={float(loss_host):.4f}")
         self.params = list(layer_params)
         self.fit_representation_ = None  # stale: weights changed
         return self

@@ -23,6 +23,7 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import telemetry
+from ..telemetry.health import sentinel_metrics
 from ..train.step import loss_and_metrics
 from .mesh import get_mesh  # noqa: F401  (re-exported for the estimator)
 
@@ -91,7 +92,7 @@ def batch_shardings(mesh, keys, data_axis="data", model_axis=None):
 def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
                              loss_fn=loss_and_metrics, data_axis="data",
                              model_axis=None, donate=True,
-                             weight_update_sharding=False):
+                             weight_update_sharding=False, health=True):
     """Returns step(params, opt_state, key, batch) -> (params, opt_state, metrics).
 
     Inputs may be ordinary host arrays; jit's in_shardings place them on the mesh.
@@ -99,6 +100,10 @@ def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
     :param weight_update_sharding: shard optimizer state over the data axis
         (opt_state_shardings) — 'global' mining scope on a 1-D data mesh only
         (with a model axis the state follows W's own sharding instead).
+    :param health: merge the numeric sentinel (telemetry/health.py) into the
+        returned metrics. Norms are over the GLOBAL grads/updates in both
+        mining scopes (the sentinel runs outside shard_map, after the update),
+        so the flags mean the same thing on any mesh.
     """
     if mining_scope == "global":
         if weight_update_sharding and model_axis is not None:
@@ -108,7 +113,8 @@ def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
         return telemetry.instrument(
             _make_global_step(config, optimizer, mesh, loss_fn, data_axis,
                               model_axis, donate,
-                              weight_update_sharding=weight_update_sharding),
+                              weight_update_sharding=weight_update_sharding,
+                              health=health),
             "train/step")
     if mining_scope == "shard":
         if weight_update_sharding:
@@ -117,17 +123,20 @@ def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
                              "mining_scope='shard' runs inside shard_map")
         return telemetry.instrument(
             _make_shard_step(config, optimizer, mesh, loss_fn, data_axis,
-                             donate),
+                             donate, health=health),
             "train/step")
     raise ValueError(f"unknown mining_scope: {mining_scope!r}")
 
 
 def _make_global_step(config, optimizer, mesh, loss_fn, data_axis, model_axis,
-                      donate, weight_update_sharding=False):
+                      donate, weight_update_sharding=False, health=True):
     def step(params, opt_state, key, batch):
         (cost, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, batch, key, config)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if health:
+            metrics = {**metrics,
+                       **sentinel_metrics(cost, grads, updates, params)}
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, metrics
 
@@ -154,7 +163,8 @@ def _make_global_step(config, optimizer, mesh, loss_fn, data_axis, model_axis,
     return wrapper
 
 
-def _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate):
+def _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate,
+                     health=True):
     n_shards = mesh.devices.size
 
     def local_loss(params, batch, keys):
@@ -181,6 +191,11 @@ def _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate):
 
         (cost, metrics), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if health:
+            # outside shard_map: grads are already pmean'd, so these are
+            # global-norm flags — identical semantics to the 'global' scope
+            metrics = {**metrics,
+                       **sentinel_metrics(cost, grads, updates, params)}
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, metrics
 

@@ -37,6 +37,7 @@ from .. import telemetry
 from ..models import dae_core
 from ..ops import losses, triplet
 from ..ops.initializers import xavier_init
+from ..telemetry.health import embedding_health, mining_health, sentinel_metrics
 from ..train.step import materialize_x
 from . import mining
 from .dp import _key_spec
@@ -235,6 +236,7 @@ def moe_loss_and_metrics(params, batch, key, config, router_weight=0.01,
                 batch["labels"], h, row_valid=valid)
             ae_loss = losses.weighted_loss(x, y, config.loss_func,
                                            weight=data_weight, row_valid=valid)
+            health = mining_health(data_weight, fraction, row_valid=valid)
         else:
             # global mining, anchor-partitioned: gather only the small [B, D]
             # codes + labels; each device mines ITS rows as anchors (1/E of the
@@ -249,11 +251,15 @@ def moe_loss_and_metrics(params, batch, key, config, router_weight=0.01,
             per_row = losses.reconstruction_loss_per_row(x, y, config.loss_func)
             ae_loss = _global_weighted_mean(per_row, data_weight_local * valid,
                                             axis_name)
+            # per-shard data_weight stats; the step's pmean over the expert
+            # axis turns them into the global-batch means the dense path
+            # reports (means of per-shard means over equal-size shards)
+            health = mining_health(data_weight_local, fraction, row_valid=valid)
         cost = ae_loss + config.alpha * t_loss + router_weight * aux
         metrics = {"cost": cost, "autoencoder_loss": ae_loss,
                    "triplet_loss": t_loss, "fraction_triplet": fraction,
                    "num_triplet": num, "router_aux": aux,
-                   "routed_fraction": routed_fraction, **extras}
+                   "routed_fraction": routed_fraction, **extras, **health}
     else:
         if axis_name is None:
             ae_loss = losses.weighted_loss(x, y, config.loss_func,
@@ -264,16 +270,22 @@ def moe_loss_and_metrics(params, batch, key, config, router_weight=0.01,
         cost = ae_loss + router_weight * aux
         metrics = {"cost": cost, "autoencoder_loss": ae_loss, "router_aux": aux,
                    "routed_fraction": routed_fraction}
+    # embedding health over this shard's codes (routed mode: per-shard stats,
+    # pmean'd by the step; capacity-dropped rows are masked out via `valid`)
+    metrics.update(embedding_health(h, row_valid=valid))
     return cost, metrics
 
 
 def make_moe_train_step(config, optimizer, mesh, capacity_factor=2.0,
-                        router_weight=0.01, axis_name="expert", donate=True):
+                        router_weight=0.01, axis_name="expert", donate=True,
+                        health=True):
     """Jitted EP train step over `mesh` (one expert per device along `axis_name`).
 
     Batch rows are sharded over the expert axis (dp rides the same axis); expert
     params are sharded one-per-device; the gate is replicated (its gradient
-    transposes to a psum). Returns step(params, opt_state, key, batch)."""
+    transposes to a psum). Returns step(params, opt_state, key, batch).
+    `health=True` adds the numeric sentinel (telemetry/health.py) over the
+    global (post-shard_map) grads/updates."""
     n_experts = mesh.shape[axis_name]
 
     def step(params, opt_state, key, batch):
@@ -311,6 +323,9 @@ def make_moe_train_step(config, optimizer, mesh, capacity_factor=2.0,
 
         (cost, metrics), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if health:
+            metrics = {**metrics,
+                       **sentinel_metrics(cost, grads, updates, params)}
         params = jax.tree_util.tree_map(lambda a, u: a + u, params, updates)
         return params, opt_state, metrics
 

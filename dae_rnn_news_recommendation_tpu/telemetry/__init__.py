@@ -19,12 +19,15 @@ invariant, built in. `telemetry.span(..., fence=False)` marks host-only
 regions; jaxcheck R6 flags device work inside them.
 """
 
+from .health import embedding_health, mining_health, sentinel_metrics
 from .manifest import build_manifest, read_manifest, write_manifest
+from .recorder import FlightRecorder, summarize_batch
 from .tracer import (Tracer, counters, current_tracer, device_fence, disable,
                      enable, enabled, instrument, record_transfer, span)
 from .xla_events import XlaEventListener
 
 __all__ = [
+    "FlightRecorder",
     "Tracer",
     "XlaEventListener",
     "build_manifest",
@@ -32,11 +35,15 @@ __all__ = [
     "current_tracer",
     "device_fence",
     "disable",
+    "embedding_health",
     "enable",
     "enabled",
     "instrument",
+    "mining_health",
     "read_manifest",
     "record_transfer",
+    "sentinel_metrics",
     "span",
+    "summarize_batch",
     "write_manifest",
 ]

@@ -1,12 +1,15 @@
 """CLI: `python -m dae_rnn_news_recommendation_tpu.telemetry report ...`
 
-    report <trace.json> [--metrics PATH] [--bench PATH] [--json]
+    report <trace.json> [--metrics PATH] [--bench PATH] [--health PATH]
+                        [--json]
 
 Prints the per-span p50/p95/total table (with feed-stall and compile-count
 columns) from a trace exported by a traced fit; optionally joins metrics.jsonl
-scalars and reconciles a bench record's H2D probes against measured transfer
-counters. Exit codes: 0 report rendered, 1 trace had no span events,
-2 usage / unreadable input.
+scalars, reconciles a bench record's H2D probes against measured transfer
+counters, and renders a flight-recorder health bundle (auto-detected next to
+the trace when --health is omitted). Unreadable OPTIONAL inputs degrade to
+warning notes. Exit codes: 0 report rendered, 1 trace had no span events and
+nothing else loaded, 2 usage / unreadable trace.
 """
 
 import argparse
@@ -30,13 +33,17 @@ def main(argv=None):
     rep.add_argument("--bench", default=None,
                      help="bench stdout JSON line or evidence sidecar, for "
                           "the h2d probe-vs-measured reconciliation")
+    rep.add_argument("--health", default=None,
+                     help="flight-recorder health_bundle.json (default: "
+                          "auto-detect next to the trace)")
     rep.add_argument("--json", action="store_true",
                      help="emit the report as JSON instead of a table")
     args = parser.parse_args(argv)
 
     try:
         text, code = report(args.trace, metrics_path=args.metrics,
-                            bench_path=args.bench, as_json=args.json)
+                            bench_path=args.bench, health_path=args.health,
+                            as_json=args.json)
     except (OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2

@@ -1,0 +1,130 @@
+"""In-graph model-health metrics: the numeric sentinel.
+
+Everything here runs INSIDE the jitted step — pure jnp, no host sync, no
+Python branching on traced values (jaxcheck R1-clean by construction). The
+step factories (train/step.py, train/resident.py, parallel/dp.py,
+parallel/ep.py) merge `sentinel_metrics` into the metrics dict they already
+return, so the health flags ride the existing once-per-epoch metric fetch:
+zero extra device round trips per step (tests/test_health.py asserts the
+fetch count and the single compile).
+
+Three layers, merged into the same metrics namespace:
+
+  * `sentinel_metrics`  — step-level: isfinite over loss/grads/updates,
+    global grad/param norms, update-to-param ratio. Catches NaN/Inf the step
+    it happens and exploding updates before they NaN.
+  * `embedding_health`  — batch-embedding stats: hidden norm mean/max and a
+    collapse score (mean pairwise cosine of the batch's unit embeddings).
+    A collapsed encoder (every article mapping to the same direction) keeps
+    a healthy-looking loss while AUROC dies; the collapse score goes to 1.
+  * `mining_health`     — the paper's `data_weight` distribution
+    (mean/max/fraction-zero) and the margin-violation rate. `data_weight`
+    re-weighting of the reconstruction loss is the paper's core novelty
+    (reference triplet_loss_utils.py:129, :251-277) and `data_weight -> 0`
+    means mining has gone dead: the model trains a plain autoencoder.
+
+The collapse score uses the closed form for masked mean pairwise cosine:
+with unit rows u_i (n valid rows), sum_{i!=j} cos(i,j) = ||sum u||^2 - n,
+so the mean is (||s||^2 - n) / (n(n-1)) — O(B*D), no B^2 matrix.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+def _nonfinite_count(tree):
+    """Number of NaN/Inf scalars across the floating leaves of `tree`, as an
+    int32 (0 = all finite — an exact integer comparison; a float fraction
+    would be off by an XLA reciprocal-ulp under jit and misfire). Integer
+    leaves (optax counts, labels) are skipped — they cannot be non-finite and
+    isfinite is undefined for them."""
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)]
+    if not leaves:
+        return jnp.int32(0)
+    return sum(jnp.sum((~jnp.isfinite(l)).astype(jnp.int32)) for l in leaves)
+
+
+def _global_norm(tree):
+    """sqrt(sum of squared floating leaves) — optax.global_norm without the
+    dependency surface."""
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)]
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+def sentinel_metrics(cost, grads, updates, params):
+    """Step-level health flags, computed in-graph after the optimizer update.
+
+    `params` must be the PRE-update params so `health/update_ratio` is the
+    classic ||update|| / ||param|| step-size diagnostic (≈ learning-rate ×
+    relative gradient scale; a sudden jump precedes divergence).
+
+    `health/nonfinite` is 1.0 when ANY of cost / grads / updates contains a
+    NaN or Inf — one flag the flight recorder can trip on without scanning
+    every metric."""
+    grad_norm = _global_norm(grads)
+    param_norm = _global_norm(params)
+    update_norm = _global_norm(updates)
+    all_finite = (jnp.isfinite(cost)
+                  & (_nonfinite_count(grads) == 0)
+                  & (_nonfinite_count(updates) == 0))
+    return {
+        "health/grad_norm": grad_norm,
+        "health/param_norm": param_norm,
+        "health/update_ratio": update_norm / jnp.maximum(param_norm, _EPS),
+        "health/nonfinite": 1.0 - all_finite.astype(jnp.float32),
+    }
+
+
+def embedding_health(h, row_valid=None, prefix="health/embedding"):
+    """Norm stats + collapse score for a batch of embeddings `h` [B, D].
+
+    collapse = masked mean pairwise cosine over the valid rows: 0 for a
+    well-spread isotropic batch, -> 1.0 when every row points the same way
+    (the dead-encoder failure the serving-scale system in PAPERS.md monitors
+    continuously). Closed form (||sum u||^2 - n) / (n(n-1)), O(B*D)."""
+    dtype = jnp.float32
+    v = (jnp.ones(h.shape[0], dtype) if row_valid is None
+         else row_valid.astype(dtype))
+    n = jnp.maximum(jnp.sum(v), 1.0)
+    norms = jnp.sqrt(jnp.sum(jnp.square(h.astype(dtype)), axis=1))
+    norm_mean = jnp.sum(norms * v) / n
+    norm_max = jnp.max(norms * v)
+    u = h.astype(dtype) / jnp.maximum(norms, _EPS)[:, None] * v[:, None]
+    s = jnp.sum(u, axis=0)
+    pair_sum = jnp.sum(jnp.square(s)) - n  # sum_{i!=j} cos(u_i, u_j)
+    collapse = pair_sum / jnp.maximum(n * (n - 1.0), 1.0)
+    return {
+        f"{prefix}_norm_mean": norm_mean,
+        f"{prefix}_norm_max": norm_max,
+        f"{prefix}_collapse": collapse,
+    }
+
+
+def mining_health(data_weight, fraction, row_valid=None):
+    """Distribution stats of the paper's triplet-participation `data_weight`
+    [B] plus the margin-violation rate.
+
+    `fraction` is the mining fn's fraction-of-violating-triplets (batch_all)
+    or fraction-of-violating-anchors (batch_hard) — recorded under one name
+    so dashboards don't fork per strategy. `data_weight_zero_fraction -> 1`
+    is the dead-mining signal: every row's reconstruction loss gets weight 0
+    and the triplet term stops shaping the embedding space."""
+    dtype = jnp.float32
+    w = data_weight.astype(dtype)
+    v = (jnp.ones(w.shape[0], dtype) if row_valid is None
+         else row_valid.astype(dtype))
+    n = jnp.maximum(jnp.sum(v), 1.0)
+    return {
+        "health/data_weight_mean": jnp.sum(w * v) / n,
+        "health/data_weight_max": jnp.max(w * v),
+        "health/data_weight_zero_fraction":
+            jnp.sum((w <= 0.0).astype(dtype) * v) / n,
+        "health/margin_violation_rate": jnp.asarray(fraction, dtype),
+    }

@@ -1,0 +1,205 @@
+"""Flight recorder: a bounded ring of recent step metrics + crash bundles.
+
+Host-side twin of telemetry/health.py's in-graph sentinel: the estimator
+feeds every step's (already fetched) metric row into `record()`, which keeps
+the last `capacity` rows in a deque and watches for three anomaly classes:
+
+  * nonfinite  — any NaN/Inf metric value, or the sentinel's
+                 `health/nonfinite` flag tripping;
+  * divergence — cost exceeding `divergence_factor` x its own EMA (after a
+                 short warmup so the first noisy steps don't trip it);
+  * exception  — an uncaught exception in fit (the estimator calls `dump`
+                 from its handler and re-raises).
+
+On the first anomaly the estimator dumps a diagnostics bundle
+(`health_bundle.json` in the run dir): the ring contents, the trace tail
+(when tracing is on), the run manifest, a batch signature, the first bad and
+last good step ids. `python -m ...telemetry report --health` renders it.
+
+Detection granularity follows the metric fetch: all three feed paths fetch
+step metrics once per epoch (the async-dispatch design), so anomalies are
+noticed at the epoch boundary — but the ring pins the exact step, because
+every step's row is recorded with its global step id. `health_abort=True`
+(opt-in, estimator ctor) stops fit at that boundary; the default records and
+keeps going, matching prior behavior exactly.
+"""
+
+import collections
+import json
+import math
+import os
+
+import numpy as np
+
+
+def _jsonable(v):
+    """Best-effort scalar conversion; non-numeric values pass through repr."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+def summarize_batch(batch):
+    """Host-side summary of a feed batch: shape/dtype per key, plus value
+    stats (min/max/mean, nonfinite count) for host numpy arrays. Device or
+    donated buffers stay shape-only — a diagnostics path must never force a
+    transfer or touch freed memory."""
+    if not isinstance(batch, dict):
+        return {"type": type(batch).__name__}
+    out = {}
+    for k, v in batch.items():
+        entry = {"shape": list(getattr(v, "shape", ())),
+                 "dtype": str(getattr(v, "dtype", type(v).__name__))}
+        if isinstance(v, np.ndarray) and v.size and \
+                np.issubdtype(v.dtype, np.floating):
+            finite = np.isfinite(v)
+            entry["n_nonfinite"] = int(v.size - finite.sum())
+            if finite.any():
+                fv = v[finite]
+                entry.update(min=float(fv.min()), max=float(fv.max()),
+                             mean=float(fv.mean()))
+        out[k] = entry
+    return out
+
+
+class FlightRecorder:
+    """Ring buffer of step metrics with anomaly detection.
+
+    :param capacity: steps of history the bundle carries
+    :param divergence_factor: cost > factor * EMA(cost) flags divergence
+    :param ema_alpha: EMA smoothing for the divergence baseline
+    :param warmup_steps: steps before divergence can trip (the EMA needs a
+        baseline; nonfinite detection is active from step one)
+    """
+
+    BUNDLE_SCHEMA = 1
+
+    def __init__(self, capacity=256, divergence_factor=10.0, ema_alpha=0.05,
+                 warmup_steps=10):
+        self.capacity = int(capacity)
+        self.divergence_factor = float(divergence_factor)
+        self.ema_alpha = float(ema_alpha)
+        self.warmup_steps = int(warmup_steps)
+        self.ring = collections.deque(maxlen=self.capacity)
+        self.ema = None
+        self.n_recorded = 0
+        self.status = "ok"
+        self.first_bad_step = None
+        self.first_bad_reason = None
+        self.last_good_step = None
+        self.batch_signature = None
+        self.bundle_path = None
+
+    # ------------------------------------------------------------ recording
+
+    def record(self, step, metrics):
+        """Feed one step's host metrics. Returns the anomaly reason string
+        the first time this step looks bad, else None. Later anomalies only
+        update the ring (the bundle names the FIRST bad step)."""
+        row = {"step": int(step)}
+        nonfinite_keys = []
+        for k, v in metrics.items():
+            fv = _jsonable(v)
+            row[k] = fv
+            if isinstance(fv, float) and not math.isfinite(fv):
+                nonfinite_keys.append(k)
+        self.ring.append(row)
+        self.n_recorded += 1
+
+        reason = None
+        cost = row.get("cost")
+        if nonfinite_keys:
+            reason = f"nonfinite metrics at step {step}: " \
+                     f"{sorted(nonfinite_keys)[:4]}"
+        elif row.get("health/nonfinite", 0.0) > 0.0:
+            reason = (f"sentinel nonfinite flag at step {step} "
+                      "(grads/updates contain NaN or Inf)")
+        elif (isinstance(cost, float) and self.ema is not None
+                and self.n_recorded > self.warmup_steps
+                and cost > self.divergence_factor * self.ema):
+            reason = (f"divergence at step {step}: cost {cost:.6g} > "
+                      f"{self.divergence_factor:g} x EMA {self.ema:.6g}")
+
+        if isinstance(cost, float) and math.isfinite(cost):
+            self.ema = (cost if self.ema is None else
+                        self.ema + self.ema_alpha * (cost - self.ema))
+        if reason is None:
+            if self.status == "ok":
+                self.last_good_step = int(step)
+            return None
+        if self.first_bad_step is None:
+            self.first_bad_step = int(step)
+            self.first_bad_reason = reason
+            self.status = "degraded"
+            return reason
+        return None
+
+    def note_batch_signature(self, batch):
+        """Record the feed's batch signature once (shape/dtype per key, value
+        stats when the arrays are host numpy). Called at most once per epoch
+        by the estimator — cheap, and enough to tie a bundle to its feed."""
+        try:
+            self.batch_signature = summarize_batch(batch)
+        except Exception:
+            self.batch_signature = None  # diagnostics must never kill a fit
+
+    def note_exception(self, exc):
+        """Mark the run failed by an uncaught exception (dump() records it)."""
+        self.status = "failed"
+        if self.first_bad_reason is None:
+            self.first_bad_reason = f"exception: {type(exc).__name__}: {exc}"
+
+    # ------------------------------------------------------------ snapshots
+
+    def snapshot(self):
+        """Small health summary for checkpoint metadata
+        (utils/checkpoint.py): enough for restore to warn when the run that
+        wrote the checkpoint was already degraded."""
+        last = self.ring[-1] if self.ring else {}
+        return {
+            "status": self.status,
+            "step": last.get("step"),
+            "loss_ema": self.ema,
+            "grad_norm": last.get("health/grad_norm"),
+            "first_bad_step": self.first_bad_step,
+            "reason": self.first_bad_reason,
+        }
+
+    def dump(self, path, reason=None, manifest_path=None, trace_tail=None,
+             extra=None):
+        """Write the diagnostics bundle (atomic replace); returns `path`, or
+        None when writing failed — the recorder must never take down the fit
+        it is documenting."""
+        bundle = {
+            "schema": self.BUNDLE_SCHEMA,
+            "reason": reason or self.first_bad_reason or "manual dump",
+            "status": self.status,
+            "first_bad_step": self.first_bad_step,
+            "last_good_step": self.last_good_step,
+            "loss_ema": self.ema,
+            "n_steps_recorded": self.n_recorded,
+            "ring": list(self.ring),
+            "batch_signature": self.batch_signature,
+        }
+        if manifest_path and os.path.exists(manifest_path):
+            try:
+                with open(manifest_path, encoding="utf-8") as f:
+                    bundle["manifest"] = json.load(f)
+            except (OSError, ValueError):
+                bundle["manifest"] = None
+        if trace_tail:
+            bundle["trace_tail"] = trace_tail
+        if extra:
+            bundle.update(extra)
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(bundle, f, indent=1, default=str)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        self.bundle_path = path
+        return path

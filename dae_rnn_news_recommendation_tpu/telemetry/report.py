@@ -16,7 +16,16 @@ trace-derived stall can be cross-checked against the FeedStats numbers logged
 by the same run. `--bench` reconciles a bench record's
 `h2d_bandwidth_mbytes_per_sec` probes against the fence-measured transfer
 counters captured during that run (`extra.transfer_events`) — the measured
-answer to the README Performance stream-vs-probe discrepancy.
+answer to the README Performance stream-vs-probe discrepancy. `--health`
+renders a flight-recorder bundle (telemetry/recorder.py) — status, first bad
+step, the anomaly reason, and the last recorded ring rows; when the flag is
+omitted a `health_bundle.json` sitting next to the trace is picked up
+automatically.
+
+Optional sections degrade gracefully: an unreadable metrics/bench/health
+input becomes a warning note in the report instead of an error, and a trace
+with no span events still renders whatever optional sections loaded (only a
+trace that is empty AND alone exits 1).
 """
 
 import json
@@ -77,6 +86,15 @@ def load_bench(path):
         if "extra" in obj:
             return obj["extra"]
     return None
+
+
+def load_health(path):
+    """A flight-recorder bundle (telemetry/recorder.py dump())."""
+    with open(path, encoding="utf-8") as f:
+        bundle = json.load(f)
+    if not isinstance(bundle, dict):
+        raise ValueError(f"{path}: not a health bundle object")
+    return bundle
 
 
 # -------------------------------------------------------------- aggregation
@@ -185,6 +203,28 @@ def bench_reconciliation(extra):
     return out or None
 
 
+def health_summary(bundle):
+    """The load-bearing fields of a flight-recorder bundle, plus the tail of
+    the metrics ring (the steps leading into the anomaly)."""
+    if not bundle:
+        return None
+    out = {k: bundle.get(k) for k in
+           ("status", "reason", "first_bad_step", "last_good_step",
+            "loss_ema", "n_steps_recorded")}
+    ring = bundle.get("ring") or []
+    out["ring_steps"] = len(ring)
+    tail = []
+    for row in ring[-5:]:
+        entry = {"step": row.get("step")}
+        for k in ("cost", "health/grad_norm", "health/update_ratio",
+                  "health/nonfinite"):
+            if k in row:
+                entry[k] = row[k]
+        tail.append(entry)
+    out["ring_tail"] = tail
+    return out
+
+
 # ---------------------------------------------------------------- rendering
 
 _COLS = ("span", "count", "total_s", "p50_ms", "p95_ms",
@@ -201,22 +241,28 @@ def _fmt_row(values, widths):
     return "  ".join(cells).rstrip()
 
 
-def render_text(rows, counters=None, manifest=None, metrics=None, bench=None):
+def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
+                health=None, notes=None):
     lines = []
     if manifest:
         lines.append("run: git %s  backend=%s  feed=%s  created %s" % (
             str(manifest.get("git_rev", "unknown"))[:12],
             manifest.get("backend"), manifest.get("feed_mode"),
             manifest.get("created_utc")))
-    table = [tuple(r[c] for c in _COLS) for r in rows]
-    widths = [max([len(_HEADS[i])] +
-                  [len("-" if v is None else
-                       (f"{v:.3f}" if isinstance(v, float) else str(v)))
-                   for v in (row[i] for row in table)])
-              for i in range(len(_COLS))]
-    lines.append(_fmt_row(_HEADS, widths))
-    for row in table:
-        lines.append(_fmt_row(row, widths))
+    for note in notes or ():
+        lines.append(f"note: {note}")
+    if rows:
+        table = [tuple(r[c] for c in _COLS) for r in rows]
+        widths = [max([len(_HEADS[i])] +
+                      [len("-" if v is None else
+                           (f"{v:.3f}" if isinstance(v, float) else str(v)))
+                       for v in (row[i] for row in table)])
+                  for i in range(len(_COLS))]
+        lines.append(_fmt_row(_HEADS, widths))
+        for row in table:
+            lines.append(_fmt_row(row, widths))
+    else:
+        lines.append("no span events in trace")
     if counters:
         lines.append("")
         lines.append("counters:")
@@ -244,11 +290,41 @@ def render_text(rows, counters=None, manifest=None, metrics=None, bench=None):
         lines.append("bench h2d reconciliation:")
         for k, v in bench.items():
             lines.append(f"  {k}: {v}")
+    if health:
+        lines.append("")
+        status = health.get("status") or "unknown"
+        lines.append(f"model health: {status}")
+        if health.get("reason"):
+            lines.append(f"  reason: {health['reason']}")
+        if health.get("first_bad_step") is not None:
+            lines.append(f"  first bad step: {health['first_bad_step']}  "
+                         f"(last good: {health.get('last_good_step')})")
+        lines.append(f"  loss EMA: {health.get('loss_ema')}  "
+                     f"steps recorded: {health.get('n_steps_recorded')}")
+        tail = health.get("ring_tail") or []
+        if tail:
+            lines.append("  ring tail (last recorded steps):")
+            for row in tail:
+                parts = [f"step={row.get('step')}"]
+                parts += [f"{k.split('/')[-1]}={row[k]:.6g}"
+                          for k in ("cost", "health/grad_norm",
+                                    "health/update_ratio",
+                                    "health/nonfinite")
+                          if isinstance(row.get(k), float)]
+                lines.append("    " + "  ".join(parts))
     return "\n".join(lines)
 
 
-def report(trace_path, metrics_path=None, bench_path=None, as_json=False):
-    """Build the report. Returns (text, exit_code)."""
+def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
+           as_json=False):
+    """Build the report. Returns (text, exit_code).
+
+    The trace is the report's backbone — an unreadable trace still raises
+    (the CLI maps it to exit 2). Every OTHER input is optional and degrades
+    gracefully: a missing/garbled metrics, bench, or health file becomes a
+    `note:` line and its section is skipped, and a trace with zero span
+    events renders a partial report as long as some other section loaded
+    (empty AND alone stays exit 1)."""
     trace = load_trace(trace_path)
     rows = span_table(trace)
     meta = trace.get("metadata", {}) or {}
@@ -262,15 +338,37 @@ def report(trace_path, metrics_path=None, bench_path=None, as_json=False):
             manifest = read_manifest(meta["manifest_path"])
         except Exception:
             manifest = None
-    metrics = metrics_summary(load_metrics(metrics_path)) if metrics_path \
-        else None
-    bench = bench_reconciliation(load_bench(bench_path)) if bench_path \
-        else None
+
+    notes = []
+
+    def optional(path, loader, label):
+        if not path:
+            return None
+        try:
+            return loader(path)
+        except (OSError, ValueError) as exc:
+            notes.append(f"{label} unavailable, section skipped ({exc})")
+            return None
+
+    records = optional(metrics_path, load_metrics, "metrics")
+    metrics = metrics_summary(records) if records is not None else None
+    bench = bench_reconciliation(optional(bench_path, load_bench, "bench"))
+    if health_path is None:
+        # a traced fit drops health_bundle.json next to trace.json — pick it
+        # up without a flag
+        cand = os.path.join(os.path.dirname(os.path.abspath(trace_path)),
+                            "health_bundle.json")
+        health_path = cand if os.path.exists(cand) else None
+    health = health_summary(optional(health_path, load_health,
+                                     "health bundle"))
     if as_json:
         return json.dumps({"spans": rows, "counters": counters,
                            "manifest": manifest, "metrics": metrics,
-                           "bench": bench}, indent=2, default=str), 0
-    if not rows:
+                           "bench": bench, "health": health,
+                           "notes": notes or None},
+                          indent=2, default=str), 0
+    if not rows and not (metrics or bench or health):
         return "no span events in trace", 1
     return render_text(rows, counters=counters, manifest=manifest,
-                       metrics=metrics, bench=bench), 0
+                       metrics=metrics, bench=bench, health=health,
+                       notes=notes), 0

@@ -29,6 +29,7 @@ import numpy as np
 import scipy.sparse as sp
 
 from .. import telemetry
+from ..telemetry.health import sentinel_metrics
 from .step import loss_and_metrics
 
 # resident sparse feeds reuse the streaming feed's padded layout
@@ -100,7 +101,7 @@ def stack_epoch_indices(batcher, n_rows):
     return np.stack(perms), np.stack(valids)
 
 
-def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics):
+def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics, health=True):
     """Build the jitted whole-epoch function.
 
     epoch_fn(params, opt_state, key, resident, perm, row_valid, extremes)
@@ -115,6 +116,10 @@ def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics):
     here; the estimator additionally gates resident execution on the default
     objective (`_resident_eligible`) because subclass params may not match
     this scan's gather layout.
+
+    `health=True` merges the numeric sentinel (telemetry/health.py) into each
+    scan step's metrics slot — stacked [S] like every other metric, fetched
+    in the same once-per-epoch download.
     """
 
     def gather_batch(resident, idx, rv, extremes):
@@ -141,9 +146,12 @@ def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics):
             idx, rv = sl
             batch = gather_batch(resident, idx, rv, extremes)
             key, sub = jax.random.split(key)
-            (_cost, metrics), grads = jax.value_and_grad(
+            (cost, metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, batch, sub, config)
             updates, opt_state = optimizer.update(grads, opt_state, params)
+            if health:
+                metrics = {**metrics,
+                           **sentinel_metrics(cost, grads, updates, params)}
             params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
             return (params, opt_state, key), metrics
 

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from .. import telemetry
 from ..models import dae_core
 from ..ops import corruption, losses, triplet
+from ..telemetry.health import embedding_health, mining_health, sentinel_metrics
 
 
 # dense key -> its sparse-ingest feed keys (single-input and triplet batches)
@@ -117,10 +118,14 @@ def loss_and_metrics(params, batch, key, config):
             "fraction_triplet": fraction,
             "num_triplet": num,
             **extras,
+            # in-graph mining/embedding health (telemetry/health.py): rides
+            # the same metric fetch, no extra host sync
+            **mining_health(data_weight, fraction, row_valid=row_valid),
         }
     else:
         cost = losses.weighted_loss(x, y, config.loss_func, row_valid=row_valid)
         metrics = {"cost": cost}
+    metrics.update(embedding_health(h, row_valid=row_valid))
     return cost, metrics
 
 
@@ -154,6 +159,14 @@ def triplet_loss_and_metrics(params, batch, key, config):
         hs["org"], hs["pos"], hs["neg"], row_valid=row_valid
     )
     cost = ae_loss + config.alpha * t_loss
+    # margin-violation rate for the precomputed path: fraction of valid rows
+    # whose anchor sits closer (by dot product) to its negative than to its
+    # positive — the precomputed twin of the mining paths' fraction_triplet
+    margin = jnp.sum(hs["org"] * hs["pos"] - hs["org"] * hs["neg"], axis=1)
+    rv = (jnp.ones_like(margin) if row_valid is None
+          else row_valid.astype(margin.dtype))
+    violation = jnp.sum((margin < 0.0).astype(margin.dtype) * rv) \
+        / jnp.maximum(jnp.sum(rv), 1.0)
     return cost, {
         "cost": cost,
         "autoencoder_loss": ae_loss,
@@ -165,11 +178,14 @@ def triplet_loss_and_metrics(params, batch, key, config):
         "autoencoder_loss_anchor": tower_loss["org"],
         "autoencoder_loss_pos": tower_loss["pos"],
         "autoencoder_loss_neg": tower_loss["neg"],
+        "health/margin_violation_rate": violation,
+        # embedding health on the anchor tower (telemetry/health.py)
+        **embedding_health(hs["org"], row_valid=row_valid),
     }
 
 
 def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True,
-                    donate_batch=False):
+                    donate_batch=False, health=True):
     """Build the jitted train step. `config` is static; params/opt_state are donated
     so XLA updates them in place in HBM.
 
@@ -178,13 +194,21 @@ def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True,
     pipelined feed, train/pipeline.py): XLA recycles each consumed batch's
     HBM into the next allocation instead of churning fresh buffers per step.
     The streaming path must keep it False (it hands jit host arrays, and the
-    prefetch queue may still hold references)."""
+    prefetch queue may still hold references).
+
+    `health=True` merges the in-graph numeric sentinel
+    (telemetry/health.py: isfinite flags, grad/param norms, update ratio)
+    into the returned metrics — same fetch, no extra sync; `health=False` is
+    the plain step (the overhead baseline in tests/test_health.py)."""
 
     def step(params, opt_state, key, batch):
         (cost, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, batch, key, config
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if health:
+            metrics = {**metrics,
+                       **sentinel_metrics(cost, grads, updates, params)}
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, metrics
 

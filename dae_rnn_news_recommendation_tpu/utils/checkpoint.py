@@ -26,14 +26,20 @@ except Exception:  # pragma: no cover
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
-def save_checkpoint(ckpt_dir, state, step, use_orbax=True, multiprocess=False):
+def save_checkpoint(ckpt_dir, state, step, use_orbax=True, multiprocess=False,
+                    health=None):
     """Save {'params':…, 'opt_state':…, 'epoch':…} at `step`; returns the path.
 
     `multiprocess=True` is the pod path: EVERY process calls this with the same
     shared `ckpt_dir` and its (replicated or sharded) global jax.Arrays; orbax
     coordinates the collective save (the primary host finalizes — per-process
     private dirs would never commit on non-primary hosts), and the numpy
-    sidecars are written by process 0 only."""
+    sidecars are written by process 0 only.
+
+    `health` is an optional flight-recorder snapshot (telemetry/recorder.py:
+    status, step, loss EMA, grad norm, first bad step) written as a
+    health.json sidecar so a restore can warn when the checkpoint came from a
+    degraded run."""
     base = os.path.abspath(os.path.join(ckpt_dir, f"step_{step}"))
     os.makedirs(base, exist_ok=True)
     primary = not multiprocess or jax.process_index() == 0
@@ -63,6 +69,16 @@ def save_checkpoint(ckpt_dir, state, step, use_orbax=True, multiprocess=False):
         np.savez(os.path.join(base, "aux.npz"),
                  *[np.asarray(x) for x in opt_leaves],
                  epoch=np.asarray(int(state.get("epoch", 0))))
+        if health is not None:
+            import json
+
+            try:
+                with open(os.path.join(base, "health.json"), "w",
+                          encoding="utf-8") as f:
+                    json.dump(health, f, indent=1, default=str)
+                    f.write("\n")
+            except (OSError, TypeError):
+                pass  # the health sidecar must never fail a save
     if multiprocess:
         from jax.experimental import multihost_utils
 
@@ -83,7 +99,7 @@ class AsyncCheckpointer:
         self._future = None
         self._executor = None
 
-    def save(self, ckpt_dir, state, step, use_orbax=True, keep=0):
+    def save(self, ckpt_dir, state, step, use_orbax=True, keep=0, health=None):
         import concurrent.futures
 
         if self._executor is None:
@@ -93,7 +109,8 @@ class AsyncCheckpointer:
         self.wait()
 
         def work():
-            save_checkpoint(ckpt_dir, host_state, step, use_orbax=use_orbax)
+            save_checkpoint(ckpt_dir, host_state, step, use_orbax=use_orbax,
+                            health=health)
             if keep:
                 prune_checkpoints(ckpt_dir, keep)
 
@@ -139,10 +156,33 @@ def load_params(ckpt_path, params_like):
 
 def load_checkpoint(ckpt_path, like):
     """Restore the full {'params','opt_state','epoch'} state; `like` provides the
-    pytree structure (must use the same optimizer that produced the checkpoint)."""
+    pytree structure (must use the same optimizer that produced the checkpoint).
+
+    When the checkpoint carries a health.json sidecar (save_checkpoint's
+    `health=`), it is returned under out['health'] and a RuntimeWarning is
+    raised if the run that wrote it was degraded or failed — resuming a NaN'd
+    or diverged run silently is how a bad state propagates."""
     params = load_params(ckpt_path, like["params"])
     aux_path = os.path.join(ckpt_path, "aux.npz")
     out = {"params": params, "opt_state": like.get("opt_state"), "epoch": 0}
+    health_path = os.path.join(ckpt_path, "health.json")
+    if os.path.isfile(health_path):
+        import json
+        import warnings
+
+        try:
+            with open(health_path, encoding="utf-8") as f:
+                out["health"] = json.load(f)
+        except (OSError, ValueError):
+            out["health"] = None
+        status = (out["health"] or {}).get("status", "ok")
+        if status != "ok":
+            warnings.warn(
+                f"resuming from a checkpoint whose run was {status} "
+                f"(first bad step: {(out['health'] or {}).get('first_bad_step')}, "
+                f"reason: {(out['health'] or {}).get('reason')}) — inspect the "
+                "run's health_bundle.json before trusting this state",
+                RuntimeWarning, stacklevel=2)
     if os.path.isfile(aux_path):
         data = np.load(aux_path)
         out["epoch"] = int(data["epoch"])

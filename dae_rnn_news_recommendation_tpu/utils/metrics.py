@@ -9,6 +9,7 @@ default, so observability parity never hinges on another framework.
 """
 
 import json
+import math
 import os
 import time
 
@@ -23,6 +24,9 @@ class MetricsWriter:
         self._path = os.path.join(logdir, "metrics.jsonl")
         self._f = open(self._path, "a", buffering=1)
         self._tb = None
+        # NaN/Inf scalars seen so far (a NaN'd loss must be *diagnosable* from
+        # the logs, so it can't be dropped silently or crash the writer)
+        self.nonfinite_scalar_count = 0
         if use_tensorboard:
             try:
                 self._tb = _TBWriter(logdir)
@@ -30,10 +34,19 @@ class MetricsWriter:
                 self._tb = None
 
     def scalar(self, tag, value, step):
-        rec = {"tag": tag, "value": float(value), "step": int(step), "ts": time.time()}
+        """Log one scalar to both sinks. Non-finite values are recorded
+        deterministically: the raw value goes to metrics.jsonl (Python's json
+        emits NaN/Infinity tokens that json.loads round-trips), the TB sink is
+        skipped (TB renderers choke on NaN points), and
+        `nonfinite_scalar_count` is bumped so callers/tests can assert on it."""
+        fv = float(value)
+        rec = {"tag": tag, "value": fv, "step": int(step), "ts": time.time()}
         self._f.write(json.dumps(rec) + "\n")
+        if not math.isfinite(fv):
+            self.nonfinite_scalar_count += 1
+            return
         if self._tb is not None:
-            self._tb.add_scalar(tag, float(value), int(step))
+            self._tb.add_scalar(tag, fv, int(step))
 
     def scalars(self, mapping, step):
         for tag, value in mapping.items():

@@ -1,0 +1,74 @@
+"""Planted R7 violations: per-step host conversion of jitted-step outputs
+inside a training loop.
+
+The carried-state signature (`params, opt_state, metrics = step(params,
+opt_state, ...)`) marks an async-dispatch pipeline; `float()`/`np.asarray`
+on the returned metrics inside the loop forces a device sync every step.
+
+The clean twins must NOT be flagged: accumulating device metrics and
+fetching once per epoch with jax.device_get (converting only after that
+fetch), and an eval-style loop with no carried state.
+"""
+
+import jax
+import numpy as np
+
+from dae_rnn_news_recommendation_tpu.train.step import (
+    make_eval_step, make_train_step)
+
+
+def bad_float_per_step(config, optimizer, params, opt_state, key, batches):
+    step = make_train_step(config, optimizer)
+    history = []
+    for batch in batches:
+        key, sub = jax.random.split(key)
+        params, opt_state, metrics = step(params, opt_state, sub, batch)
+        history.append(float(metrics["cost"]))  # planted: R7
+    return params, history
+
+
+def bad_asarray_per_step(config, optimizer, params, opt_state, key, batches):
+    step = make_train_step(config, optimizer)
+    costs = []
+    for batch in batches:
+        key, sub = jax.random.split(key)
+        params, opt_state, metrics = step(params, opt_state, sub, batch)
+        costs.append(np.asarray(metrics["cost"]))  # planted: R7
+    return params, costs
+
+
+def bad_float_in_comprehension(config, optimizer, params, opt_state, key,
+                               batches):
+    # converting via a dict comprehension over the step's metrics is the
+    # same per-step sync, one call deep
+    step = make_train_step(config, optimizer)
+    rows = []
+    for batch in batches:
+        key, sub = jax.random.split(key)
+        params, opt_state, metrics = step(params, opt_state, sub, batch)
+        rows.append({k: float(v) for k, v in metrics.items()})  # planted: R7
+    return params, rows
+
+
+def ok_batched_fetch(config, optimizer, params, opt_state, key, batches):
+    # the sanctioned pattern: device metrics accumulate in the loop, ONE
+    # jax.device_get per epoch, host conversion only after that fetch
+    step = make_train_step(config, optimizer)
+    device_metrics = []
+    for batch in batches:
+        key, sub = jax.random.split(key)
+        params, opt_state, metrics = step(params, opt_state, sub, batch)
+        device_metrics.append(metrics)
+    host_metrics = jax.device_get(device_metrics)
+    return params, [float(m["cost"]) for m in host_metrics]
+
+
+def ok_eval_no_carried_state(config, params, batches):
+    # no carried state: each call is independent, nothing pipelines behind
+    # the conversion (the repo's validation loop) — out of R7's scope
+    eval_step = make_eval_step(config)
+    total = 0.0
+    for batch in batches:
+        metrics = eval_step(params, batch)
+        total += float(metrics["cost"])
+    return total

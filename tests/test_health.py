@@ -1,0 +1,377 @@
+"""Model-health observability: in-graph sentinel, flight recorder, crash
+bundles, checkpoint health sidecar, and the report CLI's health section.
+
+The acceptance test seeds a NaN into one mid-fit batch and asserts the
+bundle pins the exact first bad step, the default fit still completes, and
+`health_abort=True` stops at the next epoch boundary.
+"""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu import telemetry
+from dae_rnn_news_recommendation_tpu.analysis import compile_guard
+from dae_rnn_news_recommendation_tpu.data.batcher import PaddedBatcher
+from dae_rnn_news_recommendation_tpu.models import (
+    DAEConfig, DenoisingAutoencoder, init_params)
+from dae_rnn_news_recommendation_tpu.telemetry import (
+    FlightRecorder, summarize_batch)
+from dae_rnn_news_recommendation_tpu.telemetry.__main__ import main as cli_main
+from dae_rnn_news_recommendation_tpu.train import make_optimizer
+from dae_rnn_news_recommendation_tpu.train.step import make_train_step
+from dae_rnn_news_recommendation_tpu.utils.checkpoint import (
+    load_checkpoint, save_checkpoint)
+
+
+def _cfg(**kw):
+    base = dict(n_features=24, n_components=4, enc_act_func="tanh",
+                dec_act_func="none", loss_func="mean_squared",
+                corr_type="none", corr_frac=0.0, triplet_strategy="none")
+    base.update(kw)
+    return DAEConfig(**base)
+
+
+# ------------------------------------------------------------ sentinel
+
+def _one_step(batch_x, health=True):
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    optimizer = make_optimizer("gradient_descent", 0.05)
+    opt_state = optimizer.init(params)
+    step = make_train_step(cfg, optimizer, donate=False, health=health)
+    batch = {"x": jnp.asarray(batch_x),
+             "row_valid": jnp.ones(batch_x.shape[0], jnp.float32)}
+    return step(params, opt_state, jax.random.PRNGKey(1), batch)
+
+
+def test_sentinel_clean_step_flags_zero():
+    x = (np.random.default_rng(0).uniform(size=(16, 24)) < 0.3).astype(
+        np.float32)
+    _, _, metrics = _one_step(x)
+    m = jax.device_get(metrics)
+    assert float(m["health/nonfinite"]) == 0.0
+    assert float(m["health/grad_norm"]) > 0.0
+    assert float(m["health/param_norm"]) > 0.0
+    assert float(m["health/update_ratio"]) > 0.0
+    # embedding health rides along on every loss path
+    assert float(m["health/embedding_norm_mean"]) >= 0.0
+    assert -1.0 - 1e-5 <= float(m["health/embedding_collapse"]) <= 1.0 + 1e-5
+
+
+def test_sentinel_flags_nan_batch():
+    x = (np.random.default_rng(0).uniform(size=(16, 24)) < 0.3).astype(
+        np.float32)
+    x[0, 0] = np.nan
+    _, _, metrics = _one_step(x)
+    m = jax.device_get(metrics)
+    assert float(m["health/nonfinite"]) == 1.0
+    assert not np.isfinite(float(m["cost"]))
+
+
+def test_health_false_step_omits_sentinel_keys():
+    x = (np.random.default_rng(0).uniform(size=(16, 24)) < 0.3).astype(
+        np.float32)
+    _, _, metrics = _one_step(x, health=False)
+    assert not any(k.startswith("health/grad") for k in metrics)
+    assert "health/nonfinite" not in metrics
+
+
+def test_sentinel_single_compile_and_no_per_step_fetches(monkeypatch):
+    """CI guard (satellite 6): the health-flagged step compiles once across
+    same-shape steps and the loop needs ZERO host fetches per step — the
+    sentinel rides the one end-of-loop device_get."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    optimizer = make_optimizer("gradient_descent", 0.05)
+    opt_state = optimizer.init(params)
+    step = make_train_step(cfg, optimizer, donate=False, health=True)
+    x = (np.random.default_rng(1).uniform(size=(16, 24)) < 0.3).astype(
+        np.float32)
+    batch = {"x": jnp.asarray(x), "row_valid": jnp.ones(16, jnp.float32)}
+    key = jax.random.PRNGKey(2)
+    key, _ = jax.random.split(key)  # pre-warm split's own compile
+
+    fetches = {"n": 0}
+    real_device_get = jax.device_get
+
+    def counting_device_get(tree):
+        fetches["n"] += 1
+        return real_device_get(tree)
+
+    monkeypatch.setattr(jax, "device_get", counting_device_get)
+    device_metrics = []
+    with compile_guard(max_compiles=1):
+        for _ in range(4):
+            key, sub = jax.random.split(key)
+            params, opt_state, metrics = step(params, opt_state, sub, batch)
+            device_metrics.append(metrics)
+    assert fetches["n"] == 0  # no host sync inside the hot loop
+    host = jax.device_get(device_metrics)
+    assert fetches["n"] == 1  # the single per-epoch fetch carries health too
+    assert all(float(m["health/nonfinite"]) == 0.0 for m in host)
+
+
+# ------------------------------------------------------- flight recorder
+
+def test_recorder_flags_first_nonfinite_step_once():
+    rec = FlightRecorder()
+    for s in range(1, 4):
+        assert rec.record(s, {"cost": 1.0 - 0.1 * s}) is None
+    reason = rec.record(4, {"cost": float("nan")})
+    assert reason is not None and "nonfinite" in reason
+    assert rec.status == "degraded"
+    assert rec.first_bad_step == 4 and rec.last_good_step == 3
+    # later anomalies only update the ring: the bundle names the FIRST
+    assert rec.record(5, {"cost": float("inf")}) is None
+    assert rec.first_bad_step == 4
+
+
+def test_recorder_trips_on_sentinel_flag():
+    rec = FlightRecorder()
+    assert rec.record(1, {"cost": 0.5, "health/nonfinite": 0.0}) is None
+    reason = rec.record(2, {"cost": 0.5, "health/nonfinite": 1.0})
+    assert reason is not None and "sentinel" in reason
+
+
+def test_recorder_divergence_after_warmup():
+    rec = FlightRecorder(divergence_factor=10.0, warmup_steps=5)
+    for s in range(1, 8):
+        assert rec.record(s, {"cost": 1.0}) is None
+    reason = rec.record(8, {"cost": 50.0})
+    assert reason is not None and "divergence" in reason
+    # before warmup the same jump must NOT trip (noisy first steps)
+    rec2 = FlightRecorder(divergence_factor=10.0, warmup_steps=5)
+    rec2.record(1, {"cost": 1.0})
+    assert rec2.record(2, {"cost": 50.0}) is None
+
+
+def test_recorder_ring_is_bounded():
+    rec = FlightRecorder(capacity=4)
+    for s in range(1, 11):
+        rec.record(s, {"cost": 1.0})
+    assert [r["step"] for r in rec.ring] == [7, 8, 9, 10]
+
+
+def test_recorder_dump_bundle_roundtrip(tmp_path):
+    manifest = tmp_path / "manifest.json"
+    manifest.write_text('{"schema": 1, "feed_mode": "stream"}')
+    rec = FlightRecorder()
+    rec.record(1, {"cost": 1.0})
+    rec.record(2, {"cost": float("nan")})
+    path = rec.dump(str(tmp_path / "run" / "health_bundle.json"),
+                    manifest_path=str(manifest),
+                    trace_tail=[{"name": "train/step"}],
+                    extra={"note": "seeded"})
+    assert path and os.path.isfile(path) and rec.bundle_path == path
+    with open(path, encoding="utf-8") as f:
+        bundle = json.load(f)  # NaN tokens round-trip through json.loads
+    assert bundle["schema"] == FlightRecorder.BUNDLE_SCHEMA
+    assert bundle["first_bad_step"] == 2 and bundle["last_good_step"] == 1
+    assert "nonfinite" in bundle["reason"]
+    assert [r["step"] for r in bundle["ring"]] == [1, 2]
+    assert bundle["manifest"]["feed_mode"] == "stream"
+    assert bundle["trace_tail"] == [{"name": "train/step"}]
+    assert bundle["note"] == "seeded"
+
+
+def test_recorder_exception_marks_failed():
+    rec = FlightRecorder()
+    rec.record(1, {"cost": 1.0})
+    rec.note_exception(ValueError("boom"))
+    assert rec.status == "failed"
+    snap = rec.snapshot()
+    assert snap["status"] == "failed" and "boom" in snap["reason"]
+    assert snap["step"] == 1
+
+
+def test_summarize_batch_stats_and_device_safety():
+    batch = {"x": np.array([[1.0, np.nan], [3.0, 4.0]], np.float32),
+             "labels": np.array([1, 2], np.int32),
+             "weird": "hello"}
+    sig = summarize_batch(batch)
+    assert sig["x"]["shape"] == [2, 2] and sig["x"]["n_nonfinite"] == 1
+    assert sig["x"]["max"] == 4.0
+    assert "n_nonfinite" not in sig["labels"]  # ints carry shape/dtype only
+    assert summarize_batch("not a dict") == {"type": "str"}
+
+
+# ------------------------------------------------- seeded NaN acceptance
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def _inject_nan_at(monkeypatch, target_batch):
+    """Corrupt x[0, 0] of the `target_batch`-th batch (1-based, counted
+    across epochs — the estimator's global step key) yielded by
+    PaddedBatcher."""
+    calls = {"n": 0}
+    orig = PaddedBatcher._payload
+
+    def corrupting(self, ctx, idx, n_real):
+        out = orig(self, ctx, idx, n_real)
+        calls["n"] += 1
+        if calls["n"] == target_batch:
+            out["x"][0, 0] = np.nan
+        return out
+
+    monkeypatch.setattr(PaddedBatcher, "_payload", corrupting)
+    return calls
+
+
+def _fit_with_nan(workdir, monkeypatch, target_step=5, **kw):
+    # 48 rows @ batch 16 -> 3 batches/epoch; 3 epochs -> steps 1..9;
+    # target_step=5 lands mid-fit (epoch 2, batch 2)
+    X = (np.random.default_rng(0).uniform(size=(48, 24)) < 0.3).astype(
+        np.float32)
+    _inject_nan_at(monkeypatch, target_step)
+    defaults = dict(model_name="h", main_dir="h", n_components=4,
+                    num_epochs=3, batch_size=16, seed=3, corr_type="none",
+                    corr_frac=0.0, loss_func="mean_squared",
+                    opt="gradient_descent", learning_rate=0.05,
+                    triplet_strategy="none", verbose=False,
+                    use_tensorboard=False, trace=True,
+                    results_root=str(workdir / "results"))
+    defaults.update(kw)
+    m = DenoisingAutoencoder(**defaults)
+    m.fit(X)
+    return m
+
+
+def test_nan_injection_produces_bundle_with_first_bad_step(
+        workdir, monkeypatch, capsys):
+    m = _fit_with_nan(workdir, monkeypatch, target_step=5)
+    # the default path records the anomaly and COMPLETES (prior behavior)
+    assert m._last_epoch == 3
+    assert m.health_status == "degraded"
+    assert m.health_bundle_path and os.path.isfile(m.health_bundle_path)
+    with open(m.health_bundle_path, encoding="utf-8") as f:
+        bundle = json.load(f)
+    assert bundle["first_bad_step"] == 5
+    assert bundle["last_good_step"] == 4
+    assert bundle["status"] == "degraded"
+    assert "nonfinite" in bundle["reason"]
+    steps = {r["step"]: r for r in bundle["ring"]}
+    assert not np.isfinite(steps[5]["cost"])  # the offending step is pinned
+    assert np.isfinite(steps[4]["cost"])
+    assert bundle["batch_signature"]["x"]["shape"] == [16, 24]
+    assert bundle["manifest"]["feed_mode"] == "stream"
+    assert bundle.get("trace_tail")  # tracing was live at dump time
+
+    # the report CLI auto-detects the bundle next to the trace
+    assert m.trace_path and os.path.isfile(m.trace_path)
+    rc = cli_main(["report", m.trace_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "model health: degraded" in out
+    assert "first bad step: 5" in out
+
+
+def test_health_abort_stops_at_next_epoch_boundary(workdir, monkeypatch):
+    m = _fit_with_nan(workdir, monkeypatch, target_step=5, health_abort=True)
+    # injection at step 5 (epoch 2): the per-epoch fetch notices it at the
+    # end of epoch 2 and the loop breaks there — epoch 3 never runs
+    assert m._last_epoch == 2
+    assert m.health_status == "degraded"
+    with open(m.health_bundle_path, encoding="utf-8") as f:
+        assert json.load(f)["first_bad_step"] == 5
+
+
+def test_clean_fit_has_no_bundle(workdir):
+    X = (np.random.default_rng(0).uniform(size=(48, 24)) < 0.3).astype(
+        np.float32)
+    m = DenoisingAutoencoder(
+        model_name="c", main_dir="c", n_components=4, num_epochs=2,
+        batch_size=16, seed=3, corr_type="none", corr_frac=0.0,
+        loss_func="mean_squared", opt="gradient_descent", learning_rate=0.05,
+        triplet_strategy="none", verbose=False, use_tensorboard=False,
+        results_root=str(workdir / "results"))
+    m.fit(X)
+    assert m.health_bundle_path is None
+    assert not os.path.isfile(os.path.join(m.tf_summary_dir,
+                                           "health_bundle.json"))
+
+
+# --------------------------------------------------- checkpoint sidecar
+
+def test_checkpoint_embeds_health_and_restore_warns(tmp_path):
+    state = {"params": {"w": np.ones(3, np.float32)}, "opt_state": None,
+             "epoch": 2}
+    health = {"status": "degraded", "step": 7, "loss_ema": 1.5,
+              "grad_norm": 2.0, "first_bad_step": 5,
+              "reason": "nonfinite metrics at step 5: ['cost']"}
+    path = save_checkpoint(str(tmp_path / "ck"), state, 7, use_orbax=False,
+                           health=health)
+    assert os.path.isfile(os.path.join(path, "health.json"))
+    like = {"params": {"w": np.zeros(3, np.float32)}, "opt_state": None}
+    with pytest.warns(RuntimeWarning, match="degraded"):
+        out = load_checkpoint(path, like)
+    assert out["health"]["first_bad_step"] == 5
+
+    # an ok-status sidecar restores silently
+    ok_path = save_checkpoint(str(tmp_path / "ck2"), state, 7,
+                              use_orbax=False,
+                              health={"status": "ok", "step": 7})
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = load_checkpoint(ok_path, like)
+    assert out["health"]["status"] == "ok"
+
+    # no sidecar at all: nothing under 'health', no warning
+    bare = save_checkpoint(str(tmp_path / "ck3"), state, 7, use_orbax=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = load_checkpoint(bare, like)
+    assert "health" not in out
+
+
+# ------------------------------------------- report graceful degradation
+
+def _write_trace(path, events):
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_report_missing_optional_inputs_degrade_to_notes(tmp_path, capsys):
+    trace = tmp_path / "trace.json"
+    _write_trace(str(trace), [])
+    rec = FlightRecorder()
+    rec.record(1, {"cost": 1.0})
+    rec.record(2, {"cost": float("nan")})
+    rec.dump(str(tmp_path / "health_bundle.json"))
+
+    # empty trace + a loadable health bundle: partial report, rc 0
+    rc = cli_main(["report", str(trace)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no span events in trace" in out
+    assert "model health: degraded" in out
+
+    # missing/unreadable OPTIONAL inputs become notes, never a crash
+    rc = cli_main(["report", str(trace),
+                   "--bench", str(tmp_path / "missing_bench.json"),
+                   "--metrics", str(tmp_path / "missing_metrics.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "note:" in out
+
+    # corrupt bundle: note + the rest of the report still renders
+    (tmp_path / "bad").mkdir()
+    bad_trace = tmp_path / "bad" / "trace.json"
+    _write_trace(str(bad_trace), [])
+    (tmp_path / "bad" / "health_bundle.json").write_text("{not json")
+    rc = cli_main(["report", str(bad_trace),
+                   "--health", str(tmp_path / "bad" / "health_bundle.json")])
+    out = capsys.readouterr().out
+    assert rc == 1  # nothing loaded: same contract as empty-trace-alone
+    assert cli_main(["report", str(tmp_path / "nope.json")]) == 2
+    capsys.readouterr()

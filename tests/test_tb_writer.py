@@ -153,3 +153,42 @@ def test_metrics_writer_histogram_hardening_and_idempotent_close(tmp_path):
     assert mixed["min"] == 1.0 and mixed["max"] == 3.0
     mw.close()
     mw.close()  # idempotent: the fit paths close in finally + explicitly
+
+
+def test_scalar_nonfinite_recorded_deterministically(tmp_path):
+    """A NaN'd loss must be diagnosable from the logs: the raw value lands in
+    metrics.jsonl (json emits NaN/Infinity tokens json.loads round-trips),
+    the TB sink is skipped (its renderers choke on NaN points), and
+    nonfinite_scalar_count says how many were seen."""
+    import json
+    import math
+
+    from dae_rnn_news_recommendation_tpu.utils import MetricsWriter
+
+    mw = MetricsWriter(str(tmp_path))
+    tb_calls = []
+
+    class StubTB:
+        def add_scalar(self, tag, value, step):
+            tb_calls.append((tag, value, step))
+
+        def close(self):
+            pass
+
+    mw._tb = StubTB()
+    mw.scalar("cost", 1.5, 1)
+    mw.scalars({"cost": float("nan"), "health/grad_norm": float("inf")}, 2)
+    mw.scalar("cost", 2.5, 3)
+    mw.close()
+    assert mw.nonfinite_scalar_count == 2
+
+    records = [json.loads(line) for line in
+               (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert ("cost", 1.5, 1) in [(r["tag"], r["value"], r["step"])
+                                for r in records]
+    [nan_rec] = [r for r in records if r["tag"] == "cost" and r["step"] == 2]
+    assert math.isnan(nan_rec["value"])
+    [inf_rec] = [r for r in records if r["tag"] == "health/grad_norm"]
+    assert math.isinf(inf_rec["value"])
+    # the TB sink saw only the finite points
+    assert tb_calls == [("cost", 1.5, 1), ("cost", 2.5, 3)]
