@@ -85,7 +85,8 @@ def _peak_for(device_kind):
 
 
 def _roofline(platform, device_kind, encode_aps, train_aps, train_batch,
-              encode_strategy="gather-accumulate"):
+              encode_strategy="gather-accumulate", mined_batch=None,
+              mined_aps=None):
     """Analytic FLOPs/bytes per article + achieved utilization vs chip peak.
 
     encode, gather-accumulate strategy: 2*nnz*D effective FLOPs; HBM reads
@@ -128,6 +129,17 @@ def _roofline(platform, device_kind, encode_aps, train_aps, train_batch,
                              "HBM/transfer (intensity ~1 FLOP/byte)"),
                   "train": "MXU (dense 12*F*D matmul FLOPs)"},
     }
+    if mined_batch:
+        # large-batch MINED training: the mining term's FLOPs grow with B
+        # (6*B*D per article) while its memory stays O(B^2) under the
+        # blockwise/pallas dispatch — FLOPs-roofline valid where the dense
+        # cube would have been memory-infeasible (B^3*4 bytes f32).
+        roof["train_mined_flops_per_article"] = (
+            12.0 * F * D + 6.0 * mined_batch * D)
+        roof["train_mined_dense_cube_bytes"] = float(mined_batch) ** 3 * 4
+        roof["bound"]["train_mined"] = (
+            "MXU (12*F*D recon + 6*B*D pairwise mining FLOPs; O(B^2) "
+            "memory via mining_impl dispatch)")
     spec = _peak_for(device_kind) if platform == "tpu" else None
     if spec:
         peak_tflops, peak_gbps = spec
@@ -142,6 +154,10 @@ def _roofline(platform, device_kind, encode_aps, train_aps, train_batch,
         if train_aps:
             roof["train_mfu"] = round(
                 train_aps * tr_flops / (peak_tflops * 1e12), 4)
+        if mined_batch and mined_aps:
+            roof["train_mined_big_mfu"] = round(
+                mined_aps * roof["train_mined_flops_per_article"]
+                / (peak_tflops * 1e12), 4)
     return roof
 
 # Workload sizes per platform: the TPU sizes are the headline measurement; the
@@ -303,7 +319,8 @@ def _bench_encode(jax, params, config, sz, via_dense=False, feeds=None,
 
 
 def _bench_train(jax, sz, batch_override=None, steps_override=None,
-                 triplet=True, extra_out=None):
+                 triplet=True, extra_out=None, mining_impl="auto",
+                 accum_steps=1):
     """Steady-state fit() hot loop: batch_all mining at the reference default
     shape. `batch_override` runs the same step at a different batch.
     `extra_out`, when given, receives the final step's health/* sentinel
@@ -312,7 +329,11 @@ def _bench_train(jax, sz, batch_override=None, steps_override=None,
     healthy-looking throughput.
     `triplet=False` drops the mining term: batch_all costs O(B^2) FLOPs per
     article, so at large B mining dominates and the large-batch figure must be
-    reconstruction-only to say anything about the MXU matmul path."""
+    reconstruction-only to say anything about the MXU matmul path.
+    `mining_impl`/`accum_steps` thread straight to the dispatch
+    (train/step.py resolve_mining_impl) and the in-step microbatch loop — the
+    mined-big corner runs `"auto"` so the record measures exactly what a
+    default large-batch fit() would dispatch."""
     import jax.numpy as jnp
 
     from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
@@ -324,13 +345,14 @@ def _bench_train(jax, sz, batch_override=None, steps_override=None,
         loss_func="cross_entropy", corr_type="masking", corr_frac=0.3,
         triplet_strategy="batch_all" if triplet else "none",
         alpha=1.0 if triplet else 0.0, compute_dtype="bfloat16",
+        mining_impl=mining_impl,
     )
     tb = batch_override or sz["train_batch"]
     n_steps = steps_override or sz["train_steps"]
     params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
     optimizer = make_optimizer("ada_grad", 0.1)
     opt_state = jax.device_put(optimizer.init(params))
-    step = make_train_step(config, optimizer)
+    step = make_train_step(config, optimizer, accum_steps=accum_steps)
 
     rng = np.random.default_rng(1)
     batch = {
@@ -730,6 +752,7 @@ def child_main():
                          "the parent substitutes the last-good TPU sidecar "
                          "headline when evidence/bench_tpu.json exists")
     train_aps = None
+    mined_aps = None
     try:
         train_aps = _bench_train(jax, sz, extra_out=extra)
         extra["train_articles_per_sec"] = round(train_aps, 1)
@@ -757,6 +780,43 @@ def child_main():
                     big_aps * big_flops / (spec[0] * 1e12), 4)
         except Exception as e:
             extra["train_big_error"] = repr(e)[-300:]
+        try:
+            _phase("train: large-batch MINED figure (auto mining dispatch)")
+            # the figure train_big could never show: full batch_all mining at
+            # B=8192 WITH the cube intact would be a 2 TiB intermediate; the
+            # auto dispatch (train/step.py resolve_mining_impl) routes this
+            # batch to the O(B^2)-memory Pallas/blockwise path, so the mined
+            # step runs at all. Throughput is the headline; MFU is against
+            # the analytic mined FLOPs (12*F*D recon + 6*B*D mining).
+            from dae_rnn_news_recommendation_tpu.train.step import (
+                resolve_mining_impl)
+
+            mined_b, mined_steps = 8192, 10
+            mined_aps = _bench_train(jax, sz, batch_override=mined_b,
+                                     steps_override=mined_steps, triplet=True,
+                                     mining_impl="auto", accum_steps=1)
+            extra["train_mined_big_mining_impl"] = resolve_mining_impl(
+                "auto", mined_b)
+            extra["train_mined_big_accum_steps"] = 1
+            extra["train_mined_big_articles_per_sec"] = round(mined_aps, 1)
+            extra["train_mined_big_shape"] = (
+                f"batch {mined_b}, {F}->{D}, batch_all "
+                f"({resolve_mining_impl('auto', mined_b)} dispatch)+adagrad")
+            spec = _peak_for(dev.device_kind)
+            if spec:
+                mined_flops = 12.0 * F * D + 6.0 * mined_b * D
+                extra["train_mined_big_mfu"] = round(
+                    mined_aps * mined_flops / (spec[0] * 1e12), 4)
+        except Exception as e:
+            extra["train_mined_big_error"] = repr(e)[-300:]
+    else:
+        # the corner is recorded even where it cannot run: a missing key
+        # reads as "bench never covered this", a skip note reads as "covered,
+        # TPU-only by design" — tests/evidence can tell those apart.
+        extra["train_mined_big"] = (
+            "skipped (TPU-only corner: a B=8192 mined step on the CPU "
+            "fallback exceeds the bench child budget; the dispatch itself "
+            "is parity-tested on CPU in tests/test_mining_dispatch.py)")
     fit_wl = None
     try:
         fit_wl = _fit_workload(jax, sz)
@@ -816,7 +876,8 @@ def child_main():
 
     extra["roofline"] = _roofline(
         platform, dev.device_kind, encode_aps, train_aps, sz["train_batch"],
-        encode_strategy=extra.get("encode_strategy", "gather-accumulate"))
+        encode_strategy=extra.get("encode_strategy", "gather-accumulate"),
+        mined_batch=8192 if platform == "tpu" else None, mined_aps=mined_aps)
 
     try:
         # provenance + whole-run compile counters: every bench record now
@@ -826,7 +887,12 @@ def child_main():
 
         extra["xla_events"] = listener.stop().summary()
         extra["manifest"] = build_manifest(
-            feed_mode="bench", extra={"sizes": {k: sz[k] for k in sorted(sz)}})
+            feed_mode="bench",
+            extra={"sizes": {k: sz[k] for k in sorted(sz)},
+                   # dispatch provenance: what every train figure above ran
+                   # with (the mined-big record also carries its RESOLVED
+                   # impl under train_mined_big_mining_impl)
+                   "mining_impl": "auto", "accum_steps": 1})
     except Exception as e:
         extra["provenance_error"] = repr(e)[-300:]
 

@@ -1,4 +1,4 @@
-"""jaxcheck rules R1-R7 — AST checkers for the JAX hazard classes this repo
+"""jaxcheck rules R1-R8 — AST checkers for the JAX hazard classes this repo
 has been bitten by (see docs/jaxcheck.md for the catalog with in-repo
 examples of each).
 
@@ -1000,3 +1000,92 @@ def check_r7(ctx):
     for f in out:
         uniq[(f.line, f.message)] = f
     return list(uniq.values())
+
+
+# ------------------------------------------------------------------- R8
+
+# binary ops that broadcast their operands (materializing the result shape)
+_R8_BROADCAST_OPS = (ast.BitAnd, ast.BitOr, ast.Mult, ast.Add, ast.Sub)
+
+
+def _r8_sig(node, env):
+    """Broadcast signature of an expression: the frozenset of `None`
+    positions in a rank-3 `x[..., None, ...]` subscript (descending unary
+    ops and name bindings), or None when the expression is not a rank-3
+    expand. `{2}` means `x[:, :, None]`; `{0, 1}` means `x[None, None, :]`.
+    Only proper subsets count — a 3-slot subscript with zero or three
+    `None`s is not an expand-for-broadcast."""
+    if isinstance(node, ast.UnaryOp):
+        return _r8_sig(node.operand, env)
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        if isinstance(sl, ast.Tuple) and len(sl.elts) == 3:
+            pos = frozenset(i for i, e in enumerate(sl.elts)
+                            if isinstance(e, ast.Constant) and e.value is None)
+            if 0 < len(pos) < 3:
+                return frozenset(pos)
+    return None
+
+
+def _r8_scan_root(ctx, root, seen_lines):
+    """Flag broadcasting combinations of rank-3 expands with DIFFERENT
+    None-position signatures — the exact idiom whose result is the full
+    [B, B, B] cube (`a[:, :, None] op b[:, None, :]`). Same-signature
+    combinations (no new axis materialized) and rank-2 expands pass."""
+    out = []
+    env = {}
+    nodes = sorted((n for n in scope_walk(root)
+                    if isinstance(n, (ast.Assign, ast.BinOp, ast.Compare))),
+                   key=lambda n: (n.lineno, n.col_offset))
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            # thread signatures through simple rebinds (i_ne_j = ne[:, :, None])
+            if len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                env[node.targets[0].id] = _r8_sig(node.value, env)
+            continue
+        if isinstance(node, ast.BinOp):
+            if not isinstance(node.op, _R8_BROADCAST_OPS):
+                continue
+            pairs = [(node.left, node.right)]
+        else:  # Compare
+            if len(node.ops) != 1 or len(node.comparators) != 1:
+                continue
+            pairs = [(node.left, node.comparators[0])]
+        for left, right in pairs:
+            ls, rs = _r8_sig(left, env), _r8_sig(right, env)
+            if ls is not None and rs is not None and ls != rs and \
+                    node.lineno not in seen_lines:
+                seen_lines.add(node.lineno)
+                out.append(ctx.finding(
+                    node,
+                    "broadcasting rank-3 expands with different axis "
+                    "signatures materializes the [B, B, B] cube — O(B^3) "
+                    "memory that caps the mined batch (256 GiB at B=4096 "
+                    "f32). Compute it in anchor tiles instead: "
+                    "ops/triplet_blockwise.py (XLA scan, O(B^2)) or the "
+                    "Pallas kernels (VMEM tiles), via "
+                    "train/step.py mine_triplets(mining_impl=...)."))
+    return out
+
+
+@rule("R8", "full [B,B,B] triplet cube materialized by rank-3 broadcasting")
+def check_r8(ctx):
+    """The O(B^3) mining footprint this repo migrated away from (ISSUE 5):
+    `d = -dp[:, :, None] + dp[:, None, :]` and its mask twin allocate B^3
+    elements in one op. Fine as the dense reference oracle at small B;
+    fatal at large-batch mining. The heuristic is purely syntactic —
+    two rank-3 expand subscripts with different `None` positions combined
+    by a broadcasting operator — so legitimate tiled slabs (a static
+    anchor-tile leading axis, or a VMEM tile inside a kernel) carry a
+    reasoned `# jaxcheck: disable=R8` at the site."""
+    out = []
+    seen = set()
+    roots = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))]
+    for root in roots:
+        out.extend(_r8_scan_root(ctx, root, seen))
+    return out

@@ -54,6 +54,14 @@ class DAEConfig:
     # behavior). No reference counterpart — the reference mines one label
     # (triplet_loss_utils.py:79-131 takes a single label vector).
     label2_alpha: float = 0.0
+    # mining implementation for the batch_all/batch_hard terms (train/step.py
+    # resolve_mining_impl): "dense" = the O(B^3) reference cube
+    # (ops/triplet.py), "blockwise" = anchor-tiled O(B^2) scan
+    # (ops/triplet_blockwise.py), "pallas" = the TPU VMEM-tiled kernels
+    # (ops/pallas_kernels.py). "auto" keeps small batches on dense
+    # (byte-stable with prior records) and routes large batches to pallas on
+    # TPU / blockwise elsewhere.
+    mining_impl: str = "auto"  # auto | dense | blockwise | pallas
     xavier_const: float = 1.0
     compute_dtype: str = "float32"  # "bfloat16" runs the wide matmuls on the MXU in bf16
     matmul_precision: str = "default"  # "default" | "high" | "highest" for encode/decode
@@ -62,6 +70,7 @@ class DAEConfig:
         assert self.enc_act_func in ACTIVATIONS
         assert self.dec_act_func in ACTIVATIONS
         assert self.triplet_strategy in ("batch_all", "batch_hard", "none")
+        assert self.mining_impl in ("auto", "dense", "blockwise", "pallas")
 
 
 def _precision(config):

@@ -68,7 +68,7 @@ class DenoisingAutoencoder:
                  weight_update_sharding=False, resident_feed="auto",
                  resident_budget_bytes=2 << 30, feed=None, trace=False,
                  health_abort=False, health_window=256,
-                 health_divergence=10.0):
+                 health_divergence=10.0, mining_impl="auto", accum_steps=1):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
 
         :param n_components: explicit code size; overrides the compress_factor
@@ -170,10 +170,29 @@ class DenoisingAutoencoder:
         self.health_divergence = health_divergence
         self.health_bundle_path = None
         self.health_status = None
+        # mining implementation for the triplet terms (train/step.py
+        # resolve_mining_impl): "auto" keeps small batches on the dense
+        # reference path (byte-stable with prior records) and routes large
+        # batches to the Pallas kernels on TPU / the blockwise O(B^2) scan
+        # elsewhere; "dense" | "blockwise" | "pallas" force one path.
+        self.mining_impl = mining_impl
+        # microbatch gradient accumulation (train/step.py grads_and_metrics):
+        # each optimizer step accumulates grads over accum_steps
+        # row-contiguous microbatches inside ONE jitted program, so the
+        # effective batch is batch_size while activation memory is that of
+        # batch_size/accum_steps. Batch sizes round up to a multiple of
+        # accum_steps (x the mesh data extent under parallelism).
+        # mining_scope='shard' has no accumulation path — the fit falls back
+        # to accum_steps=1 and records why in the run manifest.
+        self.accum_steps = int(accum_steps)
+        self._accum_effective = None
+        self._accum_fallback = None
 
         assert isinstance(self.verbose_step, int)
         assert self.verbose >= 0
         assert self.triplet_strategy in ("batch_all", "batch_hard", "none")
+        assert self.mining_impl in ("auto", "dense", "blockwise", "pallas")
+        assert self.accum_steps >= 1, "accum_steps must be a positive int"
 
         (self.models_dir, self.data_dir, self.tf_summary_dir, self.tsv_dir,
          self.plot_dir) = create_run_directories(self.algo_name, self.main_dir,
@@ -211,6 +230,7 @@ class DenoisingAutoencoder:
             "n_components": self.n_components_override,
             "compute_dtype": self.compute_dtype, "n_devices": self.n_devices,
             "mining_scope": self.mining_scope,
+            "mining_impl": self.mining_impl, "accum_steps": self.accum_steps,
         }
 
     def _root_key(self):
@@ -246,6 +266,7 @@ class DenoisingAutoencoder:
             loss_func=self.loss_func, corr_type=self.corr_type,
             corr_frac=self.corr_frac, triplet_strategy=self.triplet_strategy,
             alpha=self.alpha, label2_alpha=self.label2_alpha,
+            mining_impl=self.mining_impl,
             xavier_const=self.xavier_init,
             compute_dtype=self.compute_dtype,
         )
@@ -273,6 +294,9 @@ class DenoisingAutoencoder:
             self._epoch0 = int(state["epoch"])
 
         self._mesh_ctx = None
+        # accumulation fallback: resolved per-build, recorded in the manifest
+        accum = self.accum_steps
+        self._accum_fallback = None
         if self.mesh is not None or self.n_devices > 1:
             from ..parallel.dp import make_parallel_train_step, make_parallel_eval_step, get_mesh
             self.mesh = self.mesh or get_mesh(self.n_devices)
@@ -284,17 +308,28 @@ class DenoisingAutoencoder:
                 raise ValueError(
                     "mining_scope='shard' runs on a 1-D data mesh; use "
                     "mining_scope='global' with a feature-sharded (2-D) mesh")
+            if accum > 1 and self.mining_scope == "shard":
+                # the shard objective runs inside shard_map where a microbatch
+                # split would change local-mining semantics (parallel/dp.py);
+                # never silent: the reason lands in the run manifest
+                self._accum_fallback = (
+                    "accum_steps=%d ignored: mining_scope='shard' has no "
+                    "accumulation path (objective runs inside shard_map); "
+                    "ran with accum_steps=1" % accum)
+                accum = 1
             self._train_step = make_parallel_train_step(
                 self.config, self.optimizer, self.mesh,
                 mining_scope=self.mining_scope, loss_fn=self._loss_fn,
                 model_axis=model_axis,
-                weight_update_sharding=self.weight_update_sharding)
+                weight_update_sharding=self.weight_update_sharding,
+                accum_steps=accum)
             self._eval_step = make_parallel_eval_step(
                 self.config, self.mesh, mining_scope=self.mining_scope,
                 loss_fn=self._loss_fn, model_axis=model_axis)
-            # rows shard over the data axis only — pad batches to that extent
+            # rows shard over the data axis only — pad batches to that extent,
+            # times accum_steps so every microbatch keeps whole data shards
             self._batch_multiple = int(self.mesh.shape.get("data",
-                                                           self.mesh.devices.size))
+                                                           self.mesh.devices.size)) * accum
             self._model_axis = model_axis
             # under jax.distributed each process batches ITS OWN rows and the
             # feed stitches them into one global jax.Array (parallel/feed.py)
@@ -310,11 +345,16 @@ class DenoisingAutoencoder:
                 self.opt_state = put_replicated(host[1], self.mesh)
         else:
             self._train_step = make_train_step(self.config, self.optimizer,
-                                               loss_fn=self._loss_fn)
+                                               loss_fn=self._loss_fn,
+                                               accum_steps=accum)
             self._eval_step = make_eval_step(self.config, loss_fn=self._loss_fn)
-            self._batch_multiple = 1
+            # batches round up to a multiple of accum_steps so the jitted
+            # step's microbatch reshape is exact (1 when accum == 1: existing
+            # feeds and their records stay byte-identical)
+            self._batch_multiple = accum
             self._model_axis = None
             self._multiprocess = False
+        self._accum_effective = accum
         self._encode_fn = make_encode_fn(self.config)
         self._sparse_encode_fn = None  # built lazily per config in transform()
 
@@ -541,7 +581,15 @@ class DenoisingAutoencoder:
                     extra={"model": type(self).__name__, "batch_size": b,
                            "n_batches": n_batches,
                            "num_epochs": self.num_epochs,
-                           "seed": self._resolved_seed}))
+                           "seed": self._resolved_seed,
+                           # mined-training provenance: which mining
+                           # implementation the step dispatches to and the
+                           # accumulation actually in effect (plus why it
+                           # fell back, if it did — never silent)
+                           "mining_impl": self.mining_impl,
+                           "accum_steps": self._accum_effective,
+                           **({"accum_fallback": self._accum_fallback}
+                              if self._accum_fallback else {})}))
             except OSError:
                 pass
         if resident_mode:
@@ -549,8 +597,9 @@ class DenoisingAutoencoder:
 
             resident_data = resident_mod.build_resident(train_set, labels,
                                                         labels2)
-            epoch_fn = resident_mod.make_epoch_fn(self.config, self.optimizer,
-                                                  loss_fn=self._loss_fn)
+            epoch_fn = resident_mod.make_epoch_fn(
+                self.config, self.optimizer, loss_fn=self._loss_fn,
+                accum_steps=self._accum_effective)
         pipelined_mode = feed_mode == "pipelined"
         if pipelined_mode:
             from ..train.pipeline import FeedStats, PipelinedFeed
@@ -572,7 +621,8 @@ class DenoisingAutoencoder:
                 place = None
                 pipe_step = make_train_step(self.config, self.optimizer,
                                             loss_fn=self._loss_fn,
-                                            donate_batch=True)
+                                            donate_batch=True,
+                                            accum_steps=self._accum_effective)
 
         for e in range(self.num_epochs):
             epoch = self._epoch0 + e + 1

@@ -77,6 +77,20 @@ def corrupt(key, x, corr_type, corr_frac, n_features=None, mn=None, mx=None):
     if corr_type != "none" and not 0.0 <= float(corr_frac) <= 1.0:
         raise ValueError(f"corr_frac must be in [0, 1], got {corr_frac}")
     if corr_type == "masking":
+        if jax.default_backend() == "tpu" and float(corr_frac) > 0.0:
+            # fused hardware-PRNG kernel (same auto-dispatch pattern as the
+            # mining paths, train/step.py resolve_mining_impl): one
+            # read-mask-write pass with on-chip randomness instead of
+            # threefry bit generation + separate where. Distributionally
+            # identical, different stream — the kernel is seeded from the
+            # step key so runs remain reproducible by key. Trace-time
+            # static branch: every other backend (and corr_frac == 0)
+            # keeps the threefry path byte-stable.
+            from .pallas_kernels import masking_noise_pallas
+
+            seed = jax.random.randint(key, (), 0, jnp.iinfo(jnp.int32).max,
+                                      dtype=jnp.int32)
+            return masking_noise_pallas(seed, x, float(corr_frac))
         return masking_noise(key, x, corr_frac)
     if corr_type == "salt_and_pepper":
         f = n_features if n_features is not None else x.shape[1]

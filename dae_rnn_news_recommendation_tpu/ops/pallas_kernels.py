@@ -13,11 +13,12 @@
    (pltpu.prng_seed / prng_random_bits): one read-mask-write pass with on-chip
    randomness instead of counter-based threefry bit generation.
 
-STATUS: VALIDATED INFRASTRUCTURE, NOT PRODUCTION (final as of round 3;
-re-confirmed round 5 with fetch-fenced timing). Measured on a real v5e-1:
-XLA wins batch_all — its fusion also never materializes the cube (runs B=4096
-where the cube would be 256 GiB). Round-5 numbers (2026-08-02, hard host-fetch
-sync per bench.py:_hard_sync — the earlier block_until_ready timings were
+STATUS: DISPATCHED AT LARGE BATCH / ON-TPU MASKING (promoted round 6 for the
+regimes the dense path cannot reach; small-batch mining stays on XLA). The
+round-3/5 measurements stand: on a real v5e-1 XLA wins dense-representable
+batch_all — its fusion also never materializes the cube (runs B=4096 where
+the cube would be 256 GiB). Round-5 numbers (2026-08-02, hard host-fetch sync
+per bench.py:_hard_sync — the earlier block_until_ready timings were
 optimistic for BOTH sides, ratio unchanged): grad-step XLA vs Pallas
 8.6 vs 30.2 ms at B=800/D=500; 129 vs 288 ms at B=2048; 950 vs 2308 ms at
 B=4096, tiles (8,128,128). Masking is sub-millisecond in both forms at
@@ -25,14 +26,14 @@ B=4096, tiles (8,128,128). Masking is sub-millisecond in both forms at
 re-tune (tile sweep + fused-mask variant) was abandoned as unmeasurable: the
 tunnel memoizes (executable, inputs) dispatches, so microbenchmarks neither scale
 with volume nor reproduce (any future attempt must feed DISTINCT inputs per
-dispatch, bench.py-style). Per the "let XLA fuse" rule the XLA paths
-(ops/triplet.py, ops/corruption.py) are the production default on every driver
-and training path, and no re-tune TODO is carried: these kernels are kept
-because they exercise and document the repo's Pallas layer (3-D grid
-accumulation, Mosaic layout constraints, hardware PRNG) with oracle tests, and
-as the starting point if a future chip/shape shifts the balance — the evidence
-bar for promotion is a measured end-to-end win on hardware with distinct-input
-timing, volume scaling verified.
+dispatch, bench.py-style). Dispatch policy today (train/step.py
+resolve_mining_impl + ops/corruption.py): mining batches <= 1024 rows keep the
+measured-fastest dense XLA path byte-stable; past that the cube's footprint —
+not FLOPs — is binding, and "auto" routes to these kernels on TPU (the
+anchor-tiled XLA scan in ops/triplet_blockwise.py elsewhere, which is also
+the large-B parity oracle for them); TPU masking corruption routes here
+unconditionally (fused pass, hardware PRNG). bench.py's train_mined_big
+corner is the evidence harness for the large-batch claim.
 
 Mosaic layout rules discovered on hardware (encoded in the kernels/asserts below):
 3D reductions need keepdims (or drop axis 0 only); [n,1,1]->(n,1) reshape lowers but
@@ -76,8 +77,9 @@ def _tile_terms(dp_ij, dp_ik, a, b, j, k, tj, tk, pos_only):
     kk = jax.lax.broadcasted_iota(jnp.int32, (tj, tk), 1) + k * tk
     neq_jk = (jj != kk).astype(jnp.float32)
 
-    # the [ti, tj, tk] cube exists only as this VMEM tile
+    # jaxcheck: disable=R8 (a [ti,tj,tk] VMEM tile, not the HBM cube — the cube exists only blockwise)
     valid3 = a[:, :, None] * b[:, None, :] * neq_jk[None, :, :]
+    # jaxcheck: disable=R8 (a [ti,tj,tk] VMEM tile, not the HBM cube — the cube exists only blockwise)
     dist = dp_ik[:, None, :] - dp_ij[:, :, None]   # reference :96-106
     pos3 = (valid3 * dist > _EPS).astype(jnp.float32)  # reference :114
     mask = pos3 if pos_only else valid3
@@ -342,6 +344,194 @@ def batch_all_triplet_loss_pallas(labels, encode, pos_triplets_only=False,
         interpret = not _on_tpu()
     return _batch_all_loss_vjp(labels, encode, bool(pos_triplets_only),
                                row_valid, tuple(tiles), bool(interpret))
+
+
+# --------------------------------------------------------------------- batch_hard
+
+def _batch_hard_kernel(dp_ref, a_ref, b_ref, rv_ref, cr_ref, va_ref,
+                       stats_ref, aw_ref, hp_hits_ref, hn_hits_ref, *, ti):
+    """Full-row batch_hard mining: one grid axis over anchor row-blocks, each
+    step sees [ti, Bp] rows of dp + masks. Single grid axis == innermost axis,
+    so the stats/hits output blocks are revisited on consecutive steps only —
+    the one accumulation pattern compiled Mosaic guarantees (see the batch_all
+    backward kernels).
+
+    Padded-COLUMN handling (the blockwise XLA twin avoids fake columns by
+    padding anchors only; here both axes pad to the tile step):
+      * hardest positive: dense min ranges over dp + max_row*(1-mask) of REAL
+        columns — real-but-invalid columns contribute their shifted dp. Fake
+        columns must contribute +inf: an anchor with no valid positive takes
+        its min over shifted real dp, and a fake column's dp=0 + max_row
+        could win it.
+      * hardest negative: dense max ranges over mask*dp of real columns, so
+        invalid REAL columns are literal zeros (reference :240) — but that
+        max can be negative (all columns valid negatives, all dp < 0), so
+        fake zero columns must be -inf, not 0."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        stats_ref[:] = jnp.zeros_like(stats_ref)
+        hp_hits_ref[:] = jnp.zeros_like(hp_hits_ref)
+        hn_hits_ref[:] = jnp.zeros_like(hn_hits_ref)
+
+    dp = dp_ref[:]                # [ti, Bp] dot products, this block of anchors
+    a = a_ref[:]                  # [ti, Bp] anchor/positive validity
+    bm = b_ref[:]                 # [ti, Bp] anchor/negative validity
+    rv = rv_ref[:]                # [1, Bp]  row_valid over columns (pad -> 0)
+    cr = cr_ref[:]                # [1, Bp]  1.0 iff the column is a real row
+    va = va_ref[:]                # [ti, 1]  row_valid for this block's anchors
+
+    neg_inf = jnp.float32(-jnp.inf)
+    # valid-column row max with the dense guard — no isfinite in Mosaic, so
+    # gate on the valid-column count instead (equivalent: the max is -inf
+    # exactly when no column is valid)
+    n_valid = jnp.sum(rv, axis=1, keepdims=True)                    # [1, 1]
+    max_row = jnp.max(jnp.where(rv > 0.0, dp, neg_inf), axis=1,
+                      keepdims=True)                                # [ti, 1]
+    max_row = jnp.where(n_valid > 0.0, max_row, 0.0)
+
+    ap_dp = jnp.where(cr > 0.0, dp + max_row * (1.0 - a),
+                      jnp.float32(jnp.inf))
+    hardest_pos = jnp.min(ap_dp, axis=1, keepdims=True)             # [ti, 1]
+    an_dp = jnp.where(cr > 0.0, bm * dp, neg_inf)
+    hardest_neg = jnp.max(an_dp, axis=1, keepdims=True)             # [ti, 1]
+
+    dist = jnp.maximum(hardest_neg - hardest_pos, 0.0)
+    count = (dist > 0.0).astype(jnp.float32) * va                   # [ti, 1]
+
+    aw_ref[pl.ds(pl.multiple_of(i * ti, 8), ti), :] = count
+    # float-equality tie hits (reference :251-253), padded columns gated by rv
+    hp_hits_ref[:] += jnp.sum(count * (dp == hardest_pos).astype(jnp.float32)
+                              * rv, axis=0, keepdims=True)          # [1, Bp]
+    hn_hits_ref[:] += jnp.sum(count * (dp == hardest_neg).astype(jnp.float32)
+                              * rv, axis=0, keepdims=True)
+
+    s_loss = jnp.sum(jax.nn.softplus(dist) * count)
+    total = jnp.sum(count)
+    sum_hp = jnp.sum(hardest_pos * va)
+    sum_hn = jnp.sum(hardest_neg * va)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+    contrib = jnp.where(lane == 0, s_loss,
+                        jnp.where(lane == 1, total,
+                                  jnp.where(lane == 2, sum_hp,
+                                            jnp.where(lane == 3, sum_hn,
+                                                      0.0))))
+    stats_ref[:] += contrib
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _batch_hard_pallas(dp, a, bm, rv, cr, va, block_rows, interpret):
+    bp = dp.shape[0]
+    ti = block_rows
+    row_spec = pl.BlockSpec((ti, bp), lambda i: (i, 0))
+    full_row = pl.BlockSpec((1, bp), lambda i: (0, 0))
+    return pl.pallas_call(
+        functools.partial(_batch_hard_kernel, ti=ti),
+        grid=(bp // ti,),
+        in_specs=[
+            row_spec,                                   # dp rows
+            row_spec,                                   # anchor/positive mask
+            row_spec,                                   # anchor/negative mask
+            full_row,                                   # row_valid columns
+            full_row,                                   # real-column mask
+            pl.BlockSpec((ti, 1), lambda i: (i, 0)),    # anchor validity
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 128), lambda i: (0, 0)),   # stats lanes
+            pl.BlockSpec((bp, 1), lambda i: (0, 0)),    # per-anchor count
+            full_row,                                   # hardest-pos tie hits
+            full_row,                                   # hardest-neg tie hits
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, bp), jnp.float32),
+            jax.ShapeDtypeStruct((1, bp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(dp, a, bm, rv, cr, va)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _batch_hard_loss_vjp(labels, encode, row_valid, block_rows, interpret):
+    """Differentiable core: only `loss` carries gradient (count/tie outputs
+    are comparison-derived, true gradient zero — same argument as batch_all)."""
+    out, _ = _batch_hard_fwd(labels, encode, row_valid, block_rows, interpret)
+    return out
+
+
+def _batch_hard_fwd(labels, encode, row_valid, block_rows, interpret):
+    b = labels.shape[0]
+    dtype = encode.dtype
+    validf = ((jnp.ones(b) if row_valid is None else row_valid)
+              .astype(jnp.float32))
+    # reuse the batch_all prep: same dp / pair masks, padded to the tile step
+    dp, a, bm = _prep_masks(labels, encode, row_valid, (block_rows, 8, 128),
+                            interpret)
+    bp = dp.shape[0]
+    rv = jnp.pad(validf, (0, bp - b)).reshape(1, bp)
+    cr = (jnp.arange(bp) < b).astype(jnp.float32).reshape(1, bp)
+    va = rv.reshape(bp, 1)
+    stats, aw, hph, hnh = _batch_hard_pallas(dp, a, bm, rv, cr, va,
+                                             int(block_rows), bool(interpret))
+    s_loss, total, sum_hp, sum_hn = (stats[0, 0], stats[0, 1], stats[0, 2],
+                                     stats[0, 3])
+    data_weight = (aw[:, 0] + hph[0] + hnh[0])[:b].astype(dtype)
+    loss = (s_loss / jnp.maximum(total, _EPS)).astype(dtype)
+    n_rows = jnp.sum(validf)
+    fraction = (total / jnp.maximum(n_rows, 1.0)).astype(dtype)
+    extras = {
+        "hardest_positive_dotproduct":
+            (sum_hp / jnp.maximum(n_rows, 1.0)).astype(dtype),
+        "hardest_negative_dotproduct":
+            (sum_hn / jnp.maximum(n_rows, 1.0)).astype(dtype),
+    }
+    out = (loss, data_weight, fraction, total.astype(dtype), extras)
+    residuals = (labels, encode, row_valid)
+    return out, residuals
+
+
+def _batch_hard_bwd(block_rows, interpret, residuals, cotangents):
+    """Recompute-backward through the O(B^2) blockwise twin: batch_hard's
+    gradient is min/max routing over the [B, B] dot matrix (no cube), so XLA
+    autodiff of the anchor-tiled scan — tie subgradients identical to the
+    dense path — is already memory-optimal; a hand-written transpose kernel
+    would buy nothing."""
+    labels, encode, row_valid = residuals
+    from .triplet_blockwise import batch_hard_triplet_loss_blockwise
+
+    loss_bar = cotangents[0]
+    de = jax.grad(
+        lambda e: batch_hard_triplet_loss_blockwise(
+            labels, e, row_valid=row_valid)[0])(encode)
+    return None, de * loss_bar.astype(encode.dtype), None
+
+
+_batch_hard_loss_vjp.defvjp(_batch_hard_fwd, _batch_hard_bwd)
+
+
+def batch_hard_triplet_loss_pallas(labels, encode, row_valid=None,
+                                   block_rows=8, interpret=None):
+    """Drop-in for ops.triplet.batch_hard_triplet_loss, tiled over anchor
+    row-blocks so only [block_rows, B] slabs of the dot matrix live in VMEM.
+
+    Keeps the dense reference's quirks bit-for-bit where they are observable
+    (zero-valued invalid negatives, float-equality tie counting in
+    data_weight) — see _batch_hard_kernel's padded-column notes for why the
+    pad columns need ±inf sentinels rather than zeros. Trainable via a
+    custom VJP that recomputes through the blockwise XLA twin
+    (ops/triplet_blockwise.py), which is O(B^2) by construction.
+
+    Same return tuple: (loss, data_weight[B], fraction, num_triplets, extras).
+
+    :param block_rows: anchor rows per grid step; compiled requires %8==0.
+    :param interpret: force interpreter mode (defaults to True off-TPU).
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _batch_hard_loss_vjp(labels, encode, row_valid, int(block_rows),
+                                bool(interpret))
 
 
 # ------------------------------------------------------------------ masking noise

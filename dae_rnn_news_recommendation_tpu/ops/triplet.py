@@ -64,13 +64,16 @@ def triplet_mask(labels, row_valid=None):
     i_ne_j = not_eye[:, :, None]
     i_ne_k = not_eye[:, None, :]
     j_ne_k = not_eye[None, :, :]
+    # jaxcheck: disable=R8 (dense reference oracle — O(B^3) by design; auto-dispatch routes B>1024 to blockwise/pallas)
     distinct = i_ne_j & i_ne_k & j_ne_k
 
     label_eq = labels[None, :] == labels[:, None]
     i_eq_j = label_eq[:, :, None]
     i_eq_k = label_eq[:, None, :]
+    # jaxcheck: disable=R8 (dense reference oracle — O(B^3) by design; auto-dispatch routes B>1024 to blockwise/pallas)
     valid_labels = i_eq_j & (~i_eq_k)
 
+    # jaxcheck: disable=R8 (dense reference oracle — O(B^3) by design; auto-dispatch routes B>1024 to blockwise/pallas)
     all_valid = valid[:, None, None] & valid[None, :, None] & valid[None, None, :]
     return distinct & valid_labels & all_valid
 
@@ -91,6 +94,7 @@ def batch_all_triplet_loss(labels, encode, pos_triplets_only=False, row_valid=No
     dp = jnp.matmul(encode, encode.T, precision=jax.lax.Precision.HIGHEST)
 
     # d[i,j,k] = -dp(anchor=i, pos=j) + dp(anchor=i, neg=k)   (reference :96-106)
+    # jaxcheck: disable=R8 (dense reference oracle — O(B^3) by design; auto-dispatch routes B>1024 to blockwise/pallas)
     dist = -dp[:, :, None] + dp[:, None, :]
 
     valid_mask = triplet_mask(labels, row_valid).astype(dtype)

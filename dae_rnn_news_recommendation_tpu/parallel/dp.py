@@ -24,7 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import telemetry
 from ..telemetry.health import sentinel_metrics
-from ..train.step import loss_and_metrics
+from ..train.step import grads_and_metrics, loss_and_metrics
 from .mesh import get_mesh  # noqa: F401  (re-exported for the estimator)
 
 _ROW_MATRICES = ("x", "x_corr", "org", "pos", "neg", "org_corr", "pos_corr",
@@ -92,7 +92,8 @@ def batch_shardings(mesh, keys, data_axis="data", model_axis=None):
 def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
                              loss_fn=loss_and_metrics, data_axis="data",
                              model_axis=None, donate=True,
-                             weight_update_sharding=False, health=True):
+                             weight_update_sharding=False, health=True,
+                             accum_steps=1):
     """Returns step(params, opt_state, key, batch) -> (params, opt_state, metrics).
 
     Inputs may be ordinary host arrays; jit's in_shardings place them on the mesh.
@@ -104,6 +105,15 @@ def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
         returned metrics. Norms are over the GLOBAL grads/updates in both
         mining scopes (the sentinel runs outside shard_map, after the update),
         so the flags mean the same thing on any mesh.
+    :param accum_steps: microbatch gradient accumulation inside the jitted
+        step (train/step.py grads_and_metrics) — 'global' mining scope only.
+        Each microbatch keeps its rows sharded over the data axis (the
+        [accum, B/accum, ...] reshape splits the leading axis, so XLA keeps
+        row ownership; global mining all_gathers one microbatch's embeddings
+        at a time). 'shard' raises: its objective lives inside shard_map
+        where the batch split would need per-shard replication of the scan —
+        the estimator falls back to accum_steps=1 there WITH a recorded
+        reason (models/estimator.py), never silently.
     """
     if mining_scope == "global":
         if weight_update_sharding and model_axis is not None:
@@ -114,13 +124,19 @@ def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
             _make_global_step(config, optimizer, mesh, loss_fn, data_axis,
                               model_axis, donate,
                               weight_update_sharding=weight_update_sharding,
-                              health=health),
+                              health=health, accum_steps=accum_steps),
             "train/step")
     if mining_scope == "shard":
         if weight_update_sharding:
             raise ValueError("weight_update_sharding requires the jit/global "
                              "path (XLA derives the reduce_scatter); "
                              "mining_scope='shard' runs inside shard_map")
+        if accum_steps > 1:
+            raise ValueError(
+                "accum_steps > 1 requires mining_scope='global' (the shard "
+                "objective runs inside shard_map; splitting the batch there "
+                "changes local-mining semantics per microbatch). The "
+                "estimator records this fallback in the run manifest.")
         return telemetry.instrument(
             _make_shard_step(config, optimizer, mesh, loss_fn, data_axis,
                              donate, health=health),
@@ -129,10 +145,11 @@ def make_parallel_train_step(config, optimizer, mesh, mining_scope="global",
 
 
 def _make_global_step(config, optimizer, mesh, loss_fn, data_axis, model_axis,
-                      donate, weight_update_sharding=False, health=True):
+                      donate, weight_update_sharding=False, health=True,
+                      accum_steps=1):
     def step(params, opt_state, key, batch):
-        (cost, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch, key, config)
+        cost, metrics, grads = grads_and_metrics(loss_fn, config, params,
+                                                 batch, key, accum_steps)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         if health:
             metrics = {**metrics,

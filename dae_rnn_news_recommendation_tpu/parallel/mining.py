@@ -57,15 +57,19 @@ def sharded_batch_all_triplet_loss(labels, encode_local, encode, axis_name,
 
     dp = jnp.matmul(encode_local, encode.T,
                     precision=jax.lax.Precision.HIGHEST)  # [B_local, B]
+    # jaxcheck: disable=R8 (anchor-sliced slab [B_local,B,B] — the shard axis already tiles the cube)
     dist = -dp[:, :, None] + dp[:, None, :]  # [B_local, B, B]
 
     # triplet mask, anchor axis sliced (ops/triplet.py:58 semantics)
     g_idx = jnp.arange(b)
     a_ne = a_idx[:, None] != g_idx[None, :]             # [B_local, B] a != j
     p_ne_n = ~jnp.eye(b, dtype=bool)
+    # jaxcheck: disable=R8 (anchor-sliced slab [B_local,B,B] — the shard axis already tiles the cube)
     distinct = a_ne[:, :, None] & a_ne[:, None, :] & p_ne_n[None, :, :]
     label_eq = labels_a[:, None] == labels[None, :]     # [B_local, B]
+    # jaxcheck: disable=R8 (anchor-sliced slab [B_local,B,B] — the shard axis already tiles the cube)
     valid_labels = label_eq[:, :, None] & (~label_eq[:, None, :])
+    # jaxcheck: disable=R8 (anchor-sliced slab [B_local,B,B] — the shard axis already tiles the cube)
     all_valid = (valid_a[:, None, None] & valid[None, :, None]
                  & valid[None, None, :])
     valid_mask = (distinct & valid_labels & all_valid).astype(dtype)

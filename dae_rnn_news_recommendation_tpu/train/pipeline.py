@@ -111,19 +111,28 @@ class FeedStats:
         }
 
 
-def bucket_sizes(batch_size, n_buckets=3, floor=32):
+def bucket_sizes(batch_size, n_buckets=3, floor=32, multiple=1):
     """The fixed set of leading-dim shapes a pipelined epoch may compile.
 
     Halving buckets from `batch_size` down to `floor`: a ragged tail of any
     size pads up by at most 2x instead of compiling its own program. Returns
     an ascending tuple; len(buckets) bounds per-epoch compilations.
+
+    `multiple` rounds every bucket up to a multiple of it (deduplicating
+    collisions) — feeds driving a microbatch-accumulated step (accum_steps,
+    train/step.py) or a data mesh need every compiled shape, ragged-tail
+    buckets included, divisible by it.
     """
     assert int(batch_size) >= 1
+    assert int(multiple) >= 1
     sizes = {int(batch_size)}
     s = int(batch_size)
     while len(sizes) < n_buckets and s // 2 >= floor:
         s //= 2
         sizes.add(s)
+    m = int(multiple)
+    if m > 1:
+        sizes = {int(-(-sz // m) * m) for sz in sizes}
     return tuple(sorted(sizes))
 
 

@@ -30,7 +30,7 @@ import scipy.sparse as sp
 
 from .. import telemetry
 from ..telemetry.health import sentinel_metrics
-from .step import loss_and_metrics
+from .step import grads_and_metrics, loss_and_metrics
 
 # resident sparse feeds reuse the streaming feed's padded layout
 _DENSE_BYTES_PER_VAL = 4
@@ -101,7 +101,8 @@ def stack_epoch_indices(batcher, n_rows):
     return np.stack(perms), np.stack(valids)
 
 
-def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics, health=True):
+def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics, health=True,
+                  accum_steps=1):
     """Build the jitted whole-epoch function.
 
     epoch_fn(params, opt_state, key, resident, perm, row_valid, extremes)
@@ -120,6 +121,12 @@ def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics, health=True):
     `health=True` merges the numeric sentinel (telemetry/health.py) into each
     scan step's metrics slot — stacked [S] like every other metric, fetched
     in the same once-per-epoch download.
+
+    `accum_steps>1` runs each scan step as a microbatch-accumulated update
+    (train/step.py grads_and_metrics): an inner scan over row-contiguous
+    microbatch slices of the gathered batch, one optimizer update per outer
+    step, sentinel on the accumulated gradient — still one compile for the
+    whole epoch.
     """
 
     def gather_batch(resident, idx, rv, extremes):
@@ -146,8 +153,8 @@ def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics, health=True):
             idx, rv = sl
             batch = gather_batch(resident, idx, rv, extremes)
             key, sub = jax.random.split(key)
-            (cost, metrics), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch, sub, config)
+            cost, metrics, grads = grads_and_metrics(
+                loss_fn, config, params, batch, sub, accum_steps)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             if health:
                 metrics = {**metrics,

@@ -36,6 +36,74 @@ _SPARSE_FEED_KEYS = {
     "neg": ("neg_indices", "neg_values"),
 }
 
+# largest batch "auto" keeps on the dense O(B^3) reference path. At the
+# repo's record shapes (B=800, D=500) dense XLA wins — its fusion never
+# materializes the cube either (ops/pallas_kernels.py STATUS) — and keeping
+# small batches there leaves every existing CPU record byte-stable. Past
+# this, the cube's footprint (and at 8k+, its address space) is the binding
+# constraint, which is exactly what the tiled paths remove.
+_DENSE_AUTO_MAX_ROWS = 1024
+
+MINING_IMPLS = ("auto", "dense", "blockwise", "pallas")
+
+
+def resolve_mining_impl(mining_impl, batch_rows):
+    """Resolve a `mining_impl` config knob to a concrete implementation.
+
+    Static (trace-time) decision: `batch_rows` is a shape and the backend
+    query touches no tracers, so the jitted step bakes in exactly one path.
+
+    auto -> "dense" at small batch (<= _DENSE_AUTO_MAX_ROWS: the measured-
+    fastest path, and byte-stable with prior records), else "pallas" on TPU
+    (VMEM-tiled kernels, ops/pallas_kernels.py) and "blockwise" anywhere
+    else (anchor-tiled O(B^2) scan, ops/triplet_blockwise.py — CPU tier-1
+    can mine batches the dense cube cannot represent).
+    """
+    if mining_impl not in MINING_IMPLS:
+        raise ValueError(
+            f"mining_impl must be one of {MINING_IMPLS}, got {mining_impl!r}")
+    if mining_impl != "auto":
+        return mining_impl
+    if batch_rows <= _DENSE_AUTO_MAX_ROWS:
+        return "dense"
+    if jax.default_backend() == "tpu":
+        return "pallas"
+    return "blockwise"
+
+
+def mine_triplets(strategy, labels, encode, row_valid=None,
+                  mining_impl="auto"):
+    """Dispatch one mining term to its implementation.
+
+    Returns the shared tuple (loss, data_weight[B], fraction, num, extras)
+    whichever path runs; all three implementations are parity-tested against
+    each other (tests/test_mining_dispatch.py).
+    """
+    impl = resolve_mining_impl(mining_impl, encode.shape[0])
+    if strategy == "batch_all":
+        if impl == "dense":
+            return triplet.batch_all_triplet_loss(labels, encode,
+                                                  row_valid=row_valid)
+        if impl == "blockwise":
+            from ..ops.triplet_blockwise import batch_all_triplet_loss_blockwise
+            return batch_all_triplet_loss_blockwise(labels, encode,
+                                                    row_valid=row_valid)
+        from ..ops.pallas_kernels import batch_all_triplet_loss_pallas
+        return batch_all_triplet_loss_pallas(labels, encode,
+                                             row_valid=row_valid)
+    if strategy == "batch_hard":
+        if impl == "dense":
+            return triplet.batch_hard_triplet_loss(labels, encode,
+                                                   row_valid=row_valid)
+        if impl == "blockwise":
+            from ..ops.triplet_blockwise import batch_hard_triplet_loss_blockwise
+            return batch_hard_triplet_loss_blockwise(labels, encode,
+                                                     row_valid=row_valid)
+        from ..ops.pallas_kernels import batch_hard_triplet_loss_pallas
+        return batch_hard_triplet_loss_pallas(labels, encode,
+                                              row_valid=row_valid)
+    raise ValueError(f"unknown mining strategy: {strategy!r}")
+
 
 def materialize_x(batch, config):
     """Ensure the dense inputs exist: sparse-ingest feeds ship (indices, values)
@@ -83,14 +151,11 @@ def loss_and_metrics(params, batch, key, config):
     y = dae_core.decode(params, h, config)
 
     if config.triplet_strategy != "none":
-        if config.triplet_strategy == "batch_all":
-            t_loss, data_weight, fraction, num, extras = triplet.batch_all_triplet_loss(
-                batch["labels"], h, row_valid=row_valid
-            )
-        else:
-            t_loss, data_weight, fraction, num, extras = triplet.batch_hard_triplet_loss(
-                batch["labels"], h, row_valid=row_valid
-            )
+        mining_impl = getattr(config, "mining_impl", "auto")
+        t_loss, data_weight, fraction, num, extras = mine_triplets(
+            config.triplet_strategy, batch["labels"], h, row_valid=row_valid,
+            mining_impl=mining_impl
+        )
         if config.label2_alpha > 0.0 and "labels2" in batch:
             # joint two-label mining: a second batch_all term over labels2
             # (always batch_all — batch_hard's max/min would let one label's
@@ -102,8 +167,8 @@ def loss_and_metrics(params, batch, key, config):
             lab2 = batch["labels2"]
             has2 = (lab2 >= 0).astype(h.dtype)
             rv2 = has2 if row_valid is None else row_valid * has2
-            t2_loss, data_weight2, _, _, _ = triplet.batch_all_triplet_loss(
-                lab2, h, row_valid=rv2
+            t2_loss, data_weight2, _, _, _ = mine_triplets(
+                "batch_all", lab2, h, row_valid=rv2, mining_impl=mining_impl
             )
             t_loss = t_loss + config.label2_alpha * t2_loss
             data_weight = jnp.maximum(data_weight, data_weight2)
@@ -184,8 +249,88 @@ def triplet_loss_and_metrics(params, batch, key, config):
     }
 
 
+def _batch_rows(batch):
+    """Static leading batch dimension of a feed dict."""
+    if "row_valid" in batch:
+        return batch["row_valid"].shape[0]
+    return max(v.shape[0] for v in batch.values()
+               if getattr(v, "ndim", 0) >= 1)
+
+
+def split_microbatches(batch, accum_steps):
+    """Split a batch dict into scan inputs for gradient accumulation.
+
+    Returns (xs, shared): `xs` holds every array with the batch's leading
+    dimension reshaped to [accum_steps, rows/accum_steps, ...] (a free
+    relayout — row-major means microbatches are contiguous row slices);
+    `shared` holds everything else (the corr_min/corr_max scalars), passed
+    to every microbatch unchanged. Trace-time static; raises if accum_steps
+    does not divide the batch rows (the estimator's batch-multiple rounding
+    guarantees it on its feeds)."""
+    rows = _batch_rows(batch)
+    if rows % accum_steps != 0:
+        raise ValueError(
+            f"accum_steps={accum_steps} must divide the batch rows ({rows}); "
+            "round the batch size up to a multiple (the estimator's batcher "
+            "does this automatically)")
+    micro = rows // accum_steps
+    xs, shared = {}, {}
+    for k, v in batch.items():
+        if getattr(v, "ndim", 0) >= 1 and v.shape[0] == rows:
+            xs[k] = v.reshape((accum_steps, micro) + tuple(v.shape[1:]))
+        else:
+            shared[k] = v
+    return xs, shared
+
+
+def grads_and_metrics(loss_fn, config, params, batch, key, accum_steps=1):
+    """value_and_grad of `loss_fn`, optionally accumulated over microbatches.
+
+    The one gradient producer shared by the streaming/pipelined step
+    (make_train_step), the resident epoch scan (train/resident.py), and the
+    mesh-parallel global step (parallel/dp.py). With accum_steps > 1 the
+    batch splits into `accum_steps` row-contiguous microbatches and a
+    `lax.scan` accumulates their gradients in a donated carry — one traced
+    program regardless of accum_steps (no per-microbatch retrace;
+    tests/test_accum.py pins the compile count), peak activation memory
+    that of ONE microbatch. Each microbatch corrupts under its own key
+    (split from the step key), mirroring how the same rows fed as separate
+    batches would draw distinct corruption.
+
+    Returns (cost, metrics, grads) with cost/grads MEANED over microbatches
+    — identical in expectation to one huge-batch step (every loss term is a
+    batch mean) — and each scalar metric averaged the same way. Mining note:
+    mining is per-microbatch (triplets never cross microbatch boundaries),
+    so at accum_steps>1 the mined population is the microbatch, not the
+    effective batch — docs/mining.md covers the tradeoff.
+    """
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    if accum_steps <= 1:
+        (cost, metrics), grads = grad_fn(params, batch, key, config)
+        return cost, metrics, grads
+
+    xs, shared = split_microbatches(batch, accum_steps)
+    keys = jax.random.split(key, accum_steps)
+
+    def body(carry, sl):
+        g_acc, c_acc = carry
+        mb, sub = sl
+        (cost, metrics), grads = grad_fn(params, {**shared, **mb}, sub,
+                                         config)
+        g_acc = jax.tree_util.tree_map(lambda a, g: a + g, g_acc, grads)
+        return (g_acc, c_acc + cost), metrics
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (g_sum, c_sum), stacked = jax.lax.scan(
+        body, (zeros, jnp.zeros((), jnp.float32)), (xs, keys))
+    inv = 1.0 / accum_steps
+    grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
+    metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0), stacked)
+    return c_sum * inv, metrics, grads
+
+
 def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True,
-                    donate_batch=False, health=True):
+                    donate_batch=False, health=True, accum_steps=1):
     """Build the jitted train step. `config` is static; params/opt_state are donated
     so XLA updates them in place in HBM.
 
@@ -199,12 +344,16 @@ def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True,
     `health=True` merges the in-graph numeric sentinel
     (telemetry/health.py: isfinite flags, grad/param norms, update ratio)
     into the returned metrics — same fetch, no extra sync; `health=False` is
-    the plain step (the overhead baseline in tests/test_health.py)."""
+    the plain step (the overhead baseline in tests/test_health.py).
+
+    `accum_steps>1` accumulates gradients over that many row-contiguous
+    microbatches inside this SAME jitted program (grads_and_metrics):
+    one optimizer update per call, one compile total, sentinel computed on
+    the accumulated gradient outside the inner scan."""
 
     def step(params, opt_state, key, batch):
-        (cost, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch, key, config
-        )
+        cost, metrics, grads = grads_and_metrics(loss_fn, config, params,
+                                                 batch, key, accum_steps)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         if health:
             metrics = {**metrics,

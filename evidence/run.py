@@ -572,6 +572,28 @@ def main(argv=None):
           f"held-out pairwise rank accuracy {user['rank_accuracy']:.4f} "
           f"± {u_ci:.4f} (95% CI over {user['n_users_eval']} users) "
           "lower bound > 0.6 (chance 0.5)")
+    # ISSUE 5 acceptance: large-batch MINED training sustains real MXU
+    # utilization. TPU-gated — bench.py's mined-big corner is TPU-only by
+    # design (the CPU record carries an explicit skip note instead), so a
+    # CPU evidence run asserts nothing it cannot measure. Reads the
+    # committed bench sidecar: the figure must come from a real hardware
+    # bench round, not be recomputed ad hoc here.
+    if platform == "tpu":
+        mined_mfu = None
+        try:
+            with open(os.path.join(HERE, "bench_tpu.json")) as f:
+                mined_mfu = (json.load(f)["record"]["extra"]
+                             .get("train_mined_big_mfu"))
+        except (OSError, ValueError, KeyError):
+            pass
+        check("train_mined_big_mfu_floor",
+              mined_mfu is not None and float(mined_mfu) >= 0.09,
+              (f"bench sidecar train_mined_big_mfu {mined_mfu} >= 0.09 "
+               "(B=8192 batch_all via the auto mining dispatch — the batch "
+               "the dense cube could never run)") if mined_mfu is not None
+              else ("evidence/bench_tpu.json has no train_mined_big_mfu — "
+                    "the sidecar predates the mined-big corner; rerun "
+                    "bench.py on TPU to capture it"))
     check("user_category_top1", user["category_top1_accuracy"] > 0.6,
           f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.6 "
           "(chance ~1/8; scored against 5-candidate category means — one "

@@ -1,0 +1,55 @@
+"""Planted R8 violations: the full [B, B, B] triplet cube, materialized by
+combining rank-3 expands with different None-position signatures
+(`dp[:, :, None] op dp[:, None, :]`). O(B^3) memory — the exact footprint
+the blockwise/Pallas mining dispatch (ISSUE 5) exists to avoid.
+
+The clean twins must NOT be flagged: rank-2 pairwise expands ([B,1] vs
+[1,B], the O(B^2) idiom the repo keeps everywhere), and same-signature
+rank-3 expands (no new axis is materialized by the combine).
+"""
+
+import jax.numpy as jnp
+
+
+def bad_cube_distance(dp):
+    # the canonical offender (ops/triplet.py:94 pre-dispatch)
+    dist = -dp[:, :, None] + dp[:, None, :]  # planted: R8
+    return jnp.sum(dist)
+
+
+def bad_cube_mask_through_names(labels, valid):
+    # signatures thread through simple name bindings
+    eq = labels[None, :] == labels[:, None]
+    i_eq_j = eq[:, :, None]
+    i_eq_k = eq[:, None, :]
+    valid_labels = i_eq_j & (~i_eq_k)  # planted: R8
+    return valid_labels
+
+
+def bad_cube_valid_chain(valid):
+    # chained & over three one-hot expands: the first combine births the cube
+    av = valid[:, None, None] & valid[None, :, None] & valid[None, None, :]  # planted: R8
+    return av
+
+
+def bad_cube_compare(dp):
+    # a broadcasting comparison materializes the same cube as arithmetic
+    harder = dp[:, :, None] > dp[:, None, :]  # planted: R8
+    return jnp.sum(harder)
+
+
+def ok_pairwise_rank2(labels, valid):
+    # [B,1] vs [1,B] expands: O(B^2), the repo's standard pairwise idiom
+    eq = labels[:, None] == labels[None, :]
+    vv = valid[:, None] & valid[None, :]
+    return eq & vv
+
+
+def ok_same_signature(x, y):
+    # both operands expand the SAME axis: result is [B, B, 1], not the cube
+    return x[:, :, None] - y[:, :, None]
+
+
+def ok_expand_times_scalar(dp):
+    # rank-3 expand combined with a scalar: no second signature, no cube
+    return dp[:, :, None] * 2.0

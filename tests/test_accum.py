@@ -1,0 +1,222 @@
+"""Microbatch gradient accumulation (ISSUE 5): `accum_steps` must change peak
+activation memory, not the math — an accumulated step is the same optimizer
+update as the full-batch step (to float tolerance, since only the reduction
+order moves), traced ONCE regardless of accum_steps, and its provenance
+(effective accum, any fallback reason) must land in the run manifest, never
+silently.
+
+Everything here runs on CPU; the no-mining, no-corruption objective makes the
+accum=K vs full-batch comparison key-independent (every loss term is a batch
+mean, and with equal microbatch sizes the mean of microbatch means IS the
+full-batch mean).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu.analysis import compile_guard
+from dae_rnn_news_recommendation_tpu.models import (
+    DAEConfig, DenoisingAutoencoder, init_params)
+from dae_rnn_news_recommendation_tpu.train import make_optimizer
+from dae_rnn_news_recommendation_tpu.train.step import (
+    grads_and_metrics, loss_and_metrics, make_train_step, split_microbatches)
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def _no_mining_config(f=12, d=5):
+    # corr_type="none" + triplet_strategy="none": the objective ignores the
+    # PRNG key, so per-microbatch key splitting cannot move the comparison
+    return DAEConfig(n_features=f, n_components=d, enc_act_func="tanh",
+                     dec_act_func="none", loss_func="mean_squared",
+                     corr_type="none", triplet_strategy="none")
+
+
+def _batch(rng, b, f):
+    return {"x": jnp.asarray(rng.uniform(size=(b, f)).astype(np.float32))}
+
+
+# ------------------------------------------------------------------ split
+
+def test_split_microbatches_shapes_and_shared(rng):
+    batch = {"x": jnp.asarray(rng.uniform(size=(12, 6)).astype(np.float32)),
+             "labels": jnp.asarray(rng.integers(0, 3, 12), jnp.int32),
+             "corr_min": np.float32(-0.5)}
+    xs, shared = split_microbatches(batch, 3)
+    assert xs["x"].shape == (3, 4, 6)
+    assert xs["labels"].shape == (3, 4)
+    assert set(shared) == {"corr_min"}
+    # row-major reshape: microbatch i is rows [4i, 4i+4) — contiguous slices
+    np.testing.assert_array_equal(np.asarray(xs["x"][1]),
+                                  np.asarray(batch["x"][4:8]))
+
+
+def test_split_microbatches_nondivisible_raises(rng):
+    batch = _batch(rng, 10, 4)
+    with pytest.raises(ValueError, match="accum_steps=3 must divide"):
+        split_microbatches(batch, 3)
+
+
+# ----------------------------------------------------- one-step parity
+
+def test_accum_grads_match_full_batch(rng):
+    """grads_and_metrics(accum_steps=4) returns the same cost and gradients
+    as the plain full-batch value_and_grad, to float tolerance."""
+    config = _no_mining_config()
+    params = init_params(jax.random.PRNGKey(0), config)
+    batch = _batch(rng, 32, config.n_features)
+    key = jax.random.PRNGKey(1)
+
+    c_full, m_full, g_full = grads_and_metrics(loss_and_metrics, config,
+                                               params, batch, key)
+    c_acc, m_acc, g_acc = grads_and_metrics(loss_and_metrics, config,
+                                            params, batch, key,
+                                            accum_steps=4)
+    np.testing.assert_allclose(float(c_acc), float(c_full), rtol=1e-6)
+    # same metric surface either way (accumulated metrics are meaned, never
+    # dropped)
+    assert set(m_acc) == set(m_full)
+    for (ka, ga), (kb, gb) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(g_full),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(g_acc),
+                   key=lambda kv: str(kv[0]))):
+        assert str(ka) == str(kb)
+        np.testing.assert_allclose(np.asarray(gb), np.asarray(ga),
+                                   atol=1e-6, err_msg=str(ka))
+
+
+def test_accum_trajectory_matches_full_batch(rng):
+    """Acceptance: a short training trajectory under make_train_step
+    (accum_steps=4) tracks the full-batch trajectory — the optimizer sees
+    the same gradients, so the parameters stay together step after step."""
+    config = _no_mining_config(f=10, d=4)
+    optimizer = make_optimizer("ada_grad", 0.1)
+    params = init_params(jax.random.PRNGKey(0), config)
+    params_acc = jax.tree_util.tree_map(jnp.array, params)
+    opt_state = optimizer.init(params)
+    opt_state_acc = optimizer.init(params_acc)
+    step_full = make_train_step(config, optimizer, donate=False)
+    step_acc = make_train_step(config, optimizer, donate=False,
+                               accum_steps=4)
+
+    key = jax.random.PRNGKey(2)
+    for _ in range(5):
+        key, sub = jax.random.split(key)
+        batch = _batch(rng, 16, config.n_features)
+        params, opt_state, m_full = step_full(params, opt_state, sub, batch)
+        params_acc, opt_state_acc, m_acc = step_acc(params_acc,
+                                                    opt_state_acc, sub, batch)
+        np.testing.assert_allclose(float(m_acc["cost"]),
+                                   float(m_full["cost"]), rtol=1e-5)
+    for (ka, pa), (kb, pb) in zip(
+            sorted(jax.tree_util.tree_leaves_with_path(params),
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_leaves_with_path(params_acc),
+                   key=lambda kv: str(kv[0]))):
+        assert str(ka) == str(kb)
+        np.testing.assert_allclose(np.asarray(pb), np.asarray(pa),
+                                   atol=1e-5, err_msg=str(ka))
+
+
+# ---------------------------------------------------------- compile count
+
+def test_accum_step_compiles_once(rng):
+    """Satellite regression: the microbatch loop is a lax.scan INSIDE the one
+    jitted step — accum_steps=4 compiles exactly one program, and repeat
+    calls (and a second "epoch") compile nothing."""
+    # n_features unique to this test so the step can't be cache-warm from
+    # another module when the whole suite shares the process
+    config = _no_mining_config(f=23, d=4)
+    optimizer = make_optimizer("ada_grad", 0.1)
+    params = init_params(jax.random.PRNGKey(0), config)
+    opt_state = optimizer.init(params)
+    step = make_train_step(config, optimizer, accum_steps=4)
+    key = jax.random.PRNGKey(1)
+    key, _ = jax.random.split(key)  # pre-warm split's own compile
+
+    def run(params, opt_state, key, n):
+        for _ in range(n):
+            key, sub = jax.random.split(key)
+            batch = _batch(rng, 16, config.n_features)
+            params, opt_state, metrics = step(params, opt_state, sub, batch)
+        jax.block_until_ready(metrics["cost"])
+        return params, opt_state, key
+
+    with compile_guard(max_compiles=1) as first:
+        params, opt_state, key = run(params, opt_state, key, 3)
+    assert first.count == 1
+
+    with compile_guard(max_compiles=0) as second:
+        params, opt_state, key = run(params, opt_state, key, 2)
+    assert second.count == 0
+
+
+# ------------------------------------------------- estimator provenance
+
+def test_estimator_manifest_records_accum_and_mining(workdir):
+    """The run manifest self-describes the large-batch knobs: requested
+    mining_impl and the accum_steps actually in effect."""
+    from dae_rnn_news_recommendation_tpu import telemetry
+
+    rng = np.random.default_rng(0)
+    x = (rng.uniform(size=(30, 24)) < 0.25).astype(np.float32)
+    labels = rng.integers(0, 4, 30).astype(np.int32)
+    m = DenoisingAutoencoder(
+        model_name="accum", main_dir="accum", n_components=6, num_epochs=1,
+        batch_size=10, seed=7, corr_type="masking", corr_frac=0.3,
+        loss_func="mean_squared", opt="ada_grad", learning_rate=0.1,
+        verbose=False, use_tensorboard=False, accum_steps=2,
+        results_root=str(workdir / "results"))
+    m.fit(x, train_set_label=labels)
+    manifest = telemetry.read_manifest(m.run_manifest_path)
+    assert manifest["mining_impl"] == "auto"
+    assert manifest["accum_steps"] == 2
+    assert "accum_fallback" not in manifest  # nothing fell back, no noise
+    assert m._accum_effective == 2
+    # the feed rounds batches to a multiple of accum_steps so the jitted
+    # step's [accum, B/accum, ...] reshape is always exact
+    assert m._batch_multiple == 2
+
+
+def test_estimator_shard_scope_fallback_is_recorded(workdir):
+    """mining_scope='shard' has no accumulation path (the objective runs
+    inside shard_map) — the build must fall back to accum_steps=1 AND record
+    why, never silently. Build-level only: exercising the sharded step needs
+    jax.shard_map (tests/test_sharded_mining.py covers it when present)."""
+    m = DenoisingAutoencoder(
+        model_name="accum_shard", main_dir="accum_shard", n_components=4,
+        num_epochs=1, batch_size=8, seed=7, loss_func="mean_squared",
+        opt="ada_grad", learning_rate=0.1, verbose=False,
+        use_tensorboard=False, n_devices=2, mining_scope="shard",
+        accum_steps=4, results_root=str(workdir / "results"))
+    m._build(16, False)
+    assert m._accum_effective == 1
+    assert m._accum_fallback is not None
+    assert "mining_scope='shard'" in m._accum_fallback
+    assert "accum_steps=4 ignored" in m._accum_fallback
+    # the data-shard batch multiple no longer carries the accum factor
+    assert m._batch_multiple == 2
+
+
+def test_parallel_step_refuses_shard_accum():
+    """Defense in depth below the estimator: dp.py itself rejects the
+    combination rather than splitting a shard_map objective wrong."""
+    from dae_rnn_news_recommendation_tpu.parallel.dp import (
+        get_mesh, make_parallel_train_step)
+
+    config = _no_mining_config(f=8, d=3)
+    optimizer = make_optimizer("ada_grad", 0.1)
+    mesh = get_mesh(2)
+    with pytest.raises(ValueError, match="accum_steps"):
+        make_parallel_train_step(config, optimizer, mesh,
+                                 mining_scope="shard", accum_steps=2)

@@ -12,7 +12,8 @@ import jax.numpy as jnp
 
 from dae_rnn_news_recommendation_tpu.ops import triplet
 from dae_rnn_news_recommendation_tpu.ops.pallas_kernels import (
-    batch_all_triplet_loss_pallas, masking_noise_pallas)
+    batch_all_triplet_loss_pallas, batch_hard_triplet_loss_pallas,
+    masking_noise_pallas)
 
 ON_TPU = jax.default_backend() == "tpu"
 # compiled Mosaic requires tk % 128 == 0; the interpreter takes any tile
@@ -205,3 +206,93 @@ def test_batch_all_vjp_multiblock_grid_tpu(rng):
     go = jax.grad(lambda e: triplet.batch_all_triplet_loss(labels, e)[0])(enc)
     np.testing.assert_allclose(np.asarray(gp), np.asarray(go),
                                rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------- batch_hard
+
+_BH_BLOCK_ROWS = 8 if ON_TPU else 4
+
+
+def _compare_hard(labels, enc, row_valid, block_rows=_BH_BLOCK_ROWS):
+    ref = triplet.batch_hard_triplet_loss(labels, enc, row_valid=row_valid)
+    got = batch_hard_triplet_loss_pallas(labels, enc, row_valid=row_valid,
+                                         block_rows=block_rows,
+                                         interpret=not ON_TPU)
+    np.testing.assert_allclose(float(ref[0]), float(got[0]),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(ref[1]), np.asarray(got[1]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(ref[2]), float(got[2]), rtol=1e-5)
+    np.testing.assert_allclose(float(ref[3]), float(got[3]), rtol=1e-5)
+    for k in ref[4]:
+        np.testing.assert_allclose(float(ref[4][k]), float(got[4][k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n_classes", [1, 3, 5])
+def test_batch_hard_matches_xla_oracle(rng, n_classes):
+    """Includes the dense quirks observable through the tuple: zero-valued
+    invalid negatives in the hardest-neg max, float-equality tie counting."""
+    b = 24
+    labels = jnp.asarray(rng.integers(0, n_classes, b), jnp.int32)
+    enc = jnp.asarray(rng.normal(size=(b, 6)).astype(np.float32))
+    _compare_hard(labels, enc, None)
+
+
+def test_batch_hard_row_valid_and_padding(rng):
+    """B not a block multiple: the padded columns must be invisible — they
+    carry +inf into the hardest-pos min and -inf into the hardest-neg max
+    (a zero pad would corrupt both reductions; see _batch_hard_kernel)."""
+    b = 21
+    labels = jnp.asarray(rng.integers(0, 4, b), jnp.int32)
+    enc = jnp.asarray(rng.normal(size=(b, 5)).astype(np.float32))
+    rv = jnp.asarray((rng.uniform(size=b) < 0.7).astype(np.float32))
+    _compare_hard(labels, enc, rv)
+
+
+def test_batch_hard_all_rows_invalid(rng):
+    """row_valid all zero: nothing mines, no NaN from the n_valid guard."""
+    b = 12
+    labels = jnp.asarray(rng.integers(0, 3, b), jnp.int32)
+    enc = jnp.asarray(rng.normal(size=(b, 4)).astype(np.float32))
+    rv = jnp.zeros(b, jnp.float32)
+    loss, dw, frac, num, extras = batch_hard_triplet_loss_pallas(
+        labels, enc, row_valid=rv, block_rows=_BH_BLOCK_ROWS,
+        interpret=not ON_TPU)
+    assert float(loss) == 0.0 and float(num) == 0.0 and float(frac) == 0.0
+    np.testing.assert_array_equal(np.asarray(dw), 0.0)
+    for v in extras.values():
+        assert np.isfinite(float(v))
+
+
+def test_batch_hard_block_rows_invariance(rng):
+    """Result is block-size independent (the grid split is bookkeeping)."""
+    b = 30
+    labels = jnp.asarray(rng.integers(0, 3, b), jnp.int32)
+    enc = jnp.asarray(rng.normal(size=(b, 4)).astype(np.float32))
+    blocks = [8, 16, 32] if ON_TPU else [2, 4, 10]
+    outs = [batch_hard_triplet_loss_pallas(labels, enc, block_rows=br,
+                                           interpret=not ON_TPU)
+            for br in blocks]
+    for o in outs[1:]:
+        np.testing.assert_allclose(float(outs[0][0]), float(o[0]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(outs[0][1]), np.asarray(o[1]),
+                                   rtol=1e-6)
+
+
+@pytest.mark.parametrize("use_rv", [False, True])
+def test_batch_hard_grad_matches_xla_grad(rng, use_rv):
+    """The custom VJP recomputes through the blockwise XLA twin — it must
+    equal XLA autodiff of the dense oracle (min/max subgradient choices
+    agree because the blockwise twin reproduces the dense tie-breaks)."""
+    b, d = 27, 9
+    labels = jnp.asarray(rng.integers(0, 4, b), jnp.int32)
+    enc = jnp.asarray(rng.normal(size=(b, d)).astype(np.float32))
+    rv = (jnp.asarray((rng.uniform(size=b) > 0.2).astype(np.float32))
+          if use_rv else None)
+    gp = jax.grad(lambda e: batch_hard_triplet_loss_pallas(
+        labels, e, row_valid=rv, block_rows=_BH_BLOCK_ROWS,
+        interpret=not ON_TPU)[0])(enc)
+    go = jax.grad(lambda e: triplet.batch_hard_triplet_loss(
+        labels, e, row_valid=rv)[0])(enc)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(go), atol=1e-5)
