@@ -674,6 +674,64 @@ def _bench_fit_resident(jax, sz):
     return epochs * n_rows / dt
 
 
+def _bench_checkpoint(jax):
+    """Checkpoint durability tax at the headline model size: per-operation
+    latency of the atomic save (tmp dir + checksum manifest + rename,
+    utils/checkpoint.py), the checksum verify a restore performs, and the
+    full restore — what one step-cadence checkpoint costs the fit and how
+    long a preempted run takes to come back."""
+    import shutil
+    import tempfile
+
+    from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+    from dae_rnn_news_recommendation_tpu.train import make_optimizer
+    from dae_rnn_news_recommendation_tpu.utils.checkpoint import (
+        latest_checkpoint, load_checkpoint, save_checkpoint, verify_checkpoint)
+
+    config = DAEConfig(
+        n_features=F, n_components=D, enc_act_func="sigmoid",
+        dec_act_func="sigmoid", loss_func="cross_entropy", corr_type="none",
+        corr_frac=0.0, triplet_strategy="none",
+    )
+    params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
+    optimizer = make_optimizer("ada_grad", 0.1)
+    state = {"params": params, "opt_state": optimizer.init(params), "epoch": 1}
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    out = {}
+    n = 5
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            # the device fetch is part of what a real save pays — keep it
+            # inside the timed region (it is also the region's R2 fence)
+            host_state = jax.device_get(state)
+            save_checkpoint(ckpt_dir, host_state, step=i + 1, use_orbax=False)
+        out["save_ms"] = round((time.perf_counter() - t0) / n * 1e3, 2)
+
+        path, _ = latest_checkpoint(ckpt_dir, verify=False)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ok, reason = verify_checkpoint(path)
+        # jaxcheck: disable=R2 (pure host I/O: checksum verify touches no device)
+        out["verify_ms"] = round((time.perf_counter() - t0) / n * 1e3, 2)
+        assert ok, f"bench checkpoint failed verification: {reason}"
+
+        t0 = time.perf_counter()
+        for _ in range(n):
+            restored = load_checkpoint(path, state)
+        restored = jax.device_put(restored["params"])  # restore ends on device
+        jax.block_until_ready(jax.tree_util.tree_leaves(restored))
+        out["restore_ms"] = round((time.perf_counter() - t0) / n * 1e3, 2)
+
+        size = 0
+        for root, _, names in os.walk(path):
+            size += sum(os.path.getsize(os.path.join(root, f)) for f in names)
+        out["checkpoint_mbytes"] = round(size / 1e6, 2)
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return out
+
+
 def child_main():
     _phase("child started; initializing backend")
     import jax
@@ -844,6 +902,11 @@ def child_main():
             _bench_fit_resident(jax, sz), 1)
     except Exception as e:
         extra["fit_resident_error"] = repr(e)[-300:]
+    try:
+        _phase("checkpoint: commit/verify/restore micro-bench")
+        extra["checkpoint"] = _bench_checkpoint(jax)
+    except Exception as e:
+        extra["checkpoint_error"] = repr(e)[-300:]
 
     unit_kind = "sparse-ingest stream"
     if platform == "tpu":

@@ -1089,3 +1089,87 @@ def check_r8(ctx):
     for root in roots:
         out.extend(_r8_scan_root(ctx, root, seen))
     return out
+
+
+# ------------------------------------------------------------------- R9
+
+_BROAD_EXC = {"Exception", "BaseException"}
+# a handler that calls any of these (by dotted-name substring) is "recording":
+# the failure reaches an operator through warnings, logging, or telemetry
+_R9_RECORD_TOKENS = ("warn", "record", "note", "log", "dump", "telemetry",
+                     "print")
+
+
+def _r9_broad(handler):
+    """Bare `except:`, or a clause naming Exception/BaseException."""
+    t = handler.type
+    if t is None:
+        return "except:"
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        d = dotted(e)
+        if d and d.split(".")[-1] in _BROAD_EXC:
+            return f"except {d}"
+    return None
+
+
+def _r9_surfaces(handler):
+    """True when the handler re-raises or records: any Raise statement, or a
+    call whose dotted name suggests warnings/logging/telemetry."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = (call_name(node) or "").lower()
+            if any(tok in name for tok in _R9_RECORD_TOKENS):
+                return True
+    return False
+
+
+def _contains_loop(stmts):
+    """A For/While anywhere in these statements, not crossing nested defs."""
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, (ast.For, ast.While)):
+                return True
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not s:
+                break
+    return False
+
+
+@rule("R9", "broad except swallows errors in a training/feed loop")
+def check_r9(ctx):
+    """A broad handler (`except Exception` / `except BaseException` / bare
+    `except`) inside a loop — or wrapping one — that neither re-raises nor
+    records is a silent-truncation bug factory: a dead feed worker or a
+    failed step vanishes and the fit 'completes' on partial data (the exact
+    failure class reliability/ exists to make loud). Legitimate
+    surface-on-the-consumer sites (a worker thread parking the exception for
+    the consuming iterator to re-raise) carry a reasoned
+    `# jaxcheck: disable=R9` — the handler cannot re-raise on its own thread.
+    Diagnostics-must-never-kill handlers pass by calling a recording API
+    (warnings.warn, logger.*, recorder.note_*, telemetry.*)."""
+    out = []
+
+    def visit(node, in_loop):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            in_loop = False  # the body runs when called, not per-iteration
+        if isinstance(node, ast.Try):
+            relevant = in_loop or _contains_loop(node.body)
+            for h in node.handlers:
+                broad = _r9_broad(h)
+                if relevant and broad and not _r9_surfaces(h):
+                    out.append(ctx.finding(
+                        h, f"`{broad}` in a training/feed loop neither "
+                        "re-raises nor records — a swallowed error here "
+                        "silently truncates the feed or fit; re-raise, "
+                        "narrow the clause, record it (warnings/logging/"
+                        "telemetry), or carry a reasoned disable at a "
+                        "surface-on-consumer site"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_loop or isinstance(node, (ast.For, ast.While)))
+
+    visit(ctx.tree, in_loop=False)
+    return out

@@ -277,7 +277,8 @@ def prefetch(iterator, depth=2):
                 for item in iterator:
                     if not put(item):
                         return
-            except BaseException as e:  # surfaced on the consumer thread
+            # jaxcheck: disable=R9 (cannot re-raise on a worker thread: the exception is parked in err[] and re-raised by the consumer after the end sentinel)
+            except BaseException as e:
                 err.append(e)
             finally:
                 put(end)

@@ -45,6 +45,17 @@ from .dae_core import DAEConfig, init_params
 _TRIPLET_METRICS = ("cost", "autoencoder_loss", "triplet_loss", "fraction_triplet", "num_triplet")
 
 
+def _skip_batches(batches, skip):
+    """Drop the first `skip` host batches of an epoch iterator — the replay
+    cursor of a crash-exact resume (the skipped steps already ran before the
+    crash; the batcher RNG was restored, so the permutation is identical)."""
+    if not skip:
+        return batches
+    import itertools
+
+    return itertools.islice(batches, skip, None)
+
+
 class DenoisingAutoencoder:
     """Denoising autoencoder with online triplet mining; sklearn-like interface."""
 
@@ -68,7 +79,8 @@ class DenoisingAutoencoder:
                  weight_update_sharding=False, resident_feed="auto",
                  resident_budget_bytes=2 << 30, feed=None, trace=False,
                  health_abort=False, health_window=256,
-                 health_divergence=10.0, mining_impl="auto", accum_steps=1):
+                 health_divergence=10.0, mining_impl="auto", accum_steps=1,
+                 checkpoint_every_steps=0, io_retries=3, io_backoff_s=0.05):
         """Reference parameters: autoencoder.py:20-99. TPU extras:
 
         :param n_components: explicit code size; overrides the compress_factor
@@ -187,12 +199,34 @@ class DenoisingAutoencoder:
         self.accum_steps = int(accum_steps)
         self._accum_effective = None
         self._accum_fallback = None
+        # step-cadence checkpointing (reliability/, docs/reliability.md): also
+        # checkpoint every N optimizer steps WITHIN an epoch (0 = epoch
+        # cadence only). Cursor saves land as step_<E>_<C> dirs carrying a
+        # resume.json sidecar (RNG key, batch-order cursor, batcher RNG
+        # state), which is what makes kill-and-resume bitwise-exact: a run
+        # killed at an arbitrary step and resumed replays the identical
+        # trajectory. Streaming/pipelined feeds only — the resident feed runs
+        # a whole epoch as one dispatch, so it falls back to epoch cadence
+        # (recorded in the run manifest, never silent).
+        self.checkpoint_every_steps = int(checkpoint_every_steps)
+        # bounded retry-with-backoff for transient feed/save faults
+        # (reliability/retry.py); every retry is recorded in the run manifest
+        # and telemetry trace. io_retries=1 disables retrying.
+        self.io_retries = int(io_retries)
+        self.io_backoff_s = float(io_backoff_s)
+        self._retry_events = []
+        self._io_retry = None
+        self._cadence_fallback = None
+        self._resume_cursor = 0
+        self._resume_batcher_state = None
 
         assert isinstance(self.verbose_step, int)
         assert self.verbose >= 0
         assert self.triplet_strategy in ("batch_all", "batch_hard", "none")
         assert self.mining_impl in ("auto", "dense", "blockwise", "pallas")
         assert self.accum_steps >= 1, "accum_steps must be a positive int"
+        assert self.checkpoint_every_steps >= 0
+        assert self.io_retries >= 1, "io_retries counts total attempts"
 
         (self.models_dir, self.data_dir, self.tf_summary_dir, self.tsv_dir,
          self.plot_dir) = create_run_directories(self.algo_name, self.main_dir,
@@ -280,6 +314,8 @@ class DenoisingAutoencoder:
         self.opt_state = self.optimizer.init(self.params)
         self._epoch0 = 0
 
+        self._resume_cursor = 0
+        self._resume_batcher_state = None
         if restore_previous_model:
             path, step = latest_checkpoint(self.model_path)
             if path is None:
@@ -292,6 +328,18 @@ class DenoisingAutoencoder:
             self.params = state["params"]
             self.opt_state = state["opt_state"]
             self._epoch0 = int(state["epoch"])
+            # crash-exact resume (docs/reliability.md): the resume sidecar
+            # restores the per-batch PRNG chain, the batcher's shuffle RNG,
+            # and the batch-order cursor, so the resumed trajectory replays
+            # the uninterrupted one bit-for-bit. Checkpoints without a
+            # sidecar (pre-PR6, or foreign) resume schedule-exact as before.
+            resume = state.get("resume") or {}
+            if resume.get("rng_key") is not None:
+                from ..utils.seeding import deserialize_key
+
+                self._key = deserialize_key(resume["rng_key"])
+            self._resume_cursor = int(resume.get("step_in_epoch", 0))
+            self._resume_batcher_state = resume.get("batcher_rng_state")
 
         self._mesh_ctx = None
         # accumulation fallback: resolved per-build, recorded in the manifest
@@ -428,6 +476,22 @@ class DenoisingAutoencoder:
         batcher = self._feed_batcher(train_set)(
             self.batch_size, shuffle=True, seed=seed,
             mesh_batch_multiple=self._batch_multiple)
+        if self._resume_batcher_state is not None and hasattr(batcher, "rng"):
+            # same RNG state as the interrupted run had at the checkpoint, so
+            # epoch shuffles replay the identical batch order from here on
+            from ..utils.seeding import restore_rng_state
+
+            restore_rng_state(batcher.rng, self._resume_batcher_state)
+        self._batcher = batcher  # _save snapshots its RNG into resume.json
+        # one policy per fit: both retryable surfaces (pipelined-feed staging
+        # and checkpoint writes) share the budget and the event log, and the
+        # events land in the run manifest + flight recorder — never silent
+        from ..reliability.retry import RetryPolicy
+
+        self._retry_events = []
+        self._io_retry = RetryPolicy(
+            max_attempts=self.io_retries, backoff_s=self.io_backoff_s,
+            on_retry=self._note_retry)
 
         try:
             self._train_loop(train_set, train_set_label, validation_set,
@@ -439,6 +503,9 @@ class DenoisingAutoencoder:
         # _last_epoch < the requested total iff a graceful stop broke the loop;
         # saving the true epoch keeps restore_previous_model's schedule exact
         self._save(getattr(self, "_last_epoch", self._epoch0 + self.num_epochs))
+        # rewrite now that the final save ran: retries taken by that save (and
+        # any chaos-injected faults) must be visible in the manifest
+        self._write_fault_manifest()
         return self
 
     def _log_param_histograms(self, train_writer, gstep):
@@ -474,9 +541,12 @@ class DenoisingAutoencoder:
                                        train_writer, val_writer)
         except Exception as exc:
             # crash path: the bundle is often the only artifact a dead run
-            # leaves behind — dump it, then re-raise unchanged
+            # leaves behind — dump it, then re-raise unchanged. The fault
+            # manifest goes with it: an injected preemption or a feed death
+            # must be visible in the run's artifacts even when fit dies.
             self._recorder.note_exception(exc)
             self._dump_health_bundle()
+            self._write_fault_manifest()
             raise
         finally:
             if tele_owner:
@@ -573,6 +643,23 @@ class DenoisingAutoencoder:
         self._last_fit_feed = feed_mode
         resident_mode = feed_mode == "resident"
         self._last_fit_resident = resident_mode
+        # step-cadence checkpointing needs a per-step host loop; the resident
+        # feed runs the whole epoch as ONE dispatch and the pod path must not
+        # issue collective saves from a background thread mid-epoch — both
+        # fall back to epoch cadence, with the reason recorded (never silent)
+        self._cadence_fallback = None
+        ckpt_steps = self.checkpoint_every_steps
+        if ckpt_steps and resident_mode:
+            self._cadence_fallback = (
+                "checkpoint_every_steps=%d ignored: the resident feed runs "
+                "each epoch as one dispatch (no per-step host loop); epoch "
+                "cadence only" % ckpt_steps)
+            ckpt_steps = 0
+        elif ckpt_steps and self._multiprocess:
+            self._cadence_fallback = (
+                "checkpoint_every_steps=%d ignored: multiprocess saves are "
+                "collective and blocking; epoch cadence only" % ckpt_steps)
+            ckpt_steps = 0
         if self.run_manifest_path:
             try:  # provenance logging must never kill a fit
                 telemetry.write_manifest(self.run_manifest_path, telemetry.build_manifest(
@@ -588,6 +675,8 @@ class DenoisingAutoencoder:
                            # fell back, if it did — never silent)
                            "mining_impl": self.mining_impl,
                            "accum_steps": self._accum_effective,
+                           "checkpoint_every_steps": ckpt_steps,
+                           "io_retries": self.io_retries,
                            **({"accum_fallback": self._accum_fallback}
                               if self._accum_fallback else {})}))
             except OSError:
@@ -624,8 +713,20 @@ class DenoisingAutoencoder:
                                             donate_batch=True,
                                             accum_steps=self._accum_effective)
 
+        from ..reliability import faults as _rfaults
+        from ..utils.seeding import rng_state
+
         for e in range(self.num_epochs):
             epoch = self._epoch0 + e + 1
+            # crash-exact resume: a cursor checkpoint (step_<E>_<C>) says C
+            # steps of this epoch already ran before the crash — restore left
+            # params/opt_state/RNG key mid-chain, so replay skips them
+            skip = min(self._resume_cursor, n_batches) if e == 0 else 0
+            # snapshot the batcher RNG BEFORE this epoch's shuffle mutates it:
+            # cursor saves store this state so a resumed run re-derives the
+            # identical permutation and then skips the first C batches
+            epoch_rng_state = (rng_state(batcher.rng)
+                               if hasattr(batcher, "rng") else None)
             self.train_cost_batch = [], [], []
             self.fraction_triplet_batch = []
             self.num_triplet_batch = []
@@ -642,6 +743,21 @@ class DenoisingAutoencoder:
                     from ..train.resident import stack_epoch_indices
 
                     perm, rvalid = stack_epoch_indices(batcher, n_rows)
+                    if skip:
+                        # cross-feed resume: a cursor checkpoint written by a
+                        # streaming/pipelined run, resumed resident. Slice the
+                        # permutation so no batch applies twice; the in-scan
+                        # key chain differs from the interrupted run's, so
+                        # this is best-effort, not bitwise — and says so
+                        import warnings
+
+                        warnings.warn(
+                            "resident resume from a mid-epoch cursor "
+                            f"checkpoint (cursor={skip}): batch order is "
+                            "preserved but per-batch PRNG keys are not — "
+                            "resume is approximate, not bitwise-exact",
+                            RuntimeWarning, stacklevel=2)
+                        perm, rvalid = perm[skip:], rvalid[skip:]
                     (self.params, self.opt_state, self._key, stacked) = epoch_fn(
                         self.params, self.opt_state, self._key, resident_data,
                         perm, rvalid, extremes)
@@ -657,18 +773,28 @@ class DenoisingAutoencoder:
                     # streaming — parity is tested, overlap is measured.
                     feed_stats.reset()
                     device_metrics = []
+                    step_in_epoch = skip
                     feed = PipelinedFeed(
-                        batcher.epoch(train_set, labels, labels2),
+                        _skip_batches(batcher.epoch(train_set, labels, labels2),
+                                      skip),
                         depth=max(2, self.prefetch_depth), place=place,
-                        extremes=extremes, buckets=(b,), stats=feed_stats)
+                        extremes=extremes, buckets=(b,), stats=feed_stats,
+                        retry=self._io_retry)
                     for batch in feed:
                         if self._recorder.batch_signature is None:
                             # device-resident here: shape/dtype only
                             self._recorder.note_batch_signature(batch)
+                        _rfaults.fire("train.step", epoch=epoch,
+                                      step=step_in_epoch + 1)
                         self._key, sub = jax.random.split(self._key)
                         self.params, self.opt_state, metrics = pipe_step(
                             self.params, self.opt_state, sub, batch)
+                        step_in_epoch += 1
                         device_metrics.append(metrics)
+                        if self._cursor_save_due(step_in_epoch, n_batches,
+                                                 ckpt_steps):
+                            self._save_cursor(epoch, step_in_epoch,
+                                              epoch_rng_state)
 
                     host_metrics = jax.device_get(device_metrics)
                     self.train_time = time.time() - t0
@@ -678,29 +804,40 @@ class DenoisingAutoencoder:
                 else:
                     # accumulate device arrays only — converting per step would force a
                     # host-device sync each batch and stall the async dispatch pipeline
-                    step_in_epoch = 0
+                    step_in_epoch = skip
                     device_metrics = []
-                    for batch in prefetch(batcher.epoch(train_set, labels, labels2),
-                                          self.prefetch_depth):
+                    for batch in prefetch(
+                            _skip_batches(
+                                batcher.epoch(train_set, labels, labels2),
+                                skip),
+                            self.prefetch_depth):
                         batch.update(extremes)
                         if self._recorder.batch_signature is None:
                             # host-side batch stats while the arrays are still
                             # numpy (once per fit; ties a bundle to its feed)
                             self._recorder.note_batch_signature(batch)
                         batch = self._place_batch(batch)
+                        _rfaults.fire("train.step", epoch=epoch,
+                                      step=step_in_epoch + 1)
                         self._key, sub = jax.random.split(self._key)
                         self.params, self.opt_state, metrics = self._train_step(
                             self.params, self.opt_state, sub, batch)
                         step_in_epoch += 1
                         device_metrics.append(metrics)
+                        if self._cursor_save_due(step_in_epoch, n_batches,
+                                                 ckpt_steps):
+                            self._save_cursor(epoch, step_in_epoch,
+                                              epoch_rng_state)
 
                     # one sync per epoch: pull all step metrics, then log/record on host
                     host_metrics = jax.device_get(device_metrics)
                     self.train_time = time.time() - t0
             for i, m in enumerate(host_metrics):
                 m = {k: float(v) for k, v in m.items()}
-                # reference step key: (epoch-1)*num_batches + i (autoencoder.py:245)
-                gstep = (epoch - 1) * n_batches + i + 1
+                # reference step key: (epoch-1)*num_batches + i (autoencoder.py:245);
+                # `skip` offsets a resumed partial epoch so gsteps stay aligned
+                # with the uninterrupted run's numbering
+                gstep = (epoch - 1) * n_batches + skip + i + 1
                 bad = self._recorder.record(gstep, m)
                 if bad is not None:
                     # first anomaly of the fit: dump the bundle now, while the
@@ -923,14 +1060,98 @@ class DenoisingAutoencoder:
                 print(f"Triplet={means.get('triplet_loss', float('nan')):.4f}\t", end="")
             print()
 
+    def _note_retry(self, event):
+        """on_retry sink for the fit's RetryPolicy: the event reaches the run
+        manifest (fit-end rewrite), and the flight recorder so a later health
+        bundle shows the I/O weather the run flew through."""
+        self._retry_events.append(event)
+        rec = getattr(self, "_recorder", None)
+        if rec is not None:
+            rec.note_fault(event)
+
+    def _write_fault_manifest(self):
+        """Merge this fit's fault/retry record into the run manifest — the
+        zero-silent-recoveries contract: every injected fault, every retry,
+        and every cadence fallback is queryable from the artifact tree
+        (`telemetry report` renders the section). Never raises."""
+        if not getattr(self, "run_manifest_path", None):
+            return
+        from ..reliability import faults as _rfaults
+
+        section = {"retries": list(getattr(self, "_retry_events", []))}
+        inj = _rfaults.active_injector()
+        if inj is not None:
+            # the injector log is cumulative across restarts of the same chaos
+            # plan, so the FINAL attempt's manifest still shows recoveries
+            # that happened in earlier (crashed) attempts
+            section["retries"] = list(inj.retries)
+            section["injected"] = list(inj.fired)
+            section["plan_seed"] = inj.plan.seed
+        if getattr(self, "_cadence_fallback", None):
+            section["cadence_fallback"] = self._cadence_fallback
+        try:
+            manifest = telemetry.read_manifest(self.run_manifest_path)
+        except Exception:
+            return  # no manifest yet (fit died before the feed resolved)
+        manifest["faults"] = section
+        try:
+            telemetry.write_manifest(self.run_manifest_path, manifest)
+        except OSError:
+            pass  # provenance logging must never kill (or fail) a fit
+
+    def _resume_payload(self, cursor=0, batcher_state=None):
+        """The resume.json sidecar: everything beyond params/opt_state that
+        the trajectory depends on — the per-batch PRNG chain position, the
+        batch-order cursor, and the batcher's shuffle-RNG state."""
+        from ..utils.seeding import rng_state, serialize_key
+
+        if batcher_state is None:
+            rng = getattr(getattr(self, "_batcher", None), "rng", None)
+            batcher_state = rng_state(rng) if rng is not None else None
+        key = getattr(self, "_key", None)
+        return {"schema": 1, "step_in_epoch": int(cursor),
+                "rng_key": serialize_key(key) if key is not None else None,
+                "batcher_rng_state": batcher_state,
+                "resolved_seed": self._resolved_seed}
+
+    def _cursor_save_due(self, step_in_epoch, n_batches, ckpt_steps):
+        # the epoch-boundary save covers the final step; a cursor save there
+        # would just shadow it with a step_<E>_<n> twin
+        return bool(ckpt_steps) and (step_in_epoch % ckpt_steps == 0
+                                     and step_in_epoch < n_batches)
+
+    def _save_cursor(self, epoch, cursor, epoch_rng_state):
+        """Mid-epoch cursor checkpoint (step_<E>_<C>): params/opt_state AFTER
+        `cursor` steps of epoch `epoch`, the RNG key at its current chain
+        position, and the batcher state snapshotted at EPOCH START — resume
+        replays the same shuffle and skips the first `cursor` batches."""
+        state = {"params": self.params, "opt_state": self.opt_state,
+                 "epoch": np.asarray(epoch - 1)}
+        rec = getattr(self, "_recorder", None)
+        health = rec.snapshot() if rec is not None else None
+        resume = self._resume_payload(cursor=cursor,
+                                      batcher_state=epoch_rng_state)
+        if getattr(self, "_async_ckpt", None) is None:
+            self._async_ckpt = AsyncCheckpointer(retry=self._io_retry)
+        self._async_ckpt.retry = self._io_retry
+        with telemetry.span("fit/checkpoint", fence=False,
+                            args={"epoch": epoch, "cursor": cursor}):
+            self._async_ckpt.save(self.model_path, state, epoch - 1,
+                                  keep=self.keep_checkpoint_max, health=health,
+                                  resume=resume, cursor=cursor)
+
     def _save(self, epoch, blocking=True):
         """Mid-run saves (blocking=False) hand the host copy to a background
         writer so disk IO overlaps the next epochs; the end-of-fit save and any
-        restore wait for in-flight writes first."""
+        restore wait for in-flight writes first. Every save carries a resume
+        sidecar (cursor 0: the next epoch starts fresh from the stored batcher
+        state and RNG key), and transient I/O failures ride the fit's
+        RetryPolicy — bounded, backed off, recorded."""
         state = {"params": self.params, "opt_state": self.opt_state,
                  "epoch": np.asarray(epoch)}
         rec = getattr(self, "_recorder", None)
         health = rec.snapshot() if rec is not None else None
+        resume = self._resume_payload()
         if getattr(self, "_multiprocess", False):
             # pod path: one SHARED checkpoint dir, every process participates
             # in the collective orbax save of the global arrays (blocking —
@@ -938,16 +1159,26 @@ class DenoisingAutoencoder:
             if getattr(self, "_async_ckpt", None) is not None:
                 self._async_ckpt.wait()
             save_checkpoint(self.model_path, state, epoch, multiprocess=True,
-                            health=health)
+                            health=health, resume=resume)
             return
         if getattr(self, "_async_ckpt", None) is None:
-            self._async_ckpt = AsyncCheckpointer()
+            self._async_ckpt = AsyncCheckpointer(retry=self._io_retry)
+        self._async_ckpt.retry = self._io_retry
         if not blocking:
             self._async_ckpt.save(self.model_path, state, epoch,
-                                  keep=self.keep_checkpoint_max, health=health)
+                                  keep=self.keep_checkpoint_max, health=health,
+                                  resume=resume)
             return
         self._async_ckpt.wait()
-        save_checkpoint(self.model_path, state, epoch, health=health)
+
+        def once():
+            save_checkpoint(self.model_path, state, epoch, health=health,
+                            resume=resume)
+
+        if self._io_retry is not None:
+            self._io_retry.run(once, site="ckpt.save")
+        else:
+            once()
         if self.keep_checkpoint_max:
             prune_checkpoints(self.model_path, self.keep_checkpoint_max)
 

@@ -1,0 +1,33 @@
+"""Reliability subsystem: deterministic fault injection, bounded recorded
+retries, and the chaos-soak harness that proves crash-exact resume.
+
+    from dae_rnn_news_recommendation_tpu import reliability
+    from dae_rnn_news_recommendation_tpu.reliability import chaos
+
+    plan = reliability.FaultPlan.generate(seed=3, n_steps=12)
+    with reliability.install(reliability.FaultInjector(plan)):
+        ...  # run a fit; planned faults fire at the production hooks
+
+Full story in docs/reliability.md. `chaos` is NOT imported here: it imports
+the estimator, and this package must stay importable from utils/checkpoint.py
+and train/pipeline.py (which the estimator itself imports) without a cycle.
+"""
+
+from .faults import (FaultInjector, FaultPlan, FaultSpec, InjectedFault,
+                     SimulatedPreemption, TransientFault, active_injector,
+                     fire, install)
+from .retry import RetryPolicy, is_transient
+
+__all__ = [
+    "FaultInjector",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "RetryPolicy",
+    "SimulatedPreemption",
+    "TransientFault",
+    "active_injector",
+    "fire",
+    "install",
+    "is_transient",
+]

@@ -1,0 +1,260 @@
+"""Chaos-soak harness: replay seeded fault plans end-to-end and prove
+crash-exact resume.
+
+For each plan the harness runs the SAME tiny fit twice:
+
+  1. a fault-free reference run — its final params are the ground truth;
+  2. a chaos run under `faults.install(FaultInjector(plan))`, supervised by
+     `run_plan`: every injected crash (preemption, feed death, commit
+     failure) is caught, the estimator is rebuilt with
+     `restore_previous_model=True`, and the fit continues from the newest
+     VERIFIED checkpoint — including the mid-epoch cursor saves the
+     estimator's step-cadence checkpointing produced.
+
+The acceptance bar (ISSUE 6): on CPU the chaos run's final params must be
+BITWISE identical to the reference run's — RNG chain, batch order, optimizer
+state and cursor all rode the checkpoint, so replaying the killed steps
+reproduces the uninterrupted trajectory exactly. Every injected fault and
+every retry must be visible in the final run manifest (zero silent
+recoveries), and each plan runs under a deadline (zero hangs).
+
+On non-CPU backends bitwise equality is NOT promised (different restarts may
+autotune differently); `run_plan` still checks allclose and reports
+`bitwise` separately so TPU soaks degrade to a documented tolerance rather
+than a lie.
+"""
+
+import dataclasses
+import hashlib
+import os
+import time
+
+import numpy as np
+
+from . import faults as _faults
+from .faults import FaultInjector, FaultPlan, InjectedFault
+
+
+def params_digest(params):
+    """sha256 over the raw bytes of every param leaf — bitwise identity."""
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(params):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def _params_allclose(a, b):
+    import jax
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.allclose(np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6)
+        for x, y in zip(la, lb))
+
+
+@dataclasses.dataclass
+class PlanResult:
+    plan: dict
+    ok: bool
+    bitwise: bool
+    allclose: bool
+    restarts: int
+    injected: list      # injector.fired — every fault that actually landed
+    retries: list       # retry events collected across all fit attempts
+    manifest_faults: dict  # the "faults" section of the final run manifest
+    detail: str
+    duration_s: float
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def _completed_epochs(model_path):
+    """Completed-epoch count of the newest verified checkpoint (quarantining
+    corrupt ones on the way), or None when no checkpoint survives."""
+    from ..utils.checkpoint import latest_checkpoint
+
+    path, _ = latest_checkpoint(model_path)
+    if path is None:
+        return None
+    data = np.load(os.path.join(path, "aux.npz"))
+    return int(data["epoch"])
+
+
+def _apply_harness_specs(injector, model_path, applied):
+    """Post-crash directives: corrupt the newest checkpoint on disk so the
+    next restore must quarantine it and fall back. Applied at most `times`
+    per spec, recorded in the injector log like any in-line fault."""
+    from ..utils.checkpoint import latest_checkpoint
+
+    for i, spec in enumerate(injector.plan.harness_specs):
+        if spec.kind != "truncate" or applied.get(i, 0) >= spec.times:
+            continue
+        path, _ = latest_checkpoint(model_path, verify=False)
+        if path is None:
+            continue
+        target, size = None, -1
+        for root, _, names in os.walk(path):
+            for name in names:
+                if name == "CHECKSUMS.json":
+                    continue
+                fp = os.path.join(root, name)
+                if os.path.getsize(fp) > size:
+                    target, size = fp, os.path.getsize(fp)
+        if target is None:
+            continue
+        with open(target, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+        applied[i] = applied.get(i, 0) + 1
+        injector.note("ckpt.corrupt", "truncate",
+                      file=os.path.relpath(target, model_path),
+                      truncated_to=max(size // 2, 1))
+
+
+def _drain_async(est):
+    """A crashed fit may leave a background checkpoint write in flight (or
+    already failed); settle it before the next restart shares the dir."""
+    ac = getattr(est, "_async_ckpt", None)
+    if ac is None:
+        return
+    try:
+        ac.wait()
+    except Exception:
+        pass  # the crash is already being handled; this is just cleanup
+
+
+def run_plan(plan, make_estimator, data, labels=None, total_epochs=3,
+             deadline_s=120.0, max_restarts=8):
+    """Execute one fault plan end-to-end. `make_estimator(tag, num_epochs)`
+    must return a fresh estimator; the 'chaos' tag must map to one stable
+    model dir across restarts (that is the checkpoint lineage being tested)
+    and 'ref' to a separate one. Returns a PlanResult."""
+    t0 = time.monotonic()
+
+    def fit(est, restore):
+        est.fit(data, train_set_label=labels,
+                restore_previous_model=restore)
+        return est
+
+    ref = fit(make_estimator("ref", total_epochs), restore=False)
+    ref_digest = params_digest(ref.params)
+
+    injector = FaultInjector(plan)
+    retries, applied, restarts = [], {}, 0
+    est, detail = None, "completed"
+    with _faults.install(injector):
+        while True:
+            if time.monotonic() - t0 > deadline_s:
+                detail = f"deadline exceeded after {restarts} restarts"
+                est = None
+                break
+            completed = (_completed_epochs(est.model_path)
+                         if est is not None else None)
+            remaining = (total_epochs if completed is None
+                         else max(total_epochs - completed, 0))
+            est = make_estimator("chaos", remaining)
+            try:
+                fit(est, restore=completed is not None)
+                retries.extend(getattr(est, "_retry_events", []))
+                break
+            except InjectedFault:
+                retries.extend(getattr(est, "_retry_events", []))
+                _drain_async(est)
+                restarts += 1
+                if restarts > max_restarts:
+                    detail = f"gave up after {max_restarts} restarts"
+                    est = None
+                    break
+                _apply_harness_specs(injector, est.model_path, applied)
+
+    duration = time.monotonic() - t0
+    if est is None:
+        return PlanResult(plan.to_dict(), False, False, False, restarts,
+                          list(injector.fired), retries, {}, detail, duration)
+
+    chaos_digest = params_digest(est.params)
+    bitwise = chaos_digest == ref_digest
+    close = bitwise or _params_allclose(ref.params, est.params)
+    manifest_faults = _read_manifest_faults(est)
+    import jax
+
+    want_bitwise = jax.default_backend() == "cpu"
+    ok = (bitwise if want_bitwise else close)
+    if ok and not injector.fired:
+        ok, detail = False, "plan fired no faults (nothing was tested)"
+    elif not ok:
+        detail = (f"params mismatch: ref {ref_digest[:12]} vs "
+                  f"chaos {chaos_digest[:12]} (allclose={close})")
+    return PlanResult(plan.to_dict(), ok, bitwise, close, restarts,
+                      list(injector.fired), retries, manifest_faults, detail,
+                      duration)
+
+
+def _read_manifest_faults(est):
+    from .. import telemetry
+
+    try:
+        manifest = telemetry.read_manifest(est.run_manifest_path)
+        return manifest.get("faults", {})
+    except Exception:
+        return {}
+
+
+def make_soak_estimator_factory(root, seed, *, feed="pipelined",
+                                n_features=24, **overrides):
+    """Factory-of-factories for the soak: tiny momentum-optimizer fits with
+    masking corruption (so the per-batch PRNG chain MATTERS — a wrong RNG
+    restore shows up as a params diff, not silence), epoch checkpoints every
+    epoch plus a cursor checkpoint every 2 steps."""
+    from ..models.estimator import DenoisingAutoencoder
+
+    defaults = dict(
+        num_epochs=3, batch_size=12, verbose=False, use_tensorboard=False,
+        seed=11 + seed, opt="momentum", momentum=0.7, learning_rate=0.05,
+        corr_type="masking", corr_frac=0.3, triplet_strategy="none",
+        checkpoint_every=1, checkpoint_every_steps=2, feed=feed,
+        io_backoff_s=0.002, n_components=4)
+
+    def make(tag, num_epochs):
+        kw = dict(defaults)
+        kw.update(overrides)
+        kw["num_epochs"] = int(num_epochs)
+        return DenoisingAutoencoder(
+            model_name=f"plan{seed}-{tag}",
+            main_dir=f"plan{seed}-{tag}/",
+            results_root=os.path.join(root, f"plan{seed}", tag), **kw)
+
+    return make
+
+
+def soak_data(n_rows=48, n_features=24, seed=1234):
+    rng = np.random.default_rng(seed)
+    return rng.random((n_rows, n_features), dtype=np.float32)
+
+
+def chaos_soak(root, n_plans=8, total_epochs=3, deadline_s=120.0,
+               n_rows=48, n_features=24, log=None):
+    """Replay `n_plans` seeded fault plans (seeds 0..n-1 — the generator's
+    round-robin guarantees all six fault families appear in any 6+ plan
+    soak). Returns {"results": [PlanResult...], "all_ok": bool, "n_ok": int}.
+    """
+    data = soak_data(n_rows, n_features)
+    n_batches = int(np.ceil(n_rows / 12))
+    results = []
+    for seed in range(n_plans):
+        plan = FaultPlan.generate(seed, n_steps=total_epochs * n_batches,
+                                  n_save_calls=2)
+        factory = make_soak_estimator_factory(root, seed)
+        res = run_plan(plan, factory, data, total_epochs=total_epochs,
+                       deadline_s=deadline_s)
+        results.append(res)
+        if log is not None:
+            log(f"plan {seed}: ok={res.ok} bitwise={res.bitwise} "
+                f"restarts={res.restarts} faults={len(res.injected)} "
+                f"retries={len(res.retries)} ({res.duration_s:.1f}s) "
+                f"{res.detail}")
+    n_ok = sum(r.ok for r in results)
+    return {"results": results, "all_ok": n_ok == len(results), "n_ok": n_ok,
+            "n_plans": n_plans}

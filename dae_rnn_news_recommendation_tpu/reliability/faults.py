@@ -1,0 +1,252 @@
+"""Deterministic, seed-driven fault injection for chaos testing.
+
+The production story this repo promises (a multi-hour TPU fit that survives
+preemptions, feed-worker deaths, and torn checkpoints) is only credible if
+those failures can be REPLAYED: same seed, same faults, same recovery path,
+byte-for-byte the same final params. This module is the replay half —
+`reliability/chaos.py` is the supervisor that drives a fit through a plan and
+checks the recovery.
+
+Design rules:
+
+  * Explicit hooks, never monkeypatching. Production code calls
+    `faults.fire("site", ...)` at the handful of places a real fault would
+    land (feed worker loop, H2D staging, the train step, checkpoint
+    write/commit). With no injector installed the call is a single global
+    `None` check — zero overhead, nothing patched, and the hook doubles as
+    documentation of the failure surface.
+
+  * Deterministic plans. A `FaultPlan` is generated from a seed (or written
+    by hand) and serializes to a plain dict, so a failing chaos seed is a
+    reproducible bug report, not a flake.
+
+  * Nothing is silent. Every fault the injector fires is appended to
+    `injector.fired` with its site/call-count/kind; the estimator copies that
+    log into the run manifest (`manifest["faults"]`) and `telemetry report`
+    renders it.
+
+Fault taxonomy (the `kind` field):
+
+  preempt    SimulatedPreemption — the SIGTERM/deadline class: the fit dies
+             mid-epoch and a supervisor restarts it from the last checkpoint.
+  fatal      InjectedFault — a non-retryable failure (feed worker death,
+             checkpoint commit failure): the component dies, the error must
+             surface, recovery is restart-from-checkpoint.
+  transient  TransientFault — the blip class (flaky H2D transfer, NFS hiccup
+             on save): `reliability.retry.RetryPolicy` absorbs a bounded
+             number of these with backoff, recording every attempt.
+  truncate   not raised in-line: a post-crash directive for the chaos harness
+             to corrupt the newest checkpoint on disk, exercising checksum
+             verification + quarantine in `utils/checkpoint.latest_checkpoint`.
+"""
+
+import contextlib
+import dataclasses
+import threading
+
+import numpy as np
+
+# Hook sites wired into production code. Keep in sync with docs/reliability.md.
+SITES = (
+    "feed.worker",   # train/pipeline.py worker loop, once per host batch
+    "feed.h2d",      # train/pipeline.py _stage, before device placement
+    "train.step",    # models/estimator.py, before each optimizer step
+    "ckpt.save",     # utils/checkpoint.py, before writing checkpoint files
+    "ckpt.commit",   # utils/checkpoint.py, before the atomic rename
+)
+
+# Post-crash directives consumed by the chaos harness, not fired in-line.
+HARNESS_SITES = ("ckpt.corrupt",)
+
+KINDS = ("preempt", "fatal", "transient", "truncate")
+
+
+class InjectedFault(RuntimeError):
+    """Base class for every injector-raised failure (kind='fatal')."""
+
+
+class SimulatedPreemption(InjectedFault):
+    """The SIGTERM/deadline class: the whole fit dies mid-epoch."""
+
+
+class TransientFault(InjectedFault):
+    """The retryable blip class: a bounded retry should absorb it."""
+
+
+_KIND_EXC = {"preempt": SimulatedPreemption, "fatal": InjectedFault,
+             "transient": TransientFault}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One planned fault: fire `kind` at the `at`-th call (1-based) of `site`,
+    for `times` consecutive calls."""
+
+    site: str
+    at: int
+    kind: str
+    times: int = 1
+    note: str = ""
+
+    def __post_init__(self):
+        assert self.site in SITES + HARNESS_SITES, self.site
+        assert self.kind in KINDS, self.kind
+        assert self.at >= 1 and self.times >= 1
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A reproducible set of faults, identified by its seed."""
+
+    seed: int
+    specs: tuple
+
+    def to_dict(self):
+        return {"seed": self.seed, "specs": [s.to_dict() for s in self.specs]}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(seed=int(d["seed"]),
+                   specs=tuple(FaultSpec.from_dict(s) for s in d["specs"]))
+
+    @property
+    def harness_specs(self):
+        """Directives the chaos harness applies between runs (ckpt.corrupt)."""
+        return tuple(s for s in self.specs if s.site in HARNESS_SITES)
+
+    @property
+    def inline_specs(self):
+        return tuple(s for s in self.specs if s.site in SITES)
+
+    @classmethod
+    def generate(cls, seed, n_steps, n_save_calls=2):
+        """Derive a plan from a seed, sized to a fit of `n_steps` optimizer
+        steps. The seed picks one mandatory fault family (round-robin, so any
+        8 consecutive seeds cover every family) plus 0-2 extra transients.
+
+        `n_save_calls` is a lower bound on how many checkpoint saves the fit
+        will attempt — save-site faults are planned within it so they actually
+        fire.
+        """
+        rng = np.random.default_rng(seed)
+        step_at = int(rng.integers(2, max(3, n_steps)))  # never step 1: a
+        # pre-first-checkpoint preemption would test restart-from-scratch,
+        # which is a different (trivial) recovery path
+        families = (
+            lambda: (FaultSpec("train.step", step_at, "preempt",
+                               note="mid-epoch preemption"),),
+            lambda: (FaultSpec("feed.worker",
+                               int(rng.integers(1, max(2, n_steps))), "fatal",
+                               note="feed worker death"),),
+            lambda: (FaultSpec("feed.h2d",
+                               int(rng.integers(1, max(2, n_steps))),
+                               "transient", note="flaky H2D transfer"),),
+            lambda: (FaultSpec("ckpt.save",
+                               int(rng.integers(1, n_save_calls + 1)),
+                               "transient", note="transient save I/O"),),
+            lambda: (FaultSpec("ckpt.commit",
+                               int(rng.integers(1, n_save_calls + 1)), "fatal",
+                               note="commit failure -> torn tmp"),),
+            lambda: (FaultSpec("train.step", step_at, "preempt",
+                               note="preemption before corruption"),
+                     FaultSpec("ckpt.corrupt", 1, "truncate",
+                               note="truncate newest checkpoint post-crash")),
+        )
+        specs = list(families[seed % len(families)]())
+        for _ in range(int(rng.integers(0, 3))):
+            specs.append(FaultSpec(
+                "feed.h2d" if rng.random() < 0.5 else "ckpt.save",
+                int(rng.integers(1, max(2, n_steps))), "transient",
+                note="extra transient"))
+        return cls(seed=int(seed), specs=tuple(specs))
+
+
+class FaultInjector:
+    """Executes a FaultPlan: counts calls per site, raises planned faults,
+    logs everything it fires. Thread-safe — the feed worker and checkpoint
+    writer hit sites from their own threads."""
+
+    def __init__(self, plan):
+        self.plan = plan
+        self.fired = []           # [{site, call, kind, note}] in fire order
+        self.retries = []         # retry events mirrored by RetryPolicy.run —
+        # cumulative across restarts, so the FINAL run's manifest still shows
+        # recoveries that happened in earlier (crashed) attempts
+        self._counts = {}
+        self._lock = threading.Lock()
+
+    def fire(self, site, **info):
+        """Called by production hooks. Raises the planned exception when a
+        spec matches this call, else returns instantly."""
+        with self._lock:
+            call = self._counts.get(site, 0) + 1
+            self._counts[site] = call
+            spec = next(
+                (s for s in self.plan.inline_specs
+                 if s.site == site and s.at <= call < s.at + s.times), None)
+            if spec is None:
+                return
+            event = {"site": site, "call": call, "kind": spec.kind,
+                     "note": spec.note, **{k: _jsonable(v)
+                                           for k, v in info.items()}}
+            self.fired.append(event)
+        raise _KIND_EXC[spec.kind](
+            f"injected {spec.kind} at {site} (call {call}): {spec.note}")
+
+    def note_retry(self, event):
+        """Mirror one RetryPolicy event into the injector's cumulative log."""
+        with self._lock:
+            self.retries.append(dict(event))
+
+    def note(self, site, kind, **info):
+        """Record a harness-applied fault (e.g. ckpt.corrupt) in the same log
+        as in-line fires, so the manifest shows the complete plan execution."""
+        with self._lock:
+            self.fired.append({"site": site, "call": 0, "kind": kind,
+                               **{k: _jsonable(v) for k, v in info.items()}})
+
+    def summary(self):
+        return {"seed": self.plan.seed, "planned": len(self.plan.specs),
+                "fired": list(self.fired)}
+
+
+def _jsonable(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+# ---------------------------------------------------------------- module hook
+# A plain module global, not a contextvar: the feed worker and the async
+# checkpoint writer run on their own threads, and contextvars don't propagate
+# into already-running thread pools. Chaos runs are single-injector by design.
+_active = None
+
+
+def active_injector():
+    """The installed FaultInjector, or None outside a chaos run."""
+    return _active
+
+
+@contextlib.contextmanager
+def install(injector):
+    """Install `injector` as the process-wide fault source for the duration
+    of the block. Nesting is a bug — chaos plans are one-at-a-time."""
+    global _active
+    assert _active is None, "a FaultInjector is already installed"
+    _active = injector
+    try:
+        yield injector
+    finally:
+        _active = None
+
+
+def fire(site, **info):
+    """Production-side hook: no-op unless a chaos run installed an injector."""
+    if _active is not None:
+        _active.fire(site, **info)

@@ -1,0 +1,104 @@
+"""Bounded retry-with-backoff for transient feed/save faults.
+
+One policy class used at both retryable surfaces: the pipelined feed's H2D
+staging (train/pipeline.py) and the checkpoint writer (utils/checkpoint.py
+AsyncCheckpointer). The contract the reliability subsystem enforces:
+
+  * bounded — `max_attempts` total tries, then the original exception
+    propagates unchanged (a persistent fault must fail loudly, not loop);
+  * backed off — sleep `backoff_s * factor**i` between tries, so a struggling
+    filesystem or link is not hammered;
+  * never silent — every retry is appended to `policy.events`, mirrored into
+    the active telemetry tracer as a `reliability/retry` span, and the
+    estimator folds the events into the run manifest (`manifest["faults"]
+    ["retries"]`) so `telemetry report` shows them.
+
+What counts as transient: the injector's TransientFault (chaos runs), plus
+the OS-level blip classes a real deployment sees — interrupted syscalls,
+timeouts, dropped connections. Anything else (ValueError, a dead worker's
+InjectedFault, ...) is NOT retried: retrying a deterministic bug just
+multiplies it.
+"""
+
+import errno
+import time
+
+from . import faults as _faults
+from .faults import TransientFault
+
+# errno values worth one more try; everything else in OSError is structural
+# (ENOENT, EACCES, ENOSPC...) and must surface immediately.
+_TRANSIENT_ERRNOS = frozenset({errno.EAGAIN, errno.EINTR, errno.EIO,
+                               errno.EBUSY, errno.ETIMEDOUT})
+
+
+def is_transient(exc):
+    """Default retry predicate — see module docstring for the rationale."""
+    if isinstance(exc, TransientFault):
+        return True
+    if isinstance(exc, (TimeoutError, InterruptedError, ConnectionError,
+                        BrokenPipeError)):
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in _TRANSIENT_ERRNOS
+    return False
+
+
+class RetryPolicy:
+    """Run callables with bounded, recorded, backed-off retries.
+
+    :param max_attempts: total tries (1 = no retry).
+    :param backoff_s: sleep before retry i is `backoff_s * factor**(i-1)`.
+    :param retryable: predicate deciding which exceptions earn a retry.
+    :param on_retry: optional callback(event_dict) — the estimator uses it to
+        collect retries for the run manifest.
+    :param sleep: injection point for tests (defaults to time.sleep).
+    """
+
+    def __init__(self, max_attempts=3, backoff_s=0.05, factor=2.0,
+                 retryable=is_transient, on_retry=None, sleep=time.sleep):
+        assert int(max_attempts) >= 1
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.factor = float(factor)
+        self.retryable = retryable
+        self.on_retry = on_retry
+        self._sleep = sleep
+        self.events = []  # every retry ever taken under this policy
+
+    def run(self, fn, *args, site="", **kwargs):
+        """Call fn(*args, **kwargs), retrying transient failures. The last
+        failure propagates unchanged once attempts are exhausted."""
+        from .. import telemetry
+
+        delay = self.backoff_s
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn(*args, **kwargs)
+            except Exception as exc:
+                if attempt >= self.max_attempts or not self.retryable(exc):
+                    raise
+                event = {"site": site, "attempt": attempt,
+                         "max_attempts": self.max_attempts,
+                         "error": f"{type(exc).__name__}: {exc}",
+                         "backoff_s": round(delay, 4)}
+                self.events.append(event)
+                inj = _faults.active_injector()
+                if inj is not None:
+                    inj.note_retry(event)  # survives restarts: the final
+                    # attempt's manifest must still show earlier recoveries
+                if self.on_retry is not None:
+                    try:
+                        self.on_retry(event)
+                    # jaxcheck: disable=R9 (guards the recording callback itself; the retry event is already in self.events and the injector log)
+                    except Exception:
+                        pass
+                # a zero-length span is enough to land the retry (with its
+                # site/attempt args) in the trace timeline next to the work
+                # it interrupted
+                with telemetry.span("reliability/retry", fence=False,
+                                    args=event):
+                    pass
+                self._sleep(delay)
+                delay *= self.factor
+        raise AssertionError("unreachable")  # pragma: no cover

@@ -90,6 +90,7 @@ class FlightRecorder:
         self.last_good_step = None
         self.batch_signature = None
         self.bundle_path = None
+        self.faults = []
 
     # ------------------------------------------------------------ recording
 
@@ -150,6 +151,16 @@ class FlightRecorder:
         if self.first_bad_reason is None:
             self.first_bad_reason = f"exception: {type(exc).__name__}: {exc}"
 
+    def note_fault(self, event):
+        """Record one recovered fault (an I/O retry, an injected transient) —
+        recoveries must never be silent, so they ride the diagnostics bundle
+        alongside the step ring. `event` is a small JSON-able dict
+        (reliability/retry.py shapes it)."""
+        try:
+            self.faults.append(dict(event))
+        except Exception:
+            pass  # diagnostics must never kill a fit
+
     # ------------------------------------------------------------ snapshots
 
     def snapshot(self):
@@ -181,6 +192,7 @@ class FlightRecorder:
             "n_steps_recorded": self.n_recorded,
             "ring": list(self.ring),
             "batch_signature": self.batch_signature,
+            "faults": list(self.faults),
         }
         if manifest_path and os.path.exists(manifest_path):
             try:

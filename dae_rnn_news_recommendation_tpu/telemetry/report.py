@@ -225,6 +225,28 @@ def health_summary(bundle):
     return out
 
 
+def faults_summary(manifest):
+    """The manifest's `faults` section (models/estimator.py
+    `_write_fault_manifest`): injected chaos faults, recorded I/O retries,
+    and any checkpoint-cadence fallback — the zero-silent-recoveries ledger
+    of the run."""
+    section = (manifest or {}).get("faults")
+    if not isinstance(section, dict):
+        return None
+    out = {"n_retries": len(section.get("retries") or []),
+           "n_injected": len(section.get("injected") or []),
+           "retries": section.get("retries") or [],
+           "injected": section.get("injected") or []}
+    if "plan_seed" in section:
+        out["plan_seed"] = section["plan_seed"]
+    if section.get("cadence_fallback"):
+        out["cadence_fallback"] = section["cadence_fallback"]
+    if not (out["n_retries"] or out["n_injected"]
+            or out.get("cadence_fallback")):
+        return None  # an empty ledger renders nothing
+    return out
+
+
 # ---------------------------------------------------------------- rendering
 
 _COLS = ("span", "count", "total_s", "p50_ms", "p95_ms",
@@ -242,7 +264,7 @@ def _fmt_row(values, widths):
 
 
 def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
-                health=None, notes=None):
+                health=None, faults=None, notes=None):
     lines = []
     if manifest:
         lines.append("run: git %s  backend=%s  feed=%s  created %s" % (
@@ -312,6 +334,25 @@ def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
                                     "health/nonfinite")
                           if isinstance(row.get(k), float)]
                 lines.append("    " + "  ".join(parts))
+    if faults:
+        lines.append("")
+        head = (f"faults/retries: {faults['n_injected']} injected, "
+                f"{faults['n_retries']} retried")
+        if "plan_seed" in faults:
+            head += f"  (chaos plan seed {faults['plan_seed']})"
+        lines.append(head)
+        for ev in faults["injected"]:
+            where = ev.get("site", "?")
+            call = ev.get("call")
+            loc = f"{where} call {call}" if call else where
+            lines.append(f"  injected: {ev.get('kind', '?')} at {loc}"
+                         + (f" — {ev['note']}" if ev.get("note") else ""))
+        for ev in faults["retries"]:
+            lines.append(f"  retry: {ev.get('site', '?')} attempt "
+                         f"{ev.get('attempt')}/{ev.get('max_attempts')} "
+                         f"after {ev.get('error')}")
+        if faults.get("cadence_fallback"):
+            lines.append(f"  cadence fallback: {faults['cadence_fallback']}")
     return "\n".join(lines)
 
 
@@ -361,14 +402,15 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
         health_path = cand if os.path.exists(cand) else None
     health = health_summary(optional(health_path, load_health,
                                      "health bundle"))
+    faults = faults_summary(manifest)
     if as_json:
         return json.dumps({"spans": rows, "counters": counters,
                            "manifest": manifest, "metrics": metrics,
                            "bench": bench, "health": health,
-                           "notes": notes or None},
+                           "faults": faults, "notes": notes or None},
                           indent=2, default=str), 0
     if not rows and not (metrics or bench or health):
         return "no span events in trace", 1
     return render_text(rows, counters=counters, manifest=manifest,
                        metrics=metrics, bench=bench, health=health,
-                       notes=notes), 0
+                       faults=faults, notes=notes), 0

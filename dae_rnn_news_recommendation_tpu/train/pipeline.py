@@ -53,6 +53,7 @@ import jax
 import numpy as np
 
 from .. import telemetry
+from ..reliability import faults as _faults
 
 # Keys whose padding rows must be flagged invalid rather than zero-filled
 # (PaddedBatcher contract: padded labels never share a class with real rows).
@@ -197,23 +198,41 @@ class PipelinedFeed:
         to the nearest bucket (see `bucket_pad`).
     :param stats: optional FeedStats; consumer wait time and staged bytes are
         recorded there.
+    :param retry: optional reliability.retry.RetryPolicy; transient staging
+        failures (a flaky H2D link, an injected `feed.h2d` fault) are retried
+        with bounded backoff on the worker thread, every attempt recorded.
 
     Yielded batches are owned by the consumer alone: the pipeline drops its
     reference at hand-off, so passing them to a step with donated inputs
     (`make_train_step(donate_batch=True)`) is safe.
+
+    Failure contract: a worker that dies for ANY reason enqueues the end
+    sentinel from its `finally` (the poison pill), so a consumer blocked on
+    the queue always wakes; the worker's exception is then re-raised on the
+    consumer thread with its original traceback. The consumer additionally
+    polls worker liveness while waiting, so even a sentinel lost to
+    interpreter teardown cannot hang the fit. `stop()` (also run when the
+    consumer abandons iteration) signals the worker, drains staged device
+    batches, and joins the thread — shutdown leaks neither buffers nor
+    threads.
     """
 
     def __init__(self, batches, depth=2, place=None, extremes=None,
-                 buckets=None, stats=None):
+                 buckets=None, stats=None, retry=None):
         self._batches = batches
         self.depth = max(1, int(depth))
         self._place = place or jax.device_put
         self._extremes = dict(extremes) if extremes else None
         self._buckets = tuple(buckets) if buckets else None
         self.stats = stats
+        self.retry = retry
+        self._thread = None
+        self._queue = None
+        self._stop_evt = None
 
     def _stage(self, host_batch):
         """Host batch -> staged device batch (runs on the worker thread)."""
+        _faults.fire("feed.h2d")
         if self._extremes:
             host_batch = {**host_batch, **self._extremes}
         with telemetry.span("feed/pad", fence=False):  # host-only work
@@ -241,6 +260,7 @@ class PipelinedFeed:
         end = object()
         err = []
         stop = threading.Event()
+        self._queue, self._stop_evt = q, stop
 
         def put(item):
             while not stop.is_set():
@@ -251,31 +271,78 @@ class PipelinedFeed:
                     continue
             return False
 
+        def stage(hb):
+            if self.retry is not None:
+                return self.retry.run(self._stage, hb, site="feed.h2d")
+            return self._stage(hb)
+
         def worker():
             try:
-                for hb in self._batches:
-                    if not put(self._stage(hb)):
+                for n, hb in enumerate(self._batches):
+                    _faults.fire("feed.worker", batch=n)
+                    if not put(stage(hb)):
                         return
-            except BaseException as e:  # surfaced on the consumer thread
+            # jaxcheck: disable=R9 (surfaced on the consumer: __iter__ re-raises err[0] after the end sentinel wakes it)
+            except BaseException as e:
                 err.append(e)
             finally:
-                put(end)
+                put(end)  # poison pill: a blocked consumer ALWAYS wakes
 
-        threading.Thread(target=worker, daemon=True,
-                         name="pipelined-feed").start()
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="pipelined-feed")
+        self._thread.start()
         try:
             while True:
                 t0 = time.perf_counter()
                 with telemetry.span("feed/wait", fence=False):  # host block
-                    item = q.get()
+                    item = self._next_item(q, end, err)
                 if self.stats is not None and item is not end:
                     self.stats.note_wait(time.perf_counter() - t0)
                 if item is end:
                     if err:
+                        # err[0] keeps its original __traceback__ (the raise
+                        # site inside the worker), so the consumer's stack
+                        # trace points at the real failure, not the queue
                         raise err[0]
                     return
                 yield item
                 del item  # the consumer owns it now; keep donation safe
         finally:
-            # early consumer exit: release a worker blocked on the full queue
-            stop.set()
+            # consumer done or abandoning early: shut the worker down cleanly
+            self.stop()
+
+    def _next_item(self, q, end, err):
+        """Blocking get that survives a worker which died without managing to
+        enqueue its sentinel (e.g. interpreter teardown killed it between the
+        exception and the finally): poll liveness while waiting."""
+        while True:
+            try:
+                return q.get(timeout=0.2)
+            except queue.Empty:
+                t = self._thread
+                if t is not None and not t.is_alive() and q.empty():
+                    if err:
+                        raise err[0]
+                    return end  # worker finished; sentinel was lost
+
+    def stop(self):
+        """Shut the feed down: signal the worker, drain staged batches (their
+        device buffers free with the refs), and join the thread. Idempotent;
+        safe to call whether iteration finished, failed, or never started."""
+        stop, q = self._stop_evt, self._queue
+        if stop is None:
+            return
+        stop.set()
+        while True:  # make room so a worker blocked on put() can exit
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        while True:  # drain anything enqueued between the drain and the join
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

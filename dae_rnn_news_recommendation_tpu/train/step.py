@@ -349,7 +349,12 @@ def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True,
     `accum_steps>1` accumulates gradients over that many row-contiguous
     microbatches inside this SAME jitted program (grads_and_metrics):
     one optimizer update per call, one compile total, sentinel computed on
-    the accumulated gradient outside the inner scan."""
+    the accumulated gradient outside the inner scan. Keeping the whole
+    accumulation inside ONE jitted call is also a reliability invariant:
+    the host only ever observes params/opt_state between full steps, so a
+    crash can never checkpoint a half-accumulated phase — the step cursor
+    in docs/reliability.md counts these atomic calls, which is what makes
+    crash-exact resume possible without persisting any intra-step state."""
 
     def step(params, opt_state, key, batch):
         cost, metrics, grads = grads_and_metrics(loss_fn, config, params,

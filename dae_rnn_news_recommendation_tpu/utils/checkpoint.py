@@ -1,47 +1,89 @@
 """Checkpoint/restore of model + optimizer state.
 
 Twin of the reference's tf.train.Saver usage (autoencoder.py:156, :166, :169-170,
-:491) with two deliberate upgrades (SURVEY §2.3.12): periodic mid-run saves for fault
-tolerance, and the epoch stored inside the checkpoint so resume continues the schedule.
+:491) with deliberate upgrades (SURVEY §2.3.12): periodic mid-run saves for fault
+tolerance, the epoch stored inside the checkpoint so resume continues the
+schedule, and — PR 6 — crash-safe commit semantics:
 
-Layout per checkpoint:  <ckpt_dir>/step_<N>/
-    params/     model weights — orbax when importable (JAX-native, sharding-aware for
-                multi-host), .npz fallback otherwise
-    aux.npz     flattened optimizer-state leaves + epoch (structure comes from the
-                caller's `like` pytree at restore, so weights stay loadable even when
-                the restoring process uses a different optimizer — e.g. load_model)
+  * atomic commit: single-process saves write into `<name>.tmp` and
+    `os.replace` it into place, so a crash mid-write leaves a `.tmp` turd
+    (invisible to restore) instead of a half-checkpoint that restores garbage;
+  * checksum manifest: every committed checkpoint carries CHECKSUMS.json
+    (sha256 + byte size per file, written last), and `latest_checkpoint`
+    VERIFIES it before returning a path — corrupt or torn dirs are quarantined
+    (renamed `quarantined-*` + RuntimeWarning) and restore falls back to the
+    newest checkpoint that verifies;
+  * resume sidecar: `save_checkpoint(resume=...)` persists a JSON payload
+    (RNG key, batch-order cursor, batcher RNG state — models/estimator.py)
+    alongside the weights, which is what makes kill-and-resume bitwise-exact;
+  * fault hooks: `reliability.faults.fire("ckpt.save" | "ckpt.commit")` let a
+    chaos plan inject transient I/O errors and torn commits here, and
+    AsyncCheckpointer absorbs transient failures via a bounded, recorded
+    RetryPolicy (reliability/retry.py).
+
+Layout per checkpoint:  <ckpt_dir>/step_<E>[_<C>]/   (C = mid-epoch cursor)
+    params/         model weights — orbax when importable, .npz fallback
+    aux.npz         flattened optimizer-state leaves + epoch
+    resume.json     crash-exact resume payload (optional)
+    health.json     flight-recorder snapshot (optional)
+    CHECKSUMS.json  sha256 manifest over all of the above (single-process)
 """
 
+import hashlib
+import json
 import os
 import re
+import shutil
 
 import jax
 import numpy as np
+
+from ..reliability import faults as _faults
 
 try:
     import orbax.checkpoint as ocp
 except Exception:  # pragma: no cover
     ocp = None
 
-_STEP_RE = re.compile(r"^step_(\d+)$")
+# step_<epoch> for epoch-boundary saves; step_<epoch>_<cursor> for mid-epoch
+# cursor saves (cursor = optimizer steps completed into epoch `epoch`+1)
+_STEP_RE = re.compile(r"^step_(\d+)(?:_(\d+))?$")
+_MANIFEST_NAME = "CHECKSUMS.json"
+
+
+def _step_key(name):
+    """(epoch, cursor) for a checkpoint dir name, or None. Epoch-boundary
+    dirs sort as cursor 0; a cursor save for the FOLLOWING epoch sorts after
+    its base epoch and before the next epoch boundary."""
+    m = _STEP_RE.match(name)
+    if not m:
+        return None
+    return int(m.group(1)), int(m.group(2) or 0)
+
+
+def checkpoint_name(step, cursor=0):
+    return f"step_{step}_{cursor}" if cursor else f"step_{step}"
 
 
 def save_checkpoint(ckpt_dir, state, step, use_orbax=True, multiprocess=False,
-                    health=None):
+                    health=None, resume=None, cursor=0):
     """Save {'params':…, 'opt_state':…, 'epoch':…} at `step`; returns the path.
 
     `multiprocess=True` is the pod path: EVERY process calls this with the same
     shared `ckpt_dir` and its (replicated or sharded) global jax.Arrays; orbax
     coordinates the collective save (the primary host finalizes — per-process
     private dirs would never commit on non-primary hosts), and the numpy
-    sidecars are written by process 0 only.
+    sidecars are written by process 0 only. The pod path keeps the legacy
+    write-in-place layout (orbax owns its own commit protocol; a host-side
+    rename would race the collective) — single-process saves get the atomic
+    tmp+rename commit and the checksum manifest.
 
-    `health` is an optional flight-recorder snapshot (telemetry/recorder.py:
-    status, step, loss EMA, grad norm, first bad step) written as a
-    health.json sidecar so a restore can warn when the checkpoint came from a
-    degraded run."""
-    base = os.path.abspath(os.path.join(ckpt_dir, f"step_{step}"))
-    os.makedirs(base, exist_ok=True)
+    `health` is an optional flight-recorder snapshot (telemetry/recorder.py)
+    written as a health.json sidecar so a restore can warn when the checkpoint
+    came from a degraded run. `resume` is an optional JSON-able payload
+    (resume.json) carrying whatever the trainer needs for crash-exact resume.
+    `cursor` > 0 names the dir step_<step>_<cursor> for mid-epoch saves."""
+    base = os.path.abspath(os.path.join(ckpt_dir, checkpoint_name(step, cursor)))
     primary = not multiprocess or jax.process_index() == 0
 
     if multiprocess and not (use_orbax and ocp is not None):
@@ -55,6 +97,39 @@ def save_checkpoint(ckpt_dir, state, step, use_orbax=True, multiprocess=False,
             "process 0 only — restore on other hosts requires ckpt_dir to be "
             "a shared filesystem", RuntimeWarning, stacklevel=2)
 
+    if multiprocess:
+        os.makedirs(base, exist_ok=True)
+        _write_payload(base, state, use_orbax, primary, health, resume)
+        from jax.experimental import multihost_utils
+
+        # no process may return (and possibly restore) before the sidecars
+        # and the orbax commit are durable everywhere
+        multihost_utils.sync_global_devices(f"ckpt_{ckpt_dir}_{step}_{cursor}")
+        return base
+
+    # single process: write everything into a tmp dir, checksum it, then
+    # commit with one atomic rename — restore can never observe a torn dir
+    _faults.fire("ckpt.save", step=int(step), cursor=int(cursor))
+    tmp = base + ".tmp"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)  # turd from a previous crashed/injected commit
+    os.makedirs(tmp)
+    try:
+        _write_payload(tmp, state, use_orbax, True, health, resume)
+        _write_checksums(tmp)
+        _faults.fire("ckpt.commit", step=int(step), cursor=int(cursor))
+        if os.path.isdir(base):
+            shutil.rmtree(base)  # re-save of the same step supersedes it
+        os.replace(tmp, base)
+    except BaseException:
+        # leave no committed dir behind; the .tmp turd (if the rmtree below
+        # also fails) is invisible to _STEP_RE either way
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return base
+
+
+def _write_payload(base, state, use_orbax, primary, health, resume):
     params_path = os.path.join(base, "params")
     if use_orbax and ocp is not None:
         ckptr = ocp.StandardCheckpointer()
@@ -64,78 +139,127 @@ def save_checkpoint(ckpt_dir, state, step, use_orbax=True, multiprocess=False,
         leaves, _ = jax.tree_util.tree_flatten(state["params"])
         np.savez(params_path + ".npz", *[np.asarray(x) for x in leaves])
 
-    if primary:
-        opt_leaves, _ = jax.tree_util.tree_flatten(state.get("opt_state"))
-        np.savez(os.path.join(base, "aux.npz"),
-                 *[np.asarray(x) for x in opt_leaves],
-                 epoch=np.asarray(int(state.get("epoch", 0))))
-        if health is not None:
-            import json
-
-            try:
-                with open(os.path.join(base, "health.json"), "w",
-                          encoding="utf-8") as f:
-                    json.dump(health, f, indent=1, default=str)
-                    f.write("\n")
-            except (OSError, TypeError):
-                pass  # the health sidecar must never fail a save
-    if multiprocess:
-        from jax.experimental import multihost_utils
-
-        # no process may return (and possibly restore) before the sidecars
-        # and the orbax commit are durable everywhere
-        multihost_utils.sync_global_devices(f"ckpt_{ckpt_dir}_{step}")
-    return base
+    if not primary:
+        return
+    opt_leaves, _ = jax.tree_util.tree_flatten(state.get("opt_state"))
+    np.savez(os.path.join(base, "aux.npz"),
+             *[np.asarray(x) for x in opt_leaves],
+             epoch=np.asarray(int(state.get("epoch", 0))))
+    if resume is not None:
+        with open(os.path.join(base, "resume.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(resume, f)
+            f.write("\n")
+    if health is not None:
+        try:
+            with open(os.path.join(base, "health.json"), "w",
+                      encoding="utf-8") as f:
+                json.dump(health, f, indent=1, default=str)
+                f.write("\n")
+        except (OSError, TypeError):
+            pass  # the health sidecar must never fail a save
 
 
-class AsyncCheckpointer:
-    """Background-thread checkpoint writer for mid-run saves: the train loop
-    pays only for the device->host copy; serialization and disk IO overlap the
-    following epochs. One save in flight at a time (a new save waits for the
-    previous one), so ordering is preserved and host memory stays bounded at
-    one extra state copy. Call `wait()` before restoring or at end of fit."""
-
-    def __init__(self):
-        self._future = None
-        self._executor = None
-
-    def save(self, ckpt_dir, state, step, use_orbax=True, keep=0, health=None):
-        import concurrent.futures
-
-        if self._executor is None:
-            self._executor = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="ckpt")
-        host_state = jax.tree_util.tree_map(np.asarray, state)
-        self.wait()
-
-        def work():
-            save_checkpoint(ckpt_dir, host_state, step, use_orbax=use_orbax,
-                            health=health)
-            if keep:
-                prune_checkpoints(ckpt_dir, keep)
-
-        self._future = self._executor.submit(work)
-
-    def wait(self):
-        """Block until the in-flight save (if any) is durable; re-raises its
-        exception."""
-        if self._future is not None:
-            f, self._future = self._future, None
-            f.result()
+def _iter_files(base):
+    for root, _, names in os.walk(base):
+        for name in sorted(names):
+            yield os.path.join(root, name)
 
 
-def latest_checkpoint(ckpt_dir):
-    """(path, step) of the newest checkpoint under ckpt_dir, or (None, -1)."""
+def _sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_checksums(base):
+    files = {}
+    for path in _iter_files(base):
+        rel = os.path.relpath(path, base)
+        if rel == _MANIFEST_NAME:
+            continue
+        files[rel] = {"sha256": _sha256(path),
+                      "bytes": os.path.getsize(path)}
+    with open(os.path.join(base, _MANIFEST_NAME), "w", encoding="utf-8") as f:
+        json.dump({"schema": 1, "files": files}, f, indent=1)
+        f.write("\n")
+
+
+def verify_checkpoint(path):
+    """(ok, reason) — whether the checkpoint dir at `path` is safe to restore.
+
+    With a CHECKSUMS.json manifest (every single-process save since PR 6):
+    every listed file must exist with matching size and sha256. Without one
+    (legacy or pod saves): the dir must at least be structurally complete
+    (params + aux.npz present) — a dir that fails even that is a torn write."""
+    manifest_path = os.path.join(path, _MANIFEST_NAME)
+    if os.path.isfile(manifest_path):
+        try:
+            with open(manifest_path, encoding="utf-8") as f:
+                manifest = json.load(f)
+            files = manifest["files"]
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            return False, f"unreadable {_MANIFEST_NAME}: {e}"
+        for rel, meta in files.items():
+            fp = os.path.join(path, rel)
+            if not os.path.isfile(fp):
+                return False, f"missing file {rel}"
+            if os.path.getsize(fp) != meta.get("bytes"):
+                return False, (f"size mismatch for {rel}: "
+                               f"{os.path.getsize(fp)} != {meta.get('bytes')}")
+            if _sha256(fp) != meta.get("sha256"):
+                return False, f"checksum mismatch for {rel}"
+        return True, "verified"
+    # legacy/pod layout: no manifest to check against, only structure
+    has_params = (os.path.isdir(os.path.join(path, "params"))
+                  or os.path.isfile(os.path.join(path, "params.npz")))
+    has_aux = os.path.isfile(os.path.join(path, "aux.npz"))
+    if has_params and has_aux:
+        return True, "no manifest (legacy layout); structure complete"
+    return False, "partial checkpoint (params or aux.npz missing)"
+
+
+def quarantine_checkpoint(path, reason=""):
+    """Move a bad checkpoint dir aside (never delete — it is evidence) under
+    a name restore can't pick up, and warn. Returns the new path."""
+    import warnings
+
+    parent, name = os.path.split(os.path.abspath(path))
+    dest = os.path.join(parent, f"quarantined-{name}")
+    n = 1
+    while os.path.exists(dest):
+        dest = os.path.join(parent, f"quarantined-{name}.{n}")
+        n += 1
+    os.replace(path, dest)
+    warnings.warn(
+        f"quarantined corrupt checkpoint {name} ({reason}) -> {dest}; "
+        "falling back to the newest verified checkpoint",
+        RuntimeWarning, stacklevel=3)
+    return dest
+
+
+def latest_checkpoint(ckpt_dir, verify=True):
+    """(path, step) of the newest VERIFIED checkpoint under ckpt_dir, or
+    (None, -1). Candidates that fail verification (torn writes, bit rot,
+    chaos-injected truncation) are quarantined with a warning and the next
+    newest is tried — restore never silently loads a bad checkpoint."""
     if not os.path.isdir(ckpt_dir):
         return None, -1
-    best, best_step = None, -1
-    for name in os.listdir(ckpt_dir):
-        m = _STEP_RE.match(name)
-        if m:
-            step = int(m.group(1))
-            if step > best_step:
-                best, best_step = os.path.join(ckpt_dir, name), step
-    return best, best_step
+    candidates = sorted(
+        ((key, name) for name in os.listdir(ckpt_dir)
+         if (key := _step_key(name)) is not None),
+        reverse=True)
+    for (epoch, _cursor), name in candidates:
+        path = os.path.join(ckpt_dir, name)
+        if not verify:
+            return path, epoch
+        ok, reason = verify_checkpoint(path)
+        if ok:
+            return path, epoch
+        quarantine_checkpoint(path, reason)
+    return None, -1
 
 
 def load_params(ckpt_path, params_like):
@@ -161,13 +285,13 @@ def load_checkpoint(ckpt_path, like):
     When the checkpoint carries a health.json sidecar (save_checkpoint's
     `health=`), it is returned under out['health'] and a RuntimeWarning is
     raised if the run that wrote it was degraded or failed — resuming a NaN'd
-    or diverged run silently is how a bad state propagates."""
+    or diverged run silently is how a bad state propagates. A resume.json
+    sidecar (save_checkpoint's `resume=`) comes back under out['resume']."""
     params = load_params(ckpt_path, like["params"])
     aux_path = os.path.join(ckpt_path, "aux.npz")
     out = {"params": params, "opt_state": like.get("opt_state"), "epoch": 0}
     health_path = os.path.join(ckpt_path, "health.json")
     if os.path.isfile(health_path):
-        import json
         import warnings
 
         try:
@@ -183,6 +307,13 @@ def load_checkpoint(ckpt_path, like):
                 f"reason: {(out['health'] or {}).get('reason')}) — inspect the "
                 "run's health_bundle.json before trusting this state",
                 RuntimeWarning, stacklevel=2)
+    resume_path = os.path.join(ckpt_path, "resume.json")
+    if os.path.isfile(resume_path):
+        try:
+            with open(resume_path, encoding="utf-8") as f:
+                out["resume"] = json.load(f)
+        except (OSError, ValueError):
+            out["resume"] = None
     if os.path.isfile(aux_path):
         data = np.load(aux_path)
         out["epoch"] = int(data["epoch"])
@@ -200,17 +331,86 @@ def load_checkpoint(ckpt_path, like):
     return out
 
 
-def prune_checkpoints(ckpt_dir, keep):
-    """Delete all but the newest `keep` step_* checkpoints. keep<=0 keeps all."""
-    import shutil
+class AsyncCheckpointer:
+    """Background-thread checkpoint writer for mid-run saves: the train loop
+    pays only for the device->host copy; serialization and disk IO overlap the
+    following epochs. One save in flight at a time (a new save waits for the
+    previous one), so ordering is preserved and host memory stays bounded at
+    one extra state copy.
 
+    Failure contract (PR 6): a background save that raises is NEVER swallowed
+    — the exception is re-raised (with the failed step attached as a note) on
+    the next `save()` or `wait()` call, whichever comes first; fit's
+    end-of-run save always calls wait(), so no fit can finish "successfully"
+    over a failed mid-run save. Pass `retry=` (reliability.retry.RetryPolicy)
+    to absorb transient I/O faults with bounded, recorded retries before they
+    count as failures."""
+
+    def __init__(self, retry=None):
+        self._future = None
+        self._executor = None
+        self._inflight = None  # (ckpt_dir, step, cursor) for error context
+        self.retry = retry
+
+    def save(self, ckpt_dir, state, step, use_orbax=True, keep=0, health=None,
+             resume=None, cursor=0):
+        import concurrent.futures
+
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt")
+        # a real COPY, not np.asarray: for state already on the host,
+        # asarray is a view and the trainer's next update would race the
+        # background writer (device arrays copy on the D2H transfer anyway)
+        host_state = jax.tree_util.tree_map(
+            lambda x: x.copy() if isinstance(x, np.ndarray) else np.asarray(x),
+            state)
+        self.wait()  # surfaces the PREVIOUS save's failure, if any
+
+        def work():
+            def once():
+                save_checkpoint(ckpt_dir, host_state, step,
+                                use_orbax=use_orbax, health=health,
+                                resume=resume, cursor=cursor)
+
+            if self.retry is not None:
+                self.retry.run(once, site="ckpt.save")
+            else:
+                once()
+            if keep:
+                prune_checkpoints(ckpt_dir, keep)
+
+        self._inflight = (ckpt_dir, int(step), int(cursor))
+        self._future = self._executor.submit(work)
+
+    def wait(self):
+        """Block until the in-flight save (if any) is durable; re-raises its
+        exception with the failed checkpoint's identity attached."""
+        if self._future is None:
+            return
+        f, self._future = self._future, None
+        ctx, self._inflight = self._inflight, None
+        try:
+            f.result()
+        except Exception as e:
+            if ctx is not None:
+                note = (f"background checkpoint save failed: "
+                        f"dir={ctx[0]} step={ctx[1]} cursor={ctx[2]}")
+                if hasattr(e, "add_note"):
+                    e.add_note(note)
+                else:  # pre-3.11: same attribute, introspectable if not shown
+                    e.__notes__ = [*getattr(e, "__notes__", ()), note]
+            raise
+
+
+def prune_checkpoints(ckpt_dir, keep):
+    """Delete all but the newest `keep` step_* checkpoints. keep<=0 keeps all.
+    Quarantined dirs are never touched — they are crash evidence."""
     if keep <= 0 or not os.path.isdir(ckpt_dir):
         return []
     steps = sorted(
-        (int(m.group(1)), name)
-        for name in os.listdir(ckpt_dir)
-        if (m := _STEP_RE.match(name))
-    )
+        (key, name) for name in os.listdir(ckpt_dir)
+        if (key := _step_key(name)) is not None)
     removed = []
     for _, name in steps[:-keep]:
         shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)

@@ -12,3 +12,36 @@ def resolve_seed(seed):
     if seed is not None and seed >= 0:
         return int(seed)
     return int(np.random.SeedSequence().entropy % (2**31))
+
+
+def serialize_key(key):
+    """JAX PRNG key -> JSON-able list of ints, for checkpoint resume sidecars
+    (utils/checkpoint.py `resume=`). Works for both raw uint32 keys and typed
+    key arrays (whose raw words jax.random.key_data exposes)."""
+    import jax
+
+    arr = np.asarray(key)
+    if arr.dtype == object or not np.issubdtype(arr.dtype, np.integer):
+        # jaxcheck: disable=R5 (serialization reads raw key words; no randomness is drawn by either access)
+        arr = np.asarray(jax.random.key_data(key))
+    return [int(x) for x in arr.ravel()]
+
+
+def deserialize_key(words):
+    """Inverse of serialize_key: restore the exact PRNG key value, so a
+    resumed fit continues the per-batch key chain bit-for-bit (the
+    crash-exact resume contract, docs/reliability.md)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.asarray(words, dtype=np.uint32))
+
+
+def rng_state(rng):
+    """Snapshot a numpy Generator's bit-generator state as a JSON-able dict
+    (JSON carries the 128-bit PCG64 ints natively; npz cannot)."""
+    return rng.bit_generator.state
+
+
+def restore_rng_state(rng, state):
+    """Restore a snapshot taken by rng_state onto an existing Generator."""
+    rng.bit_generator.state = state

@@ -408,6 +408,32 @@ def main(argv=None):
 
         user = staged("user model (stacked DAE -> GRU, config 5)",
                       lambda: main_user_model(USER_ARGS)[1])
+
+        def _chaos():
+            # ISSUE 6 acceptance: 8 distinct seeded fault plans (preemption,
+            # feed death, torn commit, transient I/O, post-crash truncation),
+            # each ending in a completed resumed run whose final params are
+            # bitwise-identical (CPU) to the fault-free run, with every fault
+            # and retry in the run manifest
+            from dae_rnn_news_recommendation_tpu.reliability.chaos import (
+                chaos_soak)
+
+            out = chaos_soak(os.path.join(scratch, "chaos"), n_plans=8,
+                             log=print)
+            return {"n_ok": out["n_ok"], "n_plans": out["n_plans"],
+                    "all_ok": out["all_ok"],
+                    "plans": [{"seed": r.plan["seed"], "ok": r.ok,
+                               "bitwise": r.bitwise, "allclose": r.allclose,
+                               "restarts": r.restarts,
+                               "n_injected": len(r.injected),
+                               "n_retries": len(r.retries),
+                               "manifest_recorded": bool(r.manifest_faults),
+                               "detail": r.detail,
+                               "duration_s": round(r.duration_s, 2)}
+                              for r in out["results"]]}
+
+        chaos_out = staged("chaos soak (8 seeded fault plans, crash-exact "
+                           "resume)", _chaos)
     finally:
         os.chdir(cwd)
 
@@ -594,6 +620,16 @@ def main(argv=None):
               else ("evidence/bench_tpu.json has no train_mined_big_mfu — "
                     "the sidecar predates the mined-big corner; rerun "
                     "bench.py on TPU to capture it"))
+    n_bitwise = sum(1 for pl in chaos_out["plans"] if pl["bitwise"])
+    n_recorded = sum(1 for pl in chaos_out["plans"] if pl["manifest_recorded"])
+    check("chaos_soak_crash_exact_resume",
+          chaos_out["all_ok"] and n_recorded == chaos_out["n_plans"],
+          f"{chaos_out['n_ok']}/{chaos_out['n_plans']} seeded fault plans "
+          f"recovered ({n_bitwise} bitwise-identical to the fault-free run"
+          + (", the CPU bar" if platform == "cpu" else
+             "; allclose is the bar off-CPU")
+          + f"); {n_recorded}/{chaos_out['n_plans']} run manifests record "
+          "their faults — zero silent recoveries")
     check("user_category_top1", user["category_top1_accuracy"] > 0.6,
           f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.6 "
           "(chance ~1/8; scored against 5-candidate category means — one "
@@ -639,6 +675,7 @@ def main(argv=None):
         "aurocs_starspace": {k: float(v) for k, v in sorted(ss_aurocs.items())},
         "starspace": {"best_loss": ss_loss, "best_epoch": ss_epoch},
         "user_model": dict(user),
+        "chaos_soak": chaos_out,
         "checks": checks,
     }
     # the 3-seed spread behind the calibrated thresholds rides along in the
@@ -889,6 +926,27 @@ def _write_md(p):
         f"- {u['n_users_eval']} held-out users, seq_len {u['seq_len']}, "
         f"{u['d_embed']}-dim embeddings",
     ]
+    ch = p.get("chaos_soak")
+    if ch:
+        lines += [
+            "",
+            "## Chaos soak (reliability subsystem)",
+            "",
+            f"{ch['n_ok']}/{ch['n_plans']} seeded fault plans — preemption "
+            "mid-epoch, feed-worker death, torn checkpoint commit, transient "
+            "I/O, post-crash truncation — each driven to a completed resumed "
+            "run (docs/reliability.md). On CPU the resumed params must be "
+            "bitwise-identical to the fault-free run's; every injected fault "
+            "and retry is recorded in the run manifest:",
+            "",
+            "| plan | ok | bitwise | restarts | faults | retries | s |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for pl in ch["plans"]:
+            lines.append(
+                f"| {pl['seed']} | {pl['ok']} | {pl['bitwise']} | "
+                f"{pl['restarts']} | {pl['n_injected']} | {pl['n_retries']} | "
+                f"{pl['duration_s']} |")
     lines += ["", "## Checks", ""]
     for name, c in p["checks"].items():
         lines.append(f"- **{'PASS' if c['pass'] else 'FAIL'}** {name}: {c['detail']}")

@@ -1,0 +1,75 @@
+"""Planted R9: broad except handlers in training/feed loops that swallow the
+error — the silent-truncation class (a dead feed or failed step vanishes and
+the fit 'completes' on partial data). Clean twins: re-raise, narrow clause,
+recording handlers, and a reasoned surface-on-consumer disable."""
+
+import warnings
+
+
+def swallowing_feed_loop(batches, step):
+    n = 0
+    for batch in batches:
+        try:
+            step(batch)
+            n += 1
+        except Exception:  # planted: R9
+            pass  # batch silently dropped — the fit lies about coverage
+    return n
+
+
+def swallowing_try_around_loop(batches, step):
+    try:
+        for batch in batches:
+            step(batch)
+    except BaseException:  # planted: R9
+        return None  # the whole tail of the epoch vanishes
+
+
+def bare_except_in_loop(batches, step):
+    for batch in batches:
+        try:
+            step(batch)
+        except:  # noqa: E722  # planted: R9
+            continue
+
+
+# ---------------------------------------------------------------- clean twins
+
+def reraising_loop(batches, step):
+    for batch in batches:
+        try:
+            step(batch)
+        except Exception:
+            raise  # surfaces immediately: clean
+
+
+def recording_loop(batches, step):
+    for batch in batches:
+        try:
+            step(batch)
+        except Exception as e:
+            warnings.warn(f"step failed: {e}", RuntimeWarning)  # recorded
+
+
+def narrow_clause_loop(batches, step):
+    for batch in batches:
+        try:
+            step(batch)
+        except KeyError:
+            continue  # a narrow, deliberate clause is not R9's business
+
+
+def no_loop_guard(fn):
+    try:
+        return fn()
+    except Exception:
+        return None  # not in/around a loop: import-guard class, exempt
+
+
+def worker_surface_on_consumer(batches, step, err):
+    for batch in batches:
+        try:
+            step(batch)
+        # jaxcheck: disable=R9 (worker thread cannot re-raise; err[] is re-raised by the consumer)
+        except BaseException as e:
+            err.append(e)

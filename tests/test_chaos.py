@@ -1,0 +1,163 @@
+"""Chaos-soak acceptance (ISSUE 6): seeded fault plans replayed end-to-end
+must recover to BITWISE-identical params on CPU, with every fault and retry
+visible in the run manifest (zero silent recoveries) and every plan bounded
+by a deadline (zero hangs). Plus the SIGTERM flavor: a fit killed by a real
+signal and resumed in a fresh process state must match an uninterrupted run
+exactly.
+
+Component-level contracts live in tests/test_reliability.py; this file is
+the end-to-end bar.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import jax
+
+from dae_rnn_news_recommendation_tpu.reliability.chaos import chaos_soak
+from dae_rnn_news_recommendation_tpu.telemetry.report import (
+    faults_summary, render_text)
+
+N_PLANS = 8  # >= 6 consecutive seeds cover every fault family (faults.py)
+
+
+def test_chaos_soak_is_crash_exact_and_nothing_is_silent(tmp_path):
+    out = chaos_soak(str(tmp_path), n_plans=N_PLANS)
+    results = out["results"]
+    assert len(results) == N_PLANS
+
+    for res in results:
+        seed = res.plan["seed"]
+        assert res.ok, f"plan {seed}: {res.detail}"
+        if jax.default_backend() == "cpu":
+            assert res.bitwise, (
+                f"plan {seed}: recovered but not bitwise ({res.detail})")
+        assert res.injected, f"plan {seed} landed no faults (nothing tested)"
+        # zero silent recoveries: the FINAL run manifest carries every fault
+        # that fired and every retry taken, across all crashed attempts
+        mf = res.manifest_faults
+        assert len(mf.get("injected") or []) == len(res.injected), (
+            f"plan {seed}: manifest lost injected faults: "
+            f"{mf.get('injected')} vs {res.injected}")
+        assert len(mf.get("retries") or []) == len(res.retries), (
+            f"plan {seed}: manifest lost retries: "
+            f"{mf.get('retries')} vs {res.retries}")
+        assert mf.get("plan_seed") == seed
+
+    assert out["all_ok"] and out["n_ok"] == N_PLANS
+
+    # the soak as a whole exercised both recovery modes...
+    assert any(r.restarts > 0 for r in results)   # restart-from-checkpoint
+    assert any(r.retries for r in results)        # absorbed transients
+    # ...and every fault family the generator round-robins over
+    sites = {(e["site"], e["kind"]) for r in results for e in r.injected}
+    assert {("train.step", "preempt"), ("feed.worker", "fatal"),
+            ("feed.h2d", "transient"), ("ckpt.save", "transient"),
+            ("ckpt.commit", "fatal"), ("ckpt.corrupt", "truncate")} <= sites
+
+    # `telemetry report` renders the ledger (satellite: faults section)
+    res = next(r for r in results if r.retries)
+    faults = faults_summary({"faults": res.manifest_faults})
+    assert faults is not None
+    assert faults["n_injected"] == len(res.injected)
+    assert faults["n_retries"] == len(res.retries)
+    text = render_text([], faults=faults)
+    assert "faults/retries:" in text
+    assert "injected:" in text and "retry:" in text
+
+
+# The kill-and-resume parity script: run an uninterrupted reference fit, then
+# the same fit interrupted by a REAL SIGTERM (delivered by a watcher thread
+# the moment the first epoch checkpoint commits — deterministic, no parent
+# timing races), then resume it; both digests are printed for the parent.
+_SCRIPT = textwrap.dedent("""
+    import os, sys, signal, threading, time
+    repo = sys.argv[1]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+    from dae_rnn_news_recommendation_tpu.reliability.chaos import (
+        params_digest, soak_data)
+    from dae_rnn_news_recommendation_tpu.utils.checkpoint import (
+        latest_checkpoint)
+
+    TOTAL = 6
+    X = soak_data(n_rows=240, n_features=24, seed=1234)  # 20 batches/epoch
+
+    def make(tag, num_epochs):
+        # masking corruption + momentum so the per-batch PRNG chain and the
+        # optimizer state both MATTER: a wrong resume shows up in the digest
+        return DenoisingAutoencoder(
+            model_name=f"parity-{tag}", main_dir=f"parity-{tag}/",
+            results_root=os.path.join(os.getcwd(), tag),
+            num_epochs=num_epochs, batch_size=12, verbose=False,
+            use_tensorboard=False, seed=11, opt="momentum", momentum=0.7,
+            learning_rate=0.05, corr_type="masking", corr_frac=0.3,
+            triplet_strategy="none", checkpoint_every=1,
+            checkpoint_every_steps=4, n_components=4)
+
+    ref = make("ref", TOTAL)
+    ref.fit(X)
+    print("REF_DIGEST", params_digest(ref.params), flush=True)
+
+    m = make("chaos", TOTAL)
+    done = threading.Event()
+
+    def watcher():
+        # fire the moment epoch 1's checkpoint commits -> the signal lands
+        # mid-epoch-2 and the graceful handler stops at that boundary
+        first = os.path.join(m.model_path, "step_1")
+        while not done.is_set():
+            if os.path.isdir(first):
+                os.kill(os.getpid(), signal.SIGTERM)
+                return
+            time.sleep(0.001)
+
+    threading.Thread(target=watcher, daemon=True).start()
+    m.fit(X)
+    done.set()
+    path, _ = latest_checkpoint(m.model_path)
+    completed = int(np.load(os.path.join(path, "aux.npz"))["epoch"])
+    print("STOPPED_AT", completed, flush=True)
+    if completed >= TOTAL:
+        print("TOO_LATE", flush=True)  # signal lost the race; nothing to test
+        sys.exit(0)
+
+    m2 = make("chaos", TOTAL - completed)
+    m2.fit(X, restore_previous_model=True)
+    print("RESUMED_DIGEST", params_digest(m2.params), flush=True)
+""")
+
+
+def test_sigterm_kill_and_resume_matches_uninterrupted_run(tmp_path):
+    script = tmp_path / "parity.py"
+    script.write_text(_SCRIPT)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    proc = subprocess.run([sys.executable, str(script), repo],
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True, cwd=tmp_path, env=env, timeout=570)
+    out = proc.stdout
+    assert proc.returncode == 0, out[-3000:]
+    if "TOO_LATE" in out:  # pragma: no cover - timing fallback, not expected
+        pytest.skip("SIGTERM landed after the fit finished; nothing to test")
+
+    def grab(prefix):
+        lines = [ln for ln in out.splitlines() if ln.startswith(prefix)]
+        assert lines, f"{prefix} missing from:\n{out[-3000:]}"
+        return lines[0].split()[1]
+
+    stopped = int(grab("STOPPED_AT"))
+    assert 1 <= stopped < 6, out[-2000:]       # it really was interrupted
+    assert "stopping early" in out             # via the graceful SIGTERM path
+    ref, resumed = grab("REF_DIGEST"), grab("RESUMED_DIGEST")
+    assert ref == resumed, (
+        f"kill-and-resume diverged: ref {ref[:16]} vs resumed "
+        f"{resumed[:16]} (stopped at epoch {stopped})\n{out[-2000:]}")
