@@ -1,4 +1,4 @@
-"""jaxcheck rules R1-R8 — AST checkers for the JAX hazard classes this repo
+"""jaxcheck rules R1-R10 — AST checkers for the JAX hazard classes this repo
 has been bitten by (see docs/jaxcheck.md for the catalog with in-repo
 examples of each).
 
@@ -1172,4 +1172,77 @@ def check_r9(ctx):
             visit(child, in_loop or isinstance(node, (ast.For, ast.While)))
 
     visit(ctx.tree, in_loop=False)
+    return out
+
+
+# ------------------------------------------------------------------- R10
+
+# exact dotted names (pickle.loads must not drag json.loads along)
+_R10_EXACT = {"pickle.loads", "pickle.load", "marshal.loads"}
+# unambiguous short names: flagged whatever module alias they hang off
+# (zlib/bz2/lzma/gzip .decompress, np.unpackbits, and the wire codec's
+# host-side entry points)
+_R10_SHORT = {"decompress", "unpackbits", "unpack_wire_host",
+              "pack_csr_wire"}
+
+
+def _r10_is_host_decode(node):
+    name = call_name(node)
+    if not name:
+        return None
+    if name in _R10_EXACT or name.split(".")[-1] in _R10_SHORT:
+        return name
+    return None
+
+
+@rule("R10", "host-side per-batch decompression in a feed/training loop")
+def check_r10(ctx):
+    """Decoding compressed payloads on the host once per batch (zlib/bz2/
+    lzma/gzip decompress, pickle loads, np.unpackbits, or the wire codec's
+    host-side unpack/pack) serializes the feed on host CPU: the decode sits
+    on the critical path between batches, exactly the stall the compressed
+    wire format exists to remove — pack ONCE on the host at ingest, ship the
+    packed words, and expand on device inside the jitted step
+    (ops/wire.unpack_wire in train/step.materialize_x). Flagged inside
+    For/While loops and inside generator bodies (a generator's body re-runs
+    per yielded batch). Legitimate per-batch host pack sites — a codec
+    accounting sweep in bench code, a golden-reference comparison in a test
+    harness — carry a reasoned `# jaxcheck: disable=R10`."""
+    out = []
+    seen = set()
+
+    def flag(node):
+        name = _r10_is_host_decode(node)
+        if name and node.lineno not in seen:
+            seen.add(node.lineno)
+            out.append(ctx.finding(
+                node, f"`{name}` runs host-side per batch in this "
+                "feed/training loop — the decode serializes the feed on "
+                "host CPU; pack once at ingest and expand on device in the "
+                "jitted step (ops/wire.unpack_wire), or hoist the decode "
+                "out of the loop"))
+
+    def is_generator(fn):
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                return True
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(n))
+        return False
+
+    def visit(node, hot):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a generator body re-executes per yielded item: per-batch
+            hot = is_generator(node)
+        elif isinstance(node, ast.Lambda):
+            hot = False
+        if hot and isinstance(node, ast.Call):
+            flag(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child, hot or isinstance(node, (ast.For, ast.While)))
+
+    visit(ctx.tree, hot=False)
     return out

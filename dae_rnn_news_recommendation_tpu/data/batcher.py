@@ -144,6 +144,60 @@ class SparseIngestBatcher(PaddedBatcher):
         return {"indices": padded["indices"], "values": values}
 
 
+class WireSparseIngestBatcher(SparseIngestBatcher):
+    """Compressed-wire feed: yields the ops/wire packed layout instead of
+    padded-CSR (indices, values) pairs. Sorted column indices ship as one
+    whole first index plus delta-encoded gaps bit-packed at a corpus-static
+    width, and values optionally quantize (f16/i8) — bytes/article drops
+    well below the padded `kk*6` of SparseIngestBatcher (see
+    docs/feed_pipeline.md). The jitted step expands the packed words back to
+    padded (indices, values) ON DEVICE (train/step.py materialize_x ->
+    ops/wire.unpack_wire), so the host ships the small buffer and the chip
+    pays the cheap decode.
+
+    The WireSpec planned once per epoch over the whole matrix rides in every
+    batch as a static (hashable, empty-pytree) entry, so all batches of a fit
+    compile to one program per bucket, exactly like the padded-CSR feed.
+    """
+
+    #: value modes a training feed may use — `binary` elides values entirely
+    #: (reconstruction needs them), so it stays a codec/bench-only mode.
+    FEED_MODES = ("f32", "f16", "i8")
+
+    def __init__(self, *args, wire_mode="f32", **kwargs):
+        super().__init__(*args, **kwargs)
+        assert wire_mode in self.FEED_MODES, (
+            f"wire_mode must be one of {self.FEED_MODES}, got {wire_mode!r}")
+        self.wire_mode = wire_mode
+
+    def _prepare(self, data):
+        from ..ops import wire
+
+        csr, _k = super()._prepare(data)
+        spec = wire.plan_wire(csr, mode=self.wire_mode)
+        return csr, spec
+
+    def _payload(self, ctx, idx, n_real):
+        from ..ops import wire
+
+        csr, spec = ctx
+        packed = wire.pack_csr_wire(csr[idx], spec=spec)
+        if n_real < len(idx):
+            # padded rows (idx repeats row 0) must be inert: nnz=0 unpacks to
+            # all pad_index columns, zero values contribute nothing
+            packed["words"][n_real:] = 0
+            packed["first"][n_real:] = 0
+            packed["nnz"][n_real:] = 0
+            if "values" in packed:
+                packed["values"][n_real:] = 0
+            if "scale" in packed:
+                packed["scale"][n_real:] = 1.0
+        out = {f"x_wire_{key}": v for key, v in packed.items()
+               if key != "spec"}
+        out["x_wire_spec"] = packed["spec"]
+        return out
+
+
 def gen_batches(data, data_corrupted, batch_size, data_label=None, random=True, seed=None):
     """Reference-compatible generator (utils.py:29-70): yields
     (batch_data, batch_data_corrupted[, batch_label]) in the original ragged shapes.

@@ -121,6 +121,7 @@ def load_cifar10_dataset(cifar_dir, mode="supervised",
             if not (fn.startswith("data") or fn.startswith("test")):
                 continue
             with open(os.path.join(cifar_dir, fn), "rb") as f:
+                # jaxcheck: disable=R10 (one-time dataset load at startup — ~6 CIFAR pickle files once per process, not a per-batch feed decode)
                 batch = pickle.load(f, encoding="bytes")
             data = np.asarray(batch.get(b"data", batch.get("data")))
             labels = np.asarray(batch.get(b"labels", batch.get("labels")))

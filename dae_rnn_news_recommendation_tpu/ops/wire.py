@@ -1,0 +1,408 @@
+"""Compressed CSR wire format: delta + bit-packed indices, quantized values.
+
+The padded-CSR feed ships `(uint16/uint32 indices, float32 values)` pairs —
+`K * 6` bytes per article at the default dtypes — across a host→device link
+that bench.py measures in the tens of MB/s. This module shrinks the wire:
+
+* **indices** — per row, sorted column indices are delta-encoded (first index
+  shipped whole, then gaps) and the gaps bit-packed into int32 words at a
+  corpus-static field width `bits ∈ {4, 8, 16, 32}` (a divisor of 32, so a
+  word always holds exactly `32 // bits` fields and unpack is pure
+  shift/mask — no cross-word fields, the same code path on host numpy, XLA,
+  and Mosaic);
+* **values** — shipped as `f32` (lossless), `f16`, `i8` (per-row absmax
+  linear quantization), or elided entirely in `binary` mode (0/1 corpora,
+  the padded-CSR binary convention: `pad_index = n_features`, values None).
+
+The packed layout is *planar*: the `K-1` gap fields are laid out as
+`32 // bits` planes of `W = ceil((K-1) / (32 // bits))` fields each, with
+plane `l` occupying bit range `[l*bits, (l+1)*bits)` of every word. Unpack
+extracts each plane with one logical shift + mask and concatenates planes
+along the slot axis — a layout chosen so the Pallas kernel never needs a
+gather or an interleaving reshape.
+
+Round-trip contract (tests/test_wire.py): `unpack_wire_host(pack_csr_wire(m))`
+is **bitwise identical** to `pad_csr_batch(m)` for `f32` and `binary` modes
+(and for `f16` when every value is exactly representable, e.g. 0/1 data).
+The jnp unpack matches the host unpack bitwise on CPU, which is what makes a
+packed-wire fit reproduce the plain pipelined fit digest-for-digest.
+
+The `WireSpec` carried alongside a packed batch is registered as an empty
+pytree node whose *aux data* is the spec itself — it rides inside jitted
+batch dicts as a static (hashable) part of the treedef, so one spec means
+one compiled program no matter how many batches flow through.
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+VALUE_MODES = ("f32", "f16", "i8", "binary")
+_WIRE_BITS = (4, 8, 16, 32)
+
+
+@dataclasses.dataclass(frozen=True)
+class WireSpec:
+    """Static shape/format metadata for one packed corpus.
+
+    One spec per fit: every batch packed under it unpacks with the same
+    compiled program (the spec is jit-static via its pytree registration).
+    """
+
+    k: int            # padded slots per row (multiple of the packer's 64)
+    bits: int         # gap field width: 4 | 8 | 16 | 32
+    mode: str         # "f32" | "f16" | "i8" | "binary"
+    n_features: int   # column space (pad_index == n_features in binary mode)
+    index_dtype: str  # "uint16" | "uint32" — the unpacked indices dtype
+
+    @property
+    def pad_index(self):
+        return self.n_features if self.mode == "binary" else 0
+
+    @property
+    def fields_per_word(self):
+        return 32 // self.bits
+
+    @property
+    def words_per_row(self):
+        # K-1 gap fields, planar: ceil over fields-per-word
+        return -(-(self.k - 1) // self.fields_per_word)
+
+    @property
+    def np_index_dtype(self):
+        return np.uint16 if self.index_dtype == "uint16" else np.uint32
+
+    def wire_bytes_per_row(self):
+        """Bytes one packed row occupies on the wire (indices side: words +
+        first + nnz; values side per mode)."""
+        n = self.words_per_row * 4 + 4 + 4
+        if self.mode == "f32":
+            n += self.k * 4
+        elif self.mode == "f16":
+            n += self.k * 2
+        elif self.mode == "i8":
+            n += self.k + 4  # int8 codes + per-row f32 scale
+        return n
+
+
+# Empty-children pytree whose aux data IS the spec: jit treats it as part of
+# the treedef (static + hashable), so it can ride inside traced batch dicts.
+jax.tree_util.register_pytree_node(
+    WireSpec, lambda s: ((), s), lambda aux, _: aux)
+
+
+def _bits_for(max_gap):
+    """Smallest divisor-of-32 field width covering `max_gap`."""
+    for bits in _WIRE_BITS:
+        if max_gap < (1 << bits):
+            return bits
+    raise ValueError(f"gap {max_gap} does not fit 32 bits")
+
+
+def _padded_k(k, k_multiple=64):
+    return int(max(k_multiple, -(-int(k) // k_multiple) * k_multiple))
+
+
+def _ensure_sorted_f32(m):
+    import scipy.sparse as sp
+
+    m = sp.csr_matrix(m)
+    if m.dtype != np.float32:
+        m = m.astype(np.float32)
+    if not m.has_sorted_indices:
+        m = m.copy()
+        m.sort_indices()
+    return m
+
+
+def _padded_cols(m, k):
+    """[B, k] int64 column matrix + int32 nnz (clipped to k, mirroring the
+    packer's truncation) from a sorted CSR."""
+    b = m.shape[0]
+    nnz = np.minimum(np.diff(m.indptr), k).astype(np.int32)
+    pos = np.arange(k)[None, :]
+    valid = pos < nnz[:, None]
+    idx = np.zeros((b, k), np.int64)
+    flat = m.indptr[:-1, None] + pos
+    idx[valid] = m.indices[flat[valid]]
+    return idx, nnz, valid
+
+
+def plan_wire(m, k=None, k_multiple=64, mode="f32", index_dtype=np.uint16):
+    """Scan a corpus once and fix the static wire format for the whole fit.
+
+    `bits` covers the largest per-row gap anywhere in the corpus, so every
+    batch packed under the returned spec is exact. Mirrors pad_csr_batch's
+    k rounding and uint16→uint32 promotion rule so the unpacked layout is
+    the one the rest of the feed already speaks.
+    """
+    assert mode in VALUE_MODES, mode
+    m = _ensure_sorted_f32(m)
+    f = m.shape[1]
+    if k is None:
+        k = int(np.diff(m.indptr).max(initial=1))
+    kk = _padded_k(k, k_multiple)
+    binary = mode == "binary"
+    if f + (1 if binary else 0) > np.iinfo(index_dtype).max + 1:
+        index_dtype = np.uint32
+    # largest gap between consecutive in-row columns (row boundaries masked)
+    max_gap = 0
+    if m.indices.size:
+        gaps = np.diff(m.indices.astype(np.int64))
+        boundary = np.zeros(gaps.shape[0], bool)
+        starts = m.indptr[1:-1]  # position of each row's first element
+        boundary[starts[(starts > 0) & (starts <= gaps.shape[0])] - 1] = True
+        in_row = gaps[~boundary]
+        if in_row.size:
+            max_gap = int(in_row.max())
+    return WireSpec(k=kk, bits=_bits_for(max_gap), mode=mode,
+                    n_features=int(f),
+                    index_dtype=np.dtype(index_dtype).name)
+
+
+def pack_csr_wire(m, spec=None, k=None, k_multiple=64, mode="f32",
+                  index_dtype=np.uint16):
+    """Pack a CSR block into the wire format.
+
+    Returns `{"words", "first", "nnz", "values"?, "scale"?, "spec"}` — every
+    array leading-dim B so bucket padding and device placement treat a packed
+    batch like any other. Pass `spec` (from plan_wire) when packing batches
+    of a larger corpus; otherwise a per-call spec is derived.
+    """
+    m = _ensure_sorted_f32(m)
+    if spec is None:
+        spec = plan_wire(m, k=k, k_multiple=k_multiple, mode=mode,
+                         index_dtype=index_dtype)
+    b = m.shape[0]
+    kk = spec.k
+    idx, nnz, valid = _padded_cols(m, kk)
+
+    gaps = np.diff(idx, axis=1)
+    gaps[~valid[:, 1:]] = 0
+    if gaps.size and (gaps.min() < 0 or gaps.max() >= (1 << spec.bits)):
+        raise ValueError(
+            f"row gaps outside the spec's {spec.bits}-bit field "
+            f"(min {gaps.min()}, max {gaps.max()}): corpus does not match "
+            "the plan_wire spec (unsorted rows or a different corpus?)")
+
+    fpw = spec.fields_per_word
+    w = spec.words_per_row
+    planes = np.zeros((b, fpw, w), np.uint32)
+    flat = planes.reshape(b, fpw * w)
+    flat[:, : kk - 1] = gaps.astype(np.uint32)
+    words = np.zeros((b, w), np.uint32)
+    for l in range(fpw):
+        words |= planes[:, l, :] << np.uint32(l * spec.bits)
+
+    first = np.where(nnz > 0, idx[:, 0], 0).astype(np.int32)
+    out = {"words": words.view(np.int32), "first": first, "nnz": nnz,
+           "spec": spec}
+    if spec.mode != "binary":
+        vals = np.zeros((b, kk), np.float32)
+        pos = np.arange(kk)[None, :]
+        flatv = m.indptr[:-1, None] + pos
+        vals[valid] = m.data[flatv[valid]]
+        if spec.mode == "f32":
+            out["values"] = vals
+        elif spec.mode == "f16":
+            out["values"] = vals.astype(np.float16)
+        else:  # i8: per-row absmax linear quantization
+            absmax = np.abs(vals).max(axis=1)
+            scale = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+            out["values"] = np.rint(vals / scale[:, None]).astype(np.int8)
+            out["scale"] = scale
+    return out
+
+
+def wire_nbytes(wire):
+    """Total wire bytes of one packed batch (arrays only, spec excluded)."""
+    return int(sum(v.nbytes for k, v in wire.items()
+                   if k != "spec" and hasattr(v, "nbytes")))
+
+
+def wire_bytes_per_article(wire):
+    b = wire["nnz"].shape[0]
+    return wire_nbytes(wire) / max(1, b)
+
+
+# ----------------------------------------------------------------- unpack
+
+
+def _dequantize_jnp(spec, values, scale):
+    if spec.mode == "binary":
+        return None
+    if spec.mode == "f32":
+        return values
+    if spec.mode == "f16":
+        return values.astype(jnp.float32)
+    return values.astype(jnp.float32) * scale[:, None]
+
+
+def unpack_wire_jnp(words, first, nnz, spec, values=None, scale=None):
+    """Pure-jnp unpack: packed words → padded `(indices, values)`.
+
+    Trace-compatible (spec is static), bitwise-identical to
+    `unpack_wire_host` — the reference the Pallas kernel is tested against.
+    """
+    bits = spec.bits
+    if bits == 32:
+        planes = [words]
+    else:
+        mask = jnp.int32((1 << bits) - 1)
+        planes = [jax.lax.shift_right_logical(words, jnp.int32(l * bits)) & mask
+                  for l in range(spec.fields_per_word)]
+    gaps = jnp.concatenate(planes, axis=1)[:, : spec.k - 1]
+    base = first[:, None].astype(jnp.int32)
+    idx = jnp.concatenate(
+        [base, base + jnp.cumsum(gaps, axis=1, dtype=jnp.int32)], axis=1)
+    slot = jnp.arange(spec.k, dtype=jnp.int32)[None, :]
+    valid = slot < nnz[:, None]
+    indices = jnp.where(valid, idx, jnp.int32(spec.pad_index))
+    indices = indices.astype(spec.np_index_dtype)
+    return indices, _dequantize_jnp(spec, values, scale)
+
+
+def unpack_wire_host(wire):
+    """Host (numpy) unpack of a packed batch: returns the exact
+    `{"indices", "values", "k"}` dict pad_csr_batch would have produced."""
+    spec = wire["spec"]
+    words = wire["words"].view(np.uint32)
+    bits = spec.bits
+    if bits == 32:
+        planes = [words]
+    else:
+        mask = np.uint32((1 << bits) - 1)
+        planes = [(words >> np.uint32(l * bits)) & mask
+                  for l in range(spec.fields_per_word)]
+    gaps = np.concatenate(planes, axis=1)[:, : spec.k - 1].astype(np.int32)
+    base = wire["first"][:, None].astype(np.int32)
+    idx = np.concatenate(
+        [base, base + np.cumsum(gaps, axis=1, dtype=np.int32)], axis=1)
+    slot = np.arange(spec.k, dtype=np.int32)[None, :]
+    valid = slot < wire["nnz"][:, None]
+    indices = np.where(valid, idx, spec.pad_index).astype(spec.np_index_dtype)
+    if spec.mode == "binary":
+        values = None
+    elif spec.mode == "f32":
+        values = wire["values"]
+    elif spec.mode == "f16":
+        values = wire["values"].astype(np.float32)
+    else:
+        values = (wire["values"].astype(np.float32)
+                  * wire["scale"][:, None]).astype(np.float32)
+    return {"indices": indices, "values": values, "k": spec.k}
+
+
+# ----------------------------------------------------- Pallas unpack kernel
+
+
+def _on_tpu():
+    return jax.default_backend() == "tpu"
+
+
+def _lane_pad(n):
+    return int(-(-n // 128) * 128)
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "interpret"))
+def _unpack_pallas_call(words, first, nnz, spec, interpret):
+    # import-light at module level (mirrors ops/__init__'s lazy pallas
+    # policy): the experimental API loads only when the kernel path runs
+    from jax.experimental import pallas as pl
+
+    b = words.shape[0]
+    w_real = spec.words_per_row
+    w_pad = _lane_pad(w_real)
+    fpw = spec.fields_per_word
+    bits = spec.bits
+    pad_index = spec.pad_index
+    rows = 8
+    bp = int(-(-b // rows) * rows)
+    if bp != b or w_pad != w_real:
+        words = jnp.pad(words, ((0, bp - b), (0, w_pad - w_real)))
+    first2 = jnp.pad(first.reshape(-1, 1), ((0, bp - b), (0, 0)))
+    nnz2 = jnp.pad(nnz.reshape(-1, 1), ((0, bp - b), (0, 0)))
+    tri = jnp.triu(jnp.ones((w_pad, w_pad), jnp.float32))
+
+    def kernel(words_ref, first_ref, nnz_ref, tri_ref, idx_ref):
+        """One row-block of the unpack: extract each bit plane with a
+        logical shift + mask, turn it into in-plane prefix sums on the MXU
+        (gap counts are small ints — exact in f32 well past any uint16
+        column space), carry plane totals forward, and write the
+        padded/masked indices for slots 1..K-1. Slot 0 (the whole `first`
+        index) is prepended by the wrapper — keeping every lane write here
+        at a plane-aligned static offset."""
+        wds = words_ref[:]                       # [R, Wp] int32 packed words
+        fst = first_ref[:].astype(jnp.float32)   # [R, 1]
+        nz = nnz_ref[:]                          # [R, 1] int32
+        tr = tri_ref[:]                          # [Wp, Wp] upper-tri (incl diag)
+        mask = jnp.int32((1 << bits) - 1) if bits < 32 else None
+        carry = jnp.zeros_like(fst)              # sum of earlier planes
+        for l in range(fpw):
+            plane = (jax.lax.shift_right_logical(wds, jnp.int32(l * bits))
+                     & mask if mask is not None else wds)
+            planef = plane.astype(jnp.float32)   # [R, Wp]; zero in pad lanes
+            prefix = jnp.dot(planef, tr, preferred_element_type=jnp.float32)
+            idx = fst + carry + prefix           # slot l*w_real + lane + 1
+            carry = carry + jnp.sum(planef, axis=1, keepdims=True)
+            # slot per lane (lanes >= w_real are padding the wrapper drops)
+            lane = jax.lax.broadcasted_iota(jnp.int32, planef.shape, 1)
+            slot = lane + jnp.int32(l * w_real + 1)
+            out = jnp.where(slot < nz, idx,
+                            jnp.float32(pad_index)).astype(jnp.int32)
+            idx_ref[:, pl.ds(l * w_pad, w_pad)] = out
+
+    cols = pl.pallas_call(
+        kernel,
+        grid=(bp // rows,),
+        in_specs=[
+            pl.BlockSpec((rows, w_pad), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((w_pad, w_pad), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows, fpw * w_pad), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp, fpw * w_pad), jnp.int32),
+        interpret=interpret,
+    )(words, first2, nnz2, tri)
+    # drop row padding + per-plane lane padding, keep the first K-1 slots
+    planes = [cols[:b, l * w_pad: l * w_pad + w_real] for l in range(fpw)]
+    tail = jnp.concatenate(planes, axis=1)[:, : spec.k - 1]
+    col0 = jnp.where(nnz.reshape(-1, 1) > 0, first.reshape(-1, 1).astype(jnp.int32),
+                     jnp.int32(spec.pad_index))
+    return jnp.concatenate([col0, tail], axis=1)
+
+
+def unpack_wire_pallas(words, first, nnz, spec, values=None, scale=None,
+                       interpret=None):
+    """Pallas-kernel unpack (interpret mode off-TPU). Exactness bound: the
+    in-kernel prefix sums run on the MXU in f32, exact while every column
+    index < 2**24 — `unpack_wire` auto-routes wider corpora to the jnp path.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    assert spec.n_features < (1 << 24), (
+        "Pallas unpack is exact only for n_features < 2**24; use the jnp path")
+    indices = _unpack_pallas_call(words, first, nnz, spec, bool(interpret))
+    return (indices.astype(spec.np_index_dtype),
+            _dequantize_jnp(spec, values, scale))
+
+
+def unpack_wire(words, first, nnz, spec, values=None, scale=None, impl="auto"):
+    """Device-side unpack dispatch, callable inside a jitted step.
+
+    impl="auto" takes the Pallas kernel on TPU (where the feed's decode
+    belongs on-chip next to the consumer) and the jnp path elsewhere —
+    including any corpus too wide for the kernel's f32-exactness bound.
+    """
+    if impl == "auto":
+        impl = ("pallas" if _on_tpu() and spec.n_features < (1 << 24)
+                else "jnp")
+    if impl == "pallas":
+        return unpack_wire_pallas(words, first, nnz, spec, values=values,
+                                  scale=scale)
+    return unpack_wire_jnp(words, first, nnz, spec, values=values, scale=scale)

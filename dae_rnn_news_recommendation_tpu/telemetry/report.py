@@ -63,10 +63,26 @@ def load_metrics(path):
     return records
 
 
+def _canonicalize_bench_keys(extra):
+    """Accept pre-r06 bench records in place: `h2d_bandwidth_mbps` was the
+    canonical key's earlier name (the value was always MBytes/s — the "mbps"
+    was a misnomer, see VERDICT r5 item 3). New records emit only
+    `h2d_bandwidth_mbytes_per_sec`; old history (BENCH_r05.json) is read
+    through this alias so reconciliation never goes blind on a legacy file.
+    The applied alias is recorded in the extra so the report says which
+    spelling the record actually carried."""
+    legacy, canonical = "h2d_bandwidth_mbps", "h2d_bandwidth_mbytes_per_sec"
+    if isinstance(extra, dict) and legacy in extra and canonical not in extra:
+        extra[canonical] = extra[legacy]
+        extra["h2d_bandwidth_key_alias"] = f"{legacy} (legacy, pre-r06)"
+    return extra
+
+
 def load_bench(path):
     """The `extra` dict of a bench record: accepts the bench stdout JSON line
     (a {"metric", ..., "extra"} object), the evidence sidecar ({"record":
-    ...}), or a file of JSON lines containing either."""
+    ...}), or a file of JSON lines containing either. Legacy bench-history
+    key spellings are normalized via `_canonicalize_bench_keys`."""
     with open(path, encoding="utf-8") as f:
         text = f.read()
     candidates = []
@@ -84,7 +100,7 @@ def load_bench(path):
         if "record" in obj and isinstance(obj["record"], dict):
             obj = obj["record"]
         if "extra" in obj:
-            return obj["extra"]
+            return _canonicalize_bench_keys(obj["extra"])
     return None
 
 
@@ -181,9 +197,13 @@ def bench_reconciliation(extra):
     if not extra:
         return None
     out = {}
+    _canonicalize_bench_keys(extra)  # a caller may pass a raw legacy dict
     for key in ("h2d_bandwidth_mbytes_per_sec",
                 "h2d_feed_bandwidth_mbytes_per_sec",
-                "encode_stream_implied_mbytes_per_sec"):
+                "encode_stream_implied_mbytes_per_sec",
+                "h2d_bandwidth_key_alias",
+                "feed_wire_bytes_per_article",
+                "feed_padded_csr_bytes_per_article"):
         if key in extra:
             out[key] = extra[key]
     transfers = extra.get("transfer_events")

@@ -77,6 +77,8 @@ class FeedStats:
         self.epoch_s = 0.0
         self.batches = 0
         self.bytes_in = 0
+        self.rows_real = 0
+        self.rows_padded = 0
 
     def note_wait(self, dt):
         self.feed_wait_s += dt
@@ -84,6 +86,14 @@ class FeedStats:
 
     def note_bytes(self, n):
         self.bytes_in += int(n)
+
+    def note_rows(self, real, padded):
+        """Row accounting per staged batch: `real` rows carry data, `padded`
+        rows exist only to hit a compiled bucket shape (batcher tail padding
+        + bucket_pad). Together they make codec overhead and bucket waste
+        regression-tracked numbers instead of folklore."""
+        self.rows_real += int(real)
+        self.rows_padded += int(padded)
 
     def finish(self, epoch_s):
         """Record the epoch's total wall time (measured by the caller, who
@@ -102,6 +112,22 @@ class FeedStats:
         resident path is the next lever."""
         return self.feed_wait_s / self.epoch_s if self.epoch_s > 0 else 0.0
 
+    @property
+    def padded_row_fraction(self):
+        """Fraction of staged rows that were padding (tail + bucket_pad):
+        wasted wire bytes AND wasted device FLOPs, both shrinkable by batch
+        size / bucket choices."""
+        total = self.rows_real + self.rows_padded
+        return self.rows_padded / total if total > 0 else 0.0
+
+    @property
+    def wire_bytes_per_article(self):
+        """Staged bytes per REAL article — the feed's effective wire cost.
+        Padded-CSR feeds sit near `kk*6`; the compressed-wire feed
+        (data/batcher.WireSparseIngestBatcher) well below it; replayed
+        epoch-cache epochs at ~0 (nothing crossed the link)."""
+        return self.bytes_in / self.rows_real if self.rows_real > 0 else 0.0
+
     def summary(self):
         return {
             "feed_wait_s": round(self.feed_wait_s, 4),
@@ -109,6 +135,8 @@ class FeedStats:
             "feed_stall_fraction": round(self.feed_stall_fraction, 4),
             "feed_batches": self.batches,
             "feed_bytes": self.bytes_in,
+            "padded_row_fraction": round(self.padded_row_fraction, 4),
+            "wire_bytes_per_article": round(self.wire_bytes_per_article, 2),
         }
 
 
@@ -180,14 +208,104 @@ def _leading_dim(batch):
     return max(dims) if dims else None
 
 
+def _batch_nbytes(batch):
+    """Wire bytes of a host batch: numeric arrays only (static entries like
+    the WireSpec riding a compressed-wire batch never cross the link)."""
+    total = 0
+    for v in batch.values():
+        arr = np.asarray(v)
+        if arr.dtype != object:
+            total += arr.nbytes
+    return total
+
+
+class EpochCache:
+    """Device-resident epoch cache: pin staged batches during epoch 1, replay
+    them for later epochs of a STABLE corpus — post-warm epochs ship zero
+    bytes over the H2D link.
+
+    Eligibility is the caller's job (models/estimator.py `_wire_cache_active`:
+    shuffle off so the batch sequence repeats, no skip, single device, and the
+    consuming step built with donate_batch=False so replayed buffers survive
+    consumption). This class only enforces the byte budget: `offer` every
+    consumed batch with its wire nbytes during the warm epoch; the first
+    offer that would exceed `budget_bytes` flips the cache to `disabled`
+    (dropping every pinned ref so HBM frees immediately) and the fit simply
+    keeps paying H2D — over-budget is a fallback, never a failure. `seal()`
+    after a COMPLETE warm epoch makes `ready` true; `replay()` then yields
+    the pinned device batches in the original order.
+    """
+
+    def __init__(self, budget_bytes):
+        self.budget_bytes = int(budget_bytes)
+        self._staged = []
+        self._bytes = 0
+        self.ready = False
+        self.disabled = False
+        self.disabled_reason = None
+        self.hits = 0
+
+    @property
+    def nbytes(self):
+        return self._bytes
+
+    @property
+    def n_batches(self):
+        return len(self._staged)
+
+    def offer(self, staged_batch, nbytes):
+        """Pin one consumed device batch (warm epoch only; no-op once ready
+        or disabled). `nbytes` is the batch's wire footprint — the HBM the
+        pin keeps alive."""
+        if self.ready or self.disabled:
+            return
+        self._bytes += int(nbytes or 0)
+        if self._bytes > self.budget_bytes:
+            self.disable(
+                f"packed corpus exceeds the cache budget "
+                f"({self._bytes} > {self.budget_bytes} bytes)")
+            return
+        self._staged.append(staged_batch)
+
+    def seal(self):
+        """Mark the warm epoch complete: `replay()` becomes available. A
+        disabled or empty cache stays not-ready."""
+        if not self.disabled and self._staged:
+            self.ready = True
+
+    def disable(self, reason):
+        """Drop every pinned batch (freeing their device buffers with the
+        refs) and record why; the feed falls back to staging over the link."""
+        self.disabled = True
+        self.disabled_reason = str(reason)
+        self.ready = False
+        self._staged = []
+        self._bytes = 0
+
+    def replay(self):
+        """Yield the pinned device batches in warm-epoch order. The consumer
+        must NOT donate them (they are replayed again next epoch)."""
+        assert self.ready, "EpochCache.replay() before seal()"
+        for batch in self._staged:
+            self.hits += 1
+            yield batch
+
+
 class PipelinedFeed:
     """Iterate device-resident batches, transfers running `depth` ahead.
 
     :param batches: iterator of host batch dicts (e.g. `batcher.epoch(...)`)
     :param depth: how many batches may be staged on device ahead of the
-        consumer (2 = double buffering, 3 = triple). The worker blocks once
-        `depth` transfers are in flight, bounding device memory at
-        ~depth * batch_bytes beyond the consumer's working set.
+        consumer (2 = double buffering, 3 = triple, N = N staging slots).
+        The worker blocks once `depth` transfers are in flight, bounding
+        device memory at ~depth * batch_bytes beyond the consumer's working
+        set. Each staged batch is tagged with its slot (`seq % depth`) and
+        the `feed/pad` / `feed/h2d` telemetry spans carry the slot id, so a
+        trace shows which staging slot each transfer occupied and
+        `slot_summary()` reports per-slot H2D seconds — an unbalanced slot
+        means the buffer rotation, not the link, is the ceiling.
+    :param slots: alias for `depth` (the staging-slot framing); when given it
+        wins over `depth`.
     :param place: host batch -> device batch. Defaults to `jax.device_put`
         (single device); the mesh path passes `parallel.feed.put_sharded_batch`
         so each staged batch lands row-sharded over the data axis.
@@ -218,42 +336,66 @@ class PipelinedFeed:
     """
 
     def __init__(self, batches, depth=2, place=None, extremes=None,
-                 buckets=None, stats=None, retry=None):
+                 buckets=None, stats=None, retry=None, slots=None):
         self._batches = batches
-        self.depth = max(1, int(depth))
+        self.depth = max(1, int(slots if slots is not None else depth))
         self._place = place or jax.device_put
         self._extremes = dict(extremes) if extremes else None
         self._buckets = tuple(buckets) if buckets else None
         self.stats = stats
         self.retry = retry
+        self.slot_h2d_s = [0.0] * self.depth
+        self.slot_batches = [0] * self.depth
         self._thread = None
         self._queue = None
         self._stop_evt = None
 
-    def _stage(self, host_batch):
-        """Host batch -> staged device batch (runs on the worker thread)."""
+    def _stage(self, host_batch, slot=0):
+        """Host batch -> staged device batch (runs on the worker thread).
+        `slot` is the staging slot (seq % depth) this batch occupies; it tags
+        the telemetry spans and the per-slot accounting."""
         _faults.fire("feed.h2d")
         if self._extremes:
             host_batch = {**host_batch, **self._extremes}
-        with telemetry.span("feed/pad", fence=False):  # host-only work
+        with telemetry.span("feed/pad", fence=False,
+                            args={"slot": slot}):  # host-only work
+            rows_in = None
+            if self.stats is not None:
+                rv = host_batch.get("row_valid")
+                rows_in = (int(np.asarray(rv).sum()) if rv is not None
+                           else int(_leading_dim(host_batch) or 0))
             if self._buckets:
                 host_batch = bucket_pad(host_batch, self._buckets)
             nbytes = None
             if self.stats is not None or telemetry.enabled():
-                nbytes = sum(np.asarray(v).nbytes
-                             for v in host_batch.values())
+                nbytes = _batch_nbytes(host_batch)
             if self.stats is not None:
                 self.stats.note_bytes(nbytes)
+                rows_out = int(_leading_dim(host_batch) or 0)
+                self.stats.note_rows(rows_in, max(rows_out - rows_in, 0))
         # device_put dispatches the H2D copy asynchronously; by the time the
         # consumer's step consumes this batch, the bytes are already (or still
         # becoming) resident — that overlap is the whole point. The span fences
         # on the staged batch, so when tracing is on it measures the actual
         # copy (and feeds the transfer/h2d counter); when tracing is off the
         # dispatch stays fully async.
-        with telemetry.span("feed/h2d") as sp:
+        with telemetry.span("feed/h2d", args={"slot": slot}) as sp:
             staged = sp.fence_on(self._place(host_batch))
         telemetry.record_transfer("h2d", sp.duration_s, nbytes)
+        if sp.duration_s is not None:
+            self.slot_h2d_s[slot] += sp.duration_s
+        self.slot_batches[slot] += 1
         return staged
+
+    def slot_summary(self):
+        """Per-staging-slot accounting: how many batches each of the `depth`
+        slots staged and the fenced H2D seconds it accumulated (0.0 when
+        tracing is off — unfenced dispatch has no honest duration)."""
+        return {
+            "slots": self.depth,
+            "batches": list(self.slot_batches),
+            "h2d_s": [round(s, 4) for s in self.slot_h2d_s],
+        }
 
     def __iter__(self):
         q = queue.Queue(maxsize=self.depth)
@@ -271,16 +413,16 @@ class PipelinedFeed:
                     continue
             return False
 
-        def stage(hb):
+        def stage(hb, slot):
             if self.retry is not None:
-                return self.retry.run(self._stage, hb, site="feed.h2d")
-            return self._stage(hb)
+                return self.retry.run(self._stage, hb, slot, site="feed.h2d")
+            return self._stage(hb, slot)
 
         def worker():
             try:
                 for n, hb in enumerate(self._batches):
                     _faults.fire("feed.worker", batch=n)
-                    if not put(stage(hb)):
+                    if not put(stage(hb, n % self.depth)):
                         return
             # jaxcheck: disable=R9 (surfaced on the consumer: __iter__ re-raises err[0] after the end sentinel wakes it)
             except BaseException as e:

@@ -105,14 +105,46 @@ def mine_triplets(strategy, labels, encode, row_valid=None,
     raise ValueError(f"unknown mining strategy: {strategy!r}")
 
 
+def _unpack_wire_keys(batch):
+    """Expand compressed-wire feed keys (`{base}_wire_*`, emitted by
+    data/batcher.WireSparseIngestBatcher) back into the padded (indices,
+    values) pairs the sparse-ingest path consumes. Runs INSIDE the jitted
+    step: the bit-unpack + delta prefix-sum is device work (ops/wire.
+    unpack_wire — Pallas on TPU, jnp elsewhere), so the host only ever ships
+    the packed words. The `{base}_wire_spec` entry is a static empty-pytree
+    WireSpec, so it never hits the wire and keys the compile cache."""
+    from ..ops import wire as _wire
+
+    out = None
+    for base, (ik, vk) in _SPARSE_FEED_KEYS.items():
+        wk = f"{base}_wire_words"
+        if base in batch or ik in batch or wk not in batch:
+            continue
+        if out is None:
+            out = dict(batch)
+        idx, vals = _wire.unpack_wire(
+            out.pop(wk),
+            out.pop(f"{base}_wire_first"),
+            out.pop(f"{base}_wire_nnz"),
+            out.pop(f"{base}_wire_spec"),
+            values=out.pop(f"{base}_wire_values", None),
+            scale=out.pop(f"{base}_wire_scale", None),
+        )
+        out[ik], out[vk] = idx, vals
+    return out if out is not None else batch
+
+
 def materialize_x(batch, config):
     """Ensure the dense inputs exist: sparse-ingest feeds ship (indices, values)
     [B, K] pairs and densify ON DEVICE here (inside the jitted step), so the
     feed crosses host->device at ~nnz cost while the math stays identical.
-    Covers both the single-input ('x') and precomputed-triplet
-    ('org'/'pos'/'neg') batch shapes."""
+    Compressed-wire feeds first expand their packed words into those same
+    pairs (`_unpack_wire_keys`), then share the densify. Covers both the
+    single-input ('x') and precomputed-triplet ('org'/'pos'/'neg') batch
+    shapes."""
     from ..ops.sparse_ingest import densify_on_device
 
+    batch = _unpack_wire_keys(batch)
     out = None
     for dense_key, (ik, vk) in _SPARSE_FEED_KEYS.items():
         if dense_key not in batch and ik in batch:

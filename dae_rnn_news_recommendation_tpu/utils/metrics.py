@@ -56,11 +56,16 @@ class MetricsWriter:
         """Per-epoch feed/compute split from a pipelined fit
         (train/pipeline.FeedStats): feed_wait_s, step_time_s and
         feed_stall_fraction land in both sinks under feed/ so the
-        stream->resident gap is a tracked trajectory, not a one-off print."""
+        stream->resident gap is a tracked trajectory, not a one-off print.
+        padded_row_fraction and wire_bytes_per_article track bucket-padding
+        waste and the feed's effective wire cost (the compressed-wire codec's
+        win, and an epoch-cache replay's ~0) the same way."""
         self.scalars({
             "feed/feed_wait_s": stats.feed_wait_s,
             "feed/step_time_s": stats.step_time_s,
             "feed/feed_stall_fraction": stats.feed_stall_fraction,
+            "feed/padded_row_fraction": stats.padded_row_fraction,
+            "feed/wire_bytes_per_article": stats.wire_bytes_per_article,
         }, step)
 
     def histogram(self, tag, values, step):

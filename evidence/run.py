@@ -605,13 +605,13 @@ def main(argv=None):
     # committed bench sidecar: the figure must come from a real hardware
     # bench round, not be recomputed ad hoc here.
     if platform == "tpu":
-        mined_mfu = None
+        bench_extra = {}
         try:
             with open(os.path.join(HERE, "bench_tpu.json")) as f:
-                mined_mfu = (json.load(f)["record"]["extra"]
-                             .get("train_mined_big_mfu"))
+                bench_extra = json.load(f)["record"]["extra"] or {}
         except (OSError, ValueError, KeyError):
             pass
+        mined_mfu = bench_extra.get("train_mined_big_mfu")
         check("train_mined_big_mfu_floor",
               mined_mfu is not None and float(mined_mfu) >= 0.09,
               (f"bench sidecar train_mined_big_mfu {mined_mfu} >= 0.09 "
@@ -620,6 +620,55 @@ def main(argv=None):
               else ("evidence/bench_tpu.json has no train_mined_big_mfu — "
                     "the sidecar predates the mined-big corner; rerun "
                     "bench.py on TPU to capture it"))
+        # ISSUE 7 acceptance, all from the committed bench sidecar (a real
+        # hardware round, not an ad-hoc recompute):
+        #   * the compressed wire format beats padded-CSR bytes/article;
+        #   * the overlapped packed feed keeps fit_pipelined within 2x of the
+        #     raw train step with feed_stall_fraction <= 0.05;
+        #   * post-warm epochs of the device-resident epoch cache ship ~0
+        #     bytes over the link.
+        # best lossless-for-this-corpus mode (the bench pool is 0/1, so
+        # binary qualifies); plain f32 merely breaks even at the pool's
+        # uniform density (16-bit gaps ≈ uint16 indices) by design
+        wire_b = bench_extra.get("feed_wire_bytes_per_article_best",
+                                 bench_extra.get("feed_wire_bytes_per_article"))
+        wire_mode = bench_extra.get("feed_wire_best_mode", "f32")
+        csr_b = bench_extra.get("feed_padded_csr_bytes_per_article")
+        check("feed_wire_compresses_the_feed",
+              wire_b is not None and csr_b is not None
+              and float(wire_b) < float(csr_b),
+              (f"bench sidecar wire ({wire_mode}) {wire_b} B/article < "
+               f"padded-CSR {csr_b} (delta/bit-packed indices + value "
+               f"elision/quantization, ops/wire.py)")
+              if wire_b is not None else
+              ("evidence/bench_tpu.json has no feed_wire_bytes_per_article — "
+               "the sidecar predates the wire-format corner; rerun bench.py "
+               "on TPU to capture it"))
+        pipe_aps = bench_extra.get("fit_pipelined_articles_per_sec")
+        tr_aps = bench_extra.get("train_articles_per_sec")
+        stall = bench_extra.get("feed_stall_fraction")
+        check("fit_pipelined_within_2x_of_train",
+              None not in (pipe_aps, tr_aps, stall)
+              and float(pipe_aps) * 2 >= float(tr_aps)
+              and float(stall) <= 0.05,
+              (f"bench sidecar fit_pipelined {pipe_aps} aps within 2x of the "
+               f"raw train step {tr_aps} aps with feed_stall_fraction "
+               f"{stall} <= 0.05") if None not in (pipe_aps, tr_aps, stall)
+              else ("evidence/bench_tpu.json lacks fit_pipelined/train/stall "
+                    "figures; rerun bench.py on TPU to capture them"))
+        cache_rec = bench_extra.get("wire_cache")
+        cache_ok = (isinstance(cache_rec, dict)
+                    and cache_rec.get("post_warm_feed_bytes") == 0
+                    and cache_rec.get("n_batches", 0) > 0)
+        check("wire_cache_zero_h2d_post_warm", cache_ok,
+              (f"bench sidecar wire_cache: {cache_rec.get('n_batches')} "
+               f"batches pinned ({cache_rec.get('pinned_mbytes')} MB), "
+               f"post-warm epochs staged {cache_rec.get('post_warm_feed_bytes')}"
+               " bytes over the link (warm epoch: "
+               f"{cache_rec.get('warm_epoch_feed_bytes')})")
+              if isinstance(cache_rec, dict) and "n_batches" in cache_rec else
+              (f"evidence/bench_tpu.json wire_cache record unusable: "
+               f"{cache_rec!r}; rerun bench.py on TPU to capture it"))
     n_bitwise = sum(1 for pl in chaos_out["plans"] if pl["bitwise"])
     n_recorded = sum(1 for pl in chaos_out["plans"] if pl["manifest_recorded"])
     check("chaos_soak_crash_exact_resume",

@@ -1,0 +1,52 @@
+"""Planted R10: host-side per-batch decompression in feed/training loops —
+the decode sits on the critical path between batches and serializes the feed
+on host CPU. The wire-format design (ops/wire.py) packs once at ingest and
+expands on DEVICE inside the jitted step. Clean twins: decode hoisted out of
+the loop, device-side unpack, and a reasoned codec-accounting disable."""
+
+import pickle
+import zlib
+
+import numpy as np
+
+
+def decompressing_feed_loop(compressed_batches, step):
+    for blob in compressed_batches:
+        batch = pickle.loads(zlib.decompress(blob))  # planted: R10
+        step(batch)
+
+
+def unpackbits_in_train_loop(packed_batches, step):
+    for words in packed_batches:
+        bits = np.unpackbits(words, axis=-1)  # planted: R10
+        step(bits)
+
+
+def host_unpack_generator(wires):
+    from dae_rnn_news_recommendation_tpu.ops import wire
+
+    # a generator body re-runs per yielded batch: per-batch host decode
+    for w in wires:
+        yield wire.unpack_wire_host(w)  # planted: R10
+
+
+# ---------------------------------------------------------------- clean twins
+
+def hoisted_decode(blob, step):
+    batches = pickle.loads(zlib.decompress(blob))  # once, outside the loop
+    for batch in batches:
+        step(batch)
+
+
+def device_side_unpack_loop(packed_batches, step):
+    # the sanctioned shape: ship packed words, expand inside the jitted step
+    for packed in packed_batches:
+        step(packed)  # step calls ops/wire.unpack_wire under jit
+
+
+def codec_accounting_sweep(pool, modes, pack_csr_wire, wire_nbytes):
+    sizes = {}
+    for mode in modes:
+        # jaxcheck: disable=R10 (codec accounting, not a feed: each pack is measured for bytes/article, never shipped)
+        sizes[mode] = wire_nbytes(pack_csr_wire(pool, mode=mode))
+    return sizes

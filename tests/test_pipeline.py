@@ -306,3 +306,85 @@ def test_feed_selection_rules(workdir, monkeypatch):
 def test_feed_param_validated():
     with pytest.raises(AssertionError):
         DenoisingAutoencoder(feed="warp-drive")
+
+
+# ------------------------------------------------------------------ wire feed
+
+def test_feed_stats_row_and_wire_byte_accounting():
+    from dae_rnn_news_recommendation_tpu.train.pipeline import FeedStats
+
+    s = FeedStats()
+    s.note_rows(8, 2)
+    s.note_rows(6, 0)
+    s.note_bytes(700)
+    s.finish(1.0)
+    assert s.padded_row_fraction == pytest.approx(2 / 16)
+    assert s.wire_bytes_per_article == pytest.approx(700 / 14)
+    summ = s.summary()
+    assert summ["padded_row_fraction"] == pytest.approx(0.125)
+    assert summ["wire_bytes_per_article"] == pytest.approx(50.0)
+    s.reset()
+    assert s.padded_row_fraction == 0.0 and s.wire_bytes_per_article == 0.0
+
+
+def test_pipelined_fit_logs_wire_byte_stats(workdir):
+    m = _fit(workdir, feed="pipelined", sparse=True)
+    for s in m.feed_stats_epochs:
+        assert s["wire_bytes_per_article"] > 0  # bytes per REAL article
+        assert 0.0 <= s["padded_row_fraction"] < 1.0  # 37 rows pad to 40
+
+
+def test_pipelined_feed_slot_accounting():
+    stats = FeedStats()
+    batches = [{"x": np.full((2, 2), i, np.float32)} for i in range(5)]
+    feed = PipelinedFeed(iter(batches), slots=2, stats=stats)
+    assert feed.depth == 2  # `slots` is the staging-slot alias for depth
+    assert len(list(feed)) == 5
+    ss = feed.slot_summary()
+    assert ss["slots"] == 2
+    assert ss["batches"] == [3, 2]  # round-robin: seq % depth
+    assert len(ss["h2d_s"]) == 2 and all(t >= 0.0 for t in ss["h2d_s"])
+    # slots wins over depth when both are given
+    assert PipelinedFeed(iter([]), depth=3, slots=4).depth == 4
+
+
+def test_epoch_cache_offer_seal_replay():
+    from dae_rnn_news_recommendation_tpu.train.pipeline import EpochCache
+
+    cache = EpochCache(1000)
+    batches = [{"x": np.full((2, 2), i, np.float32)} for i in range(3)]
+    for b in batches:
+        cache.offer(b, 100)
+    assert not cache.ready  # replay only after a COMPLETE warm epoch
+    cache.seal()
+    assert cache.ready and cache.n_batches == 3 and cache.nbytes == 300
+    first = list(cache.replay())
+    assert [b["x"][0, 0] for b in first] == [0.0, 1.0, 2.0]  # warm order
+    assert first[0] is batches[0]  # the PINNED refs, not copies
+    assert cache.hits == 3
+    assert len(list(cache.replay())) == 3 and cache.hits == 6
+    cache.offer({"x": np.ones(1)}, 50)  # post-seal offers are no-ops
+    assert cache.n_batches == 3 and cache.nbytes == 300
+
+
+def test_epoch_cache_over_budget_disables_and_frees():
+    from dae_rnn_news_recommendation_tpu.train.pipeline import EpochCache
+
+    cache = EpochCache(250)
+    cache.offer({"x": 1}, 100)
+    cache.offer({"x": 2}, 100)
+    cache.offer({"x": 3}, 100)  # 300 > 250: flips to disabled
+    assert cache.disabled and "budget" in cache.disabled_reason
+    assert cache.n_batches == 0 and cache.nbytes == 0  # refs dropped at once
+    cache.seal()
+    assert not cache.ready  # a disabled cache never replays
+    with pytest.raises(AssertionError):
+        next(cache.replay())
+
+
+def test_epoch_cache_empty_seal_stays_not_ready():
+    from dae_rnn_news_recommendation_tpu.train.pipeline import EpochCache
+
+    cache = EpochCache(10)
+    cache.seal()
+    assert not cache.ready

@@ -237,3 +237,74 @@ def test_ragged_divisor_adaptation_fuzz():
             np.add.at(dense, (np.arange(b)[:, None], idx), vals)
             np.testing.assert_allclose(np.asarray(got), dense @ wd,
                                        rtol=2e-5, atol=1e-5)
+
+
+# ------------------------------------------------- index-dtype edges (pack)
+
+def _one_row(f, cols):
+    cols = np.asarray(cols, np.int64)
+    return sp.csr_matrix((np.ones(cols.size, np.float32),
+                          (np.zeros(cols.size, np.int64), cols)), shape=(1, f))
+
+
+def test_pad_csr_uint16_boundaries():
+    """The promotion rule, pinned at its exact boundary: non-binary needs the
+    max COLUMN (F-1) to fit uint16, binary additionally needs pad_index = F
+    itself to fit — so F=65536 promotes only in binary mode."""
+    for f, binary, want in [
+        (65535, False, np.uint16),
+        (65535, True, np.uint16),   # pad_index 65535 == uint16 max: fits
+        (65536, False, np.uint16),  # max column 65535: still fits
+        (65536, True, np.uint32),   # pad_index 65536: first over the edge
+        (65537, False, np.uint32),
+    ]:
+        m = _one_row(f, [3, f - 1])
+        p = SI.pad_csr_batch(m, binary=binary)
+        assert p["indices"].dtype == want, (f, binary)
+        # the extreme column survives the pack at full precision
+        assert int(p["indices"][0, 1]) == f - 1
+        if binary:
+            assert int(p["indices"][0, 2]) == f  # pad slots point at F
+        else:
+            assert int(p["indices"][0, 2]) == 0
+
+
+def test_pad_csr_empty_rows_and_empty_matrix():
+    m = sp.csr_matrix(np.array([[0, 0, 5, 0], [0, 0, 0, 0], [1, 0, 0, 2]],
+                               np.float32))
+    p = SI.pad_csr_batch(m, k_multiple=4)
+    np.testing.assert_array_equal(p["indices"][1], 0)  # all-pad row
+    np.testing.assert_array_equal(p["values"][1], 0.0)
+    pb = SI.pad_csr_batch((m > 0).astype(np.float32), k_multiple=4,
+                          binary=True)
+    np.testing.assert_array_equal(pb["indices"][1], 4)  # pad_index = F
+    empty = sp.csr_matrix((6, 100), dtype=np.float32)
+    pe = SI.pad_csr_batch(empty)
+    assert pe["k"] == 64  # nnz.max(initial=1) rounded to k_multiple
+    np.testing.assert_array_equal(pe["indices"], 0)
+    np.testing.assert_array_equal(pe["values"], 0.0)
+
+
+@pytest.mark.parametrize("binary", [False, True])
+@pytest.mark.parametrize("f", [400, 70000])
+def test_pad_csr_native_and_numpy_paths_agree(csr, monkeypatch, binary, f):
+    """The C fast path and the numpy fallback are the same layout bit for
+    bit — uint16 and promoted-uint32, values and binary alike."""
+    from dae_rnn_news_recommendation_tpu import native
+
+    m = sp.csr_matrix((csr.data, csr.indices, csr.indptr),
+                      shape=(csr.shape[0], f))
+    if binary:
+        m = m.copy()
+        m.data[:] = 1.0
+    fast = SI.pad_csr_batch(m, binary=binary)
+    monkeypatch.setattr(native, "load", lambda: None)  # force the fallback
+    slow = SI.pad_csr_batch(m, binary=binary)
+    assert fast["k"] == slow["k"]
+    assert fast["indices"].dtype == slow["indices"].dtype
+    np.testing.assert_array_equal(fast["indices"], slow["indices"])
+    if binary:
+        assert fast["values"] is None and slow["values"] is None
+    else:
+        np.testing.assert_array_equal(fast["values"].view(np.uint32),
+                                      slow["values"].view(np.uint32))

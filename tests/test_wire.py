@@ -1,0 +1,289 @@
+"""Compressed CSR wire format (ops/wire.py) + the packed-wire fit path.
+
+The codec's whole claim is *bitwise* fidelity: `unpack_wire_host(pack(m))`
+must reproduce `pad_csr_batch(m)` exactly (f32 / binary modes), the jnp and
+Pallas-interpret unpacks must match the host unpack exactly, and therefore a
+packed-wire pipelined fit must land on the SAME parameter digest as the
+padded-CSR pipelined fit — compression is a wire change, never a math change.
+The device-resident epoch cache rides the same contract: replayed epochs ship
+zero bytes and still hit the identical digest.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+
+from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+from dae_rnn_news_recommendation_tpu.ops import wire
+from dae_rnn_news_recommendation_tpu.ops.sparse_ingest import pad_csr_batch
+from dae_rnn_news_recommendation_tpu.reliability.chaos import params_digest
+
+
+@pytest.fixture
+def csr():
+    """33 x 400, ~5% dense, float32 — includes an all-zero row (row 7)."""
+    m = sp.random(33, 400, density=0.05, format="csr", random_state=0,
+                  dtype=np.float32)
+    lil = m.tolil()
+    lil[7, :] = 0
+    return lil.tocsr()
+
+
+@pytest.fixture
+def bin_csr(csr):
+    b = csr.copy()
+    b.data[:] = 1.0
+    return b
+
+
+# ------------------------------------------------------------- round trip
+
+def test_roundtrip_bitwise_f32(csr):
+    w = wire.pack_csr_wire(csr, mode="f32")
+    out = wire.unpack_wire_host(w)
+    ref = pad_csr_batch(csr)
+    assert out["k"] == ref["k"]
+    assert out["indices"].dtype == ref["indices"].dtype == np.uint16
+    np.testing.assert_array_equal(out["indices"], ref["indices"])
+    np.testing.assert_array_equal(  # bitwise, not allclose
+        out["values"].view(np.uint32), ref["values"].view(np.uint32))
+
+
+def test_roundtrip_bitwise_binary(bin_csr):
+    w = wire.pack_csr_wire(bin_csr, mode="binary")
+    assert "values" not in w  # binary elides the values plane entirely
+    out = wire.unpack_wire_host(w)
+    ref = pad_csr_batch(bin_csr, binary=True)
+    assert out["values"] is None and ref["values"] is None
+    assert w["spec"].pad_index == bin_csr.shape[1]
+    np.testing.assert_array_equal(out["indices"], ref["indices"])
+
+
+def test_roundtrip_f16_exact_on_01_data(bin_csr):
+    # 0/1 values are exactly representable in f16: lossless despite the cast
+    w = wire.pack_csr_wire(bin_csr, mode="f16")
+    assert w["values"].dtype == np.float16
+    out = wire.unpack_wire_host(w)
+    ref = pad_csr_batch(bin_csr)
+    np.testing.assert_array_equal(out["values"], ref["values"])
+    np.testing.assert_array_equal(out["indices"], ref["indices"])
+
+
+def test_roundtrip_i8_quantization_bound(csr):
+    w = wire.pack_csr_wire(csr, mode="i8")
+    assert w["values"].dtype == np.int8 and w["scale"].dtype == np.float32
+    out = wire.unpack_wire_host(w)
+    ref = pad_csr_batch(csr)
+    np.testing.assert_array_equal(out["indices"], ref["indices"])
+    # per-row absmax/127 linear quantization: error <= scale/2 per entry
+    err = np.abs(out["values"] - ref["values"])
+    bound = w["scale"][:, None] / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_roundtrip_empty_matrix():
+    m = sp.csr_matrix((5, 300), dtype=np.float32)
+    for mode, binary in (("f32", False), ("binary", True)):
+        out = wire.unpack_wire_host(wire.pack_csr_wire(m, mode=mode))
+        ref = pad_csr_batch(m, binary=binary)
+        np.testing.assert_array_equal(out["indices"], ref["indices"])
+        assert out["k"] == ref["k"] == 64  # k_multiple floor
+
+
+# ----------------------------------------------------------- spec contract
+
+def test_plan_wire_mirrors_pad_csr_promotion():
+    row = sp.csr_matrix((np.ones(2, np.float32), ([0, 0], [0, 65534])),
+                        shape=(1, 65535))
+    assert wire.plan_wire(row).index_dtype == "uint16"
+    assert wire.plan_wire(row, mode="binary").index_dtype == "uint16"
+    wide = sp.csr_matrix((np.ones(2, np.float32), ([0, 0], [0, 65535])),
+                         shape=(1, 65536))
+    # non-binary: max column 65535 still fits uint16; binary pad_index = F
+    # (65536) does not — exactly pad_csr_batch's promotion boundary
+    assert wire.plan_wire(wide).index_dtype == "uint16"
+    assert wire.plan_wire(wide, mode="binary").index_dtype == "uint32"
+    wider = sp.csr_matrix((np.ones(1, np.float32), ([0], [65536])),
+                          shape=(1, 65537))
+    assert wire.plan_wire(wider).index_dtype == "uint32"
+
+
+def test_wide_corpus_roundtrip_uint32(bin_csr):
+    m = sp.csr_matrix((bin_csr.data, bin_csr.indices, bin_csr.indptr),
+                      shape=(bin_csr.shape[0], 70000))
+    w = wire.pack_csr_wire(m, mode="binary")
+    out = wire.unpack_wire_host(w)
+    ref = pad_csr_batch(m, binary=True)
+    assert out["indices"].dtype == ref["indices"].dtype == np.uint32
+    np.testing.assert_array_equal(out["indices"], ref["indices"])
+
+
+def test_pack_rejects_corpus_outside_spec(csr):
+    tight = sp.csr_matrix(np.tril(np.ones((4, 8), np.float32)))  # gaps of 1
+    spec = wire.plan_wire(tight)
+    assert spec.bits == 4
+    with pytest.raises(ValueError, match="does not match"):
+        wire.pack_csr_wire(csr, spec=spec)  # 400-column gaps need 16 bits
+
+
+def test_shared_spec_packs_every_batch_of_a_corpus(csr):
+    spec = wire.plan_wire(csr)
+    ref = pad_csr_batch(csr, k=spec.k)
+    for lo, hi in ((0, 10), (10, 25), (25, 33)):
+        out = wire.unpack_wire_host(wire.pack_csr_wire(csr[lo:hi], spec=spec))
+        np.testing.assert_array_equal(out["indices"], ref["indices"][lo:hi])
+        np.testing.assert_array_equal(out["values"], ref["values"][lo:hi])
+
+
+def test_wirespec_is_jit_static_pytree(csr):
+    w = wire.pack_csr_wire(csr)
+    leaves, treedef = jax.tree_util.tree_flatten(w)
+    assert not any(isinstance(leaf, wire.WireSpec) for leaf in leaves)
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert rebuilt["spec"] == w["spec"]
+    # same spec -> same treedef: one compiled program per corpus
+    w2 = wire.pack_csr_wire(csr[:8], spec=w["spec"])
+    assert jax.tree_util.tree_structure(w) == jax.tree_util.tree_structure(w2)
+
+
+def test_wire_compresses_clustered_and_binary_corpora():
+    """The byte claim the bench records: binary wire beats binary padded-CSR
+    (kk*2), and an index-clustered corpus (gap bits 4) beats full padded-CSR
+    (kk*6) even shipping lossless f32 values."""
+    rng = np.random.default_rng(3)
+    rows, cols = [], []
+    for i in range(64):
+        start = rng.integers(0, 3000)
+        cols.extend(start + np.cumsum(rng.integers(1, 15, size=40)))
+        rows.extend([i] * 40)
+    m = sp.csr_matrix((np.ones(len(cols), np.float32), (rows, cols)),
+                      shape=(64, 4000))
+    kk = pad_csr_batch(m)["k"]
+    wb = wire.pack_csr_wire(m, mode="binary")
+    assert wire.plan_wire(m).bits <= 8
+    assert wire.wire_bytes_per_article(wb) < kk * 2
+    wf = wire.pack_csr_wire(m, mode="f32")
+    assert wire.wire_bytes_per_article(wf) < kk * 6
+
+
+# --------------------------------------------------------- device unpacks
+
+@pytest.mark.parametrize("mode", ["f32", "f16", "i8", "binary"])
+def test_jnp_unpack_matches_host_bitwise(csr, bin_csr, mode):
+    m = bin_csr if mode in ("f16", "binary") else csr
+    w = wire.pack_csr_wire(m, mode=mode)
+    ref = wire.unpack_wire_host(w)
+    idx, vals = wire.unpack_wire_jnp(
+        w["words"], w["first"], w["nnz"], w["spec"],
+        values=w.get("values"), scale=w.get("scale"))
+    np.testing.assert_array_equal(np.asarray(idx), ref["indices"])
+    if mode == "binary":
+        assert vals is None
+    else:
+        np.testing.assert_array_equal(
+            np.asarray(vals).view(np.uint32), ref["values"].view(np.uint32))
+
+
+@pytest.mark.parametrize("mode", ["f32", "binary"])
+def test_pallas_interpret_unpack_matches_host(csr, bin_csr, mode):
+    m = bin_csr if mode == "binary" else csr
+    w = wire.pack_csr_wire(m, mode=mode)
+    ref = wire.unpack_wire_host(w)
+    idx, _ = wire.unpack_wire_pallas(
+        w["words"], w["first"], w["nnz"], w["spec"],
+        values=w.get("values"), interpret=True)
+    assert np.asarray(idx).dtype == ref["indices"].dtype
+    np.testing.assert_array_equal(np.asarray(idx), ref["indices"])
+
+
+def test_unpack_dispatch_routes_off_tpu_to_jnp(csr):
+    w = wire.pack_csr_wire(csr)
+    idx, vals = wire.unpack_wire(w["words"], w["first"], w["nnz"], w["spec"],
+                                 values=w["values"], impl="auto")
+    ref = wire.unpack_wire_host(w)
+    np.testing.assert_array_equal(np.asarray(idx), ref["indices"])
+    np.testing.assert_array_equal(np.asarray(vals), ref["values"])
+
+
+# ------------------------------------------------------- packed-wire fits
+
+def _sparse_corpus(n=37, f=24):
+    rng = np.random.default_rng(0)
+    x = sp.csr_matrix((rng.uniform(size=(n, f)) < 0.25).astype(np.float32))
+    labels = rng.integers(0, 4, n).astype(np.int32)
+    return x, labels
+
+
+def _fit(workdir, tag, **kw):
+    x, labels = _sparse_corpus()
+    model = DenoisingAutoencoder(
+        model_name=tag, main_dir=tag,
+        n_components=6, num_epochs=3, seed=7, batch_size=10,
+        corr_type="masking", corr_frac=0.3, loss_func="mean_squared",
+        opt="ada_grad", learning_rate=0.1, verbose=False, verbose_step=10,
+        use_tensorboard=False, feed="pipelined",
+        results_root=str(workdir / "results"), **{"shuffle": False, **kw})
+    model.fit(x, train_set_label=labels)
+    return model
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def test_wire_fit_matches_padded_csr_fit_bitwise(workdir):
+    """The acceptance criterion: the packed-wire pipelined fit reproduces the
+    plain pipelined fit digest-for-digest on CPU — same batches, same PRNG
+    chain, indices/values recovered bitwise inside the jitted step."""
+    m_csr = _fit(workdir, "w_csr", wire_feed=None)
+    m_wire = _fit(workdir, "w_wire", wire_feed="f32")
+    assert params_digest(m_csr.params) == params_digest(m_wire.params)
+    np.testing.assert_array_equal(m_csr.train_cost_batch[0],
+                                  m_wire.train_cost_batch[0])
+    # the feed accounting knows it shipped a compressed wire
+    s = m_wire.feed_stats_epochs[0]
+    assert s["wire_bytes_per_article"] > 0
+    assert 0.0 <= s["padded_row_fraction"] < 1.0
+
+
+def test_wire_cache_replays_bitwise_with_zero_h2d(workdir):
+    """Epoch cache: warm epoch pays the wire once, epochs 2..N replay pinned
+    device batches — feed_bytes 0 — and the digest still matches the
+    uncached packed-wire fit."""
+    m_plain = _fit(workdir, "c_plain", wire_feed="f32")
+    m_cached = _fit(workdir, "c_cached", wire_feed="f32",
+                    wire_cache_budget_bytes=1 << 30)
+    assert params_digest(m_plain.params) == params_digest(m_cached.params)
+    cache = m_cached._wire_cache
+    assert cache is not None and cache.ready and not cache.disabled
+    assert cache.n_batches == 4  # ceil(37 / 10)
+    assert cache.hits == 8       # replayed twice (epochs 2 and 3)
+    warm, *replayed = m_cached.feed_stats_epochs
+    assert warm["feed_bytes"] > 0
+    for s in replayed:
+        assert s["feed_bytes"] == 0            # nothing crossed the link
+        assert s["feed_batches"] == 4          # but every batch was consumed
+
+
+def test_wire_cache_over_budget_falls_back(workdir):
+    """A corpus that outgrows the budget disables the cache mid-warm and the
+    fit keeps paying H2D — fallback, not failure; math unchanged."""
+    m_plain = _fit(workdir, "b_plain", wire_feed="f32")
+    m_tiny = _fit(workdir, "b_tiny", wire_feed="f32",
+                  wire_cache_budget_bytes=1)
+    assert params_digest(m_plain.params) == params_digest(m_tiny.params)
+    cache = m_tiny._wire_cache
+    assert cache.disabled and not cache.ready
+    assert "budget" in cache.disabled_reason
+    for s in m_tiny.feed_stats_epochs:
+        assert s["feed_bytes"] > 0  # every epoch shipped the wire
+
+
+def test_wire_cache_requires_repeating_batch_order(workdir):
+    m = _fit(workdir, "shuf", wire_feed="f32",
+             wire_cache_budget_bytes=1 << 30, shuffle=True)
+    assert m._wire_cache is None  # shuffle on: epoch 2 needs a new order
