@@ -1246,3 +1246,98 @@ def check_r10(ctx):
 
     visit(ctx.tree, hot=False)
     return out
+
+
+# ------------------------------------------------------------------- R11
+
+_R11_QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+                    "Queue", "LifoQueue", "PriorityQueue"}
+_R11_THREAD_CTORS = {"threading.Thread", "Thread"}
+
+
+def _r11_bindings(tree):
+    """Dotted targets bound to queue / thread constructors anywhere in the
+    file ('q', 'self._q', ...), plus every unbounded-queue construction."""
+    queues, threads, unbounded = set(), set(), []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        name = call_name(call)
+        if name is None:
+            continue
+        targets = {dotted(t) for t in node.targets} - {None}
+        if name in _R11_QUEUE_CTORS:
+            queues |= targets
+            if not call.args and _kw(call, "maxsize") is None:
+                unbounded.append(call)
+        elif name in _R11_THREAD_CTORS:
+            threads |= targets
+    return queues, threads, unbounded
+
+
+def _r11_has_timeout(call):
+    """True when the get()/join() is bounded: a timeout (kw or the
+    positional slot after `block`) or a non-blocking block=False."""
+    if _kw(call, "timeout") is not None:
+        return True
+    block = _kw(call, "block")
+    if block is not None and _const(block, True) is False:
+        return True
+    if call.args:
+        if len(call.args) >= 2:        # get(block, timeout)
+            return True
+        return _const(call.args[0], None) is not None  # join(5) / get(False)
+    return False
+
+
+@rule("R11", "unbounded queue / blocking get-join without timeout in a "
+      "serve/feed loop")
+def check_r11(ctx):
+    """Serving and feed loops live or die by bounded waits. An unbounded
+    `queue.Queue()` turns overload into silent unbounded buffering (every
+    queued request already missed its deadline by the time it's served —
+    admission control needs `maxsize` to shed instead). A bare blocking
+    `.get()` in a worker/consumer loop deadlocks the loop forever when the
+    other side dies without its sentinel landing (kill -9, interpreter
+    teardown); `.join()` without a timeout does the same at shutdown. The
+    repo's discipline (train/pipeline.py, serve/service.py): bounded queues,
+    timeout-polled gets with a liveness check, join(timeout=...). Flagged:
+    queue constructions without maxsize anywhere; `.get()` without
+    timeout/block=False on a queue-bound name inside a For/While loop;
+    `.join()` without a timeout on a queue- or thread-bound name anywhere.
+    A deliberately unbounded internal queue (e.g. a result mailbox that is
+    provably drained) carries a reasoned `# jaxcheck: disable=R11`."""
+    queues, threads, unbounded = _r11_bindings(ctx.tree)
+    out = []
+    for call in unbounded:
+        out.append(ctx.finding(
+            call, f"`{call_name(call)}()` without maxsize is an unbounded "
+            "buffer: overload queues work instead of shedding it, and every "
+            "parked item ages past its deadline — bound it and make the "
+            "producer handle Full explicitly"))
+
+    def visit(node, in_loop):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            in_loop = False  # the body runs when called, not per-iteration
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            recv = dotted(node.func.value)
+            if (node.func.attr == "get" and in_loop and recv in queues
+                    and not _r11_has_timeout(node)):
+                out.append(ctx.finding(
+                    node, f"blocking `{recv}.get()` without a timeout in "
+                    "this loop: if the producer dies without its sentinel "
+                    "landing, the consumer hangs forever — poll with "
+                    "get(timeout=...) and check producer liveness on Empty"))
+            elif (node.func.attr == "join" and recv in queues | threads
+                    and not _r11_has_timeout(node)):
+                out.append(ctx.finding(
+                    node, f"`{recv}.join()` without a timeout blocks "
+                    "shutdown forever if the other side is wedged — join "
+                    "with a timeout and surface the failure"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_loop or isinstance(node, (ast.For, ast.While)))
+
+    visit(ctx.tree, in_loop=False)
+    return out

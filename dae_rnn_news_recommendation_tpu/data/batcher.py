@@ -337,10 +337,23 @@ def prefetch(iterator, depth=2):
             finally:
                 put(end)
 
-        threading.Thread(target=worker, daemon=True).start()
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
         try:
             while True:
-                item = q.get()
+                # timeout-polled, never a bare blocking get (jaxcheck R11):
+                # if the worker dies without its end sentinel landing
+                # (interpreter teardown, a kill), the consumer surfaces
+                # instead of hanging forever
+                try:
+                    item = q.get(timeout=0.2)
+                except queue.Empty:
+                    if not t.is_alive() and q.empty():
+                        if err:
+                            raise err[0]
+                        raise RuntimeError(
+                            "prefetch worker died without its end sentinel")
+                    continue
                 if item is end:
                     if err:
                         raise err[0]

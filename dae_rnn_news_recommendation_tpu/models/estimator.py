@@ -615,7 +615,10 @@ class DenoisingAutoencoder:
         finishes, a checkpoint is saved (fit's end-of-run save path), and fit
         returns normally — so a preempted TPU job resumes from the last full
         epoch with restore_previous_model instead of losing the run. A second
-        signal falls through to the default handler (hard kill still possible).
+        signal falls through to the default handler, raising KeyboardInterrupt
+        mid-epoch — which the epoch loop catches to stop the pipelined feed
+        (drain + join, no leaked worker), write a mid-epoch cursor checkpoint,
+        and still return cleanly. A third signal hard-kills.
         No-op outside the main thread (signals can't be installed there)."""
         import contextlib
         import signal
@@ -774,125 +777,153 @@ class DenoisingAutoencoder:
             self.fraction_triplet_batch = []
             self.num_triplet_batch = []
             t0 = time.time()
+            step_in_epoch = 0  # reset before the feed branches run: the
+            # KeyboardInterrupt handler below reads it, and a stale value
+            # from the previous epoch would mislabel the cursor checkpoint
 
             # fence=False is sound here: every branch below already ends with
             # a real host fetch (jax.device_get of the epoch's metrics), which
             # is what jaxcheck R6 checks for inside unfenced spans
-            with telemetry.span("fit/epoch", fence=False,
-                                args={"epoch": epoch, "feed": feed_mode}):
-                if resident_mode:
-                    # whole epoch in ONE dispatch: scan over the same permuted
-                    # batches the streaming path would emit (train/resident.py)
-                    from ..train.resident import stack_epoch_indices
+            try:
+                with telemetry.span("fit/epoch", fence=False,
+                                    args={"epoch": epoch, "feed": feed_mode}):
+                    if resident_mode:
+                        # whole epoch in ONE dispatch: scan over the same permuted
+                        # batches the streaming path would emit (train/resident.py)
+                        from ..train.resident import stack_epoch_indices
 
-                    perm, rvalid = stack_epoch_indices(batcher, n_rows)
-                    if skip:
-                        # cross-feed resume: a cursor checkpoint written by a
-                        # streaming/pipelined run, resumed resident. Slice the
-                        # permutation so no batch applies twice; the in-scan
-                        # key chain differs from the interrupted run's, so
-                        # this is best-effort, not bitwise — and says so
-                        import warnings
+                        perm, rvalid = stack_epoch_indices(batcher, n_rows)
+                        if skip:
+                            # cross-feed resume: a cursor checkpoint written by a
+                            # streaming/pipelined run, resumed resident. Slice the
+                            # permutation so no batch applies twice; the in-scan
+                            # key chain differs from the interrupted run's, so
+                            # this is best-effort, not bitwise — and says so
+                            import warnings
 
-                        warnings.warn(
-                            "resident resume from a mid-epoch cursor "
-                            f"checkpoint (cursor={skip}): batch order is "
-                            "preserved but per-batch PRNG keys are not — "
-                            "resume is approximate, not bitwise-exact",
-                            RuntimeWarning, stacklevel=2)
-                        perm, rvalid = perm[skip:], rvalid[skip:]
-                    (self.params, self.opt_state, self._key, stacked) = epoch_fn(
-                        self.params, self.opt_state, self._key, resident_data,
-                        perm, rvalid, extremes)
-                    host = jax.device_get(stacked)
-                    host_metrics = [{k: v[i] for k, v in host.items()}
-                                    for i in range(perm.shape[0])]
-                    self.train_time = time.time() - t0
-                elif pipelined_mode:
-                    # overlapped feed (train/pipeline.py): a background worker
-                    # device_puts staged batches up to depth ahead; the step
-                    # consumes device-resident refs (and donates them on the
-                    # single-device path). Same batcher, same PRNG chain as
-                    # streaming — parity is tested, overlap is measured.
-                    feed_stats.reset()
-                    device_metrics = []
-                    step_in_epoch = skip
-                    replaying = wire_cache is not None and wire_cache.ready
-                    if replaying:
-                        # post-warm epoch: the pinned device batches replay in
-                        # warm-epoch order — nothing crosses the H2D link
-                        # (feed_bytes stays 0), only the wait bookkeeping runs
-                        feed = self._replay_batches(wire_cache, feed_stats)
-                    else:
-                        feed = PipelinedFeed(
-                            _skip_batches(
-                                batcher.epoch(train_set, labels, labels2),
-                                skip),
-                            depth=max(2, self.prefetch_depth), place=place,
-                            extremes=extremes, buckets=(b,), stats=feed_stats,
-                            retry=self._io_retry)
-                    for batch in feed:
-                        if self._recorder.batch_signature is None:
-                            # device-resident here: shape/dtype only
-                            self._recorder.note_batch_signature(batch)
+                            warnings.warn(
+                                "resident resume from a mid-epoch cursor "
+                                f"checkpoint (cursor={skip}): batch order is "
+                                "preserved but per-batch PRNG keys are not — "
+                                "resume is approximate, not bitwise-exact",
+                                RuntimeWarning, stacklevel=2)
+                            perm, rvalid = perm[skip:], rvalid[skip:]
+                        (self.params, self.opt_state, self._key, stacked) = epoch_fn(
+                            self.params, self.opt_state, self._key, resident_data,
+                            perm, rvalid, extremes)
+                        host = jax.device_get(stacked)
+                        host_metrics = [{k: v[i] for k, v in host.items()}
+                                        for i in range(perm.shape[0])]
+                        self.train_time = time.time() - t0
+                    elif pipelined_mode:
+                        # overlapped feed (train/pipeline.py): a background worker
+                        # device_puts staged batches up to depth ahead; the step
+                        # consumes device-resident refs (and donates them on the
+                        # single-device path). Same batcher, same PRNG chain as
+                        # streaming — parity is tested, overlap is measured.
+                        feed_stats.reset()
+                        device_metrics = []
+                        step_in_epoch = skip
+                        replaying = wire_cache is not None and wire_cache.ready
+                        if replaying:
+                            # post-warm epoch: the pinned device batches replay in
+                            # warm-epoch order — nothing crosses the H2D link
+                            # (feed_bytes stays 0), only the wait bookkeeping runs
+                            feed = self._replay_batches(wire_cache, feed_stats)
+                        else:
+                            feed = PipelinedFeed(
+                                _skip_batches(
+                                    batcher.epoch(train_set, labels, labels2),
+                                    skip),
+                                depth=max(2, self.prefetch_depth), place=place,
+                                extremes=extremes, buckets=(b,), stats=feed_stats,
+                                retry=self._io_retry)
+                        for batch in feed:
+                            if self._recorder.batch_signature is None:
+                                # device-resident here: shape/dtype only
+                                self._recorder.note_batch_signature(batch)
+                            if wire_cache is not None and not replaying:
+                                # warm epoch: pin the consumed (never-donated)
+                                # batch; EpochCache enforces the byte budget and
+                                # self-disables on overflow
+                                wire_cache.offer(batch, sum(
+                                    getattr(v, "nbytes", 0)
+                                    for v in batch.values()))
+                            _rfaults.fire("train.step", epoch=epoch,
+                                          step=step_in_epoch + 1)
+                            self._key, sub = jax.random.split(self._key)
+                            self.params, self.opt_state, metrics = pipe_step(
+                                self.params, self.opt_state, sub, batch)
+                            step_in_epoch += 1
+                            device_metrics.append(metrics)
+                            if self._cursor_save_due(step_in_epoch, n_batches,
+                                                     ckpt_steps):
+                                self._save_cursor(epoch, step_in_epoch,
+                                                  epoch_rng_state)
+
+                        host_metrics = jax.device_get(device_metrics)
+                        self.train_time = time.time() - t0
+                        feed_stats.finish(self.train_time)
+                        self.feed_stats_epochs.append(feed_stats.summary())
+                        train_writer.feed_stats(feed_stats, epoch)
                         if wire_cache is not None and not replaying:
-                            # warm epoch: pin the consumed (never-donated)
-                            # batch; EpochCache enforces the byte budget and
-                            # self-disables on overflow
-                            wire_cache.offer(batch, sum(
-                                getattr(v, "nbytes", 0)
-                                for v in batch.values()))
-                        _rfaults.fire("train.step", epoch=epoch,
-                                      step=step_in_epoch + 1)
-                        self._key, sub = jax.random.split(self._key)
-                        self.params, self.opt_state, metrics = pipe_step(
-                            self.params, self.opt_state, sub, batch)
-                        step_in_epoch += 1
-                        device_metrics.append(metrics)
-                        if self._cursor_save_due(step_in_epoch, n_batches,
-                                                 ckpt_steps):
-                            self._save_cursor(epoch, step_in_epoch,
-                                              epoch_rng_state)
+                            # the warm epoch ran to completion: later epochs replay
+                            wire_cache.seal()
+                    else:
+                        # accumulate device arrays only — converting per step would force a
+                        # host-device sync each batch and stall the async dispatch pipeline
+                        step_in_epoch = skip
+                        device_metrics = []
+                        for batch in prefetch(
+                                _skip_batches(
+                                    batcher.epoch(train_set, labels, labels2),
+                                    skip),
+                                self.prefetch_depth):
+                            batch.update(extremes)
+                            if self._recorder.batch_signature is None:
+                                # host-side batch stats while the arrays are still
+                                # numpy (once per fit; ties a bundle to its feed)
+                                self._recorder.note_batch_signature(batch)
+                            batch = self._place_batch(batch)
+                            _rfaults.fire("train.step", epoch=epoch,
+                                          step=step_in_epoch + 1)
+                            self._key, sub = jax.random.split(self._key)
+                            self.params, self.opt_state, metrics = self._train_step(
+                                self.params, self.opt_state, sub, batch)
+                            step_in_epoch += 1
+                            device_metrics.append(metrics)
+                            if self._cursor_save_due(step_in_epoch, n_batches,
+                                                     ckpt_steps):
+                                self._save_cursor(epoch, step_in_epoch,
+                                                  epoch_rng_state)
 
-                    host_metrics = jax.device_get(device_metrics)
-                    self.train_time = time.time() - t0
-                    feed_stats.finish(self.train_time)
-                    self.feed_stats_epochs.append(feed_stats.summary())
-                    train_writer.feed_stats(feed_stats, epoch)
-                    if wire_cache is not None and not replaying:
-                        # the warm epoch ran to completion: later epochs replay
-                        wire_cache.seal()
-                else:
-                    # accumulate device arrays only — converting per step would force a
-                    # host-device sync each batch and stall the async dispatch pipeline
-                    step_in_epoch = skip
-                    device_metrics = []
-                    for batch in prefetch(
-                            _skip_batches(
-                                batcher.epoch(train_set, labels, labels2),
-                                skip),
-                            self.prefetch_depth):
-                        batch.update(extremes)
-                        if self._recorder.batch_signature is None:
-                            # host-side batch stats while the arrays are still
-                            # numpy (once per fit; ties a bundle to its feed)
-                            self._recorder.note_batch_signature(batch)
-                        batch = self._place_batch(batch)
-                        _rfaults.fire("train.step", epoch=epoch,
-                                      step=step_in_epoch + 1)
-                        self._key, sub = jax.random.split(self._key)
-                        self.params, self.opt_state, metrics = self._train_step(
-                            self.params, self.opt_state, sub, batch)
-                        step_in_epoch += 1
-                        device_metrics.append(metrics)
-                        if self._cursor_save_due(step_in_epoch, n_batches,
-                                                 ckpt_steps):
-                            self._save_cursor(epoch, step_in_epoch,
-                                              epoch_rng_state)
-
-                    # one sync per epoch: pull all step metrics, then log/record on host
-                    host_metrics = jax.device_get(device_metrics)
-                    self.train_time = time.time() - t0
+                        # one sync per epoch: pull all step metrics, then log/record on host
+                        host_metrics = jax.device_get(device_metrics)
+                        self.train_time = time.time() - t0
+            except KeyboardInterrupt:
+                # Ctrl-C past the graceful handler (a second SIGINT falls
+                # through to the default handler; a consumer-thread interrupt
+                # never saw the handler at all): stop the pipelined feed so
+                # the worker thread joins instead of leaking, persist the
+                # epoch's progress through the checkpoint_every_steps cursor
+                # path, and exit cleanly — fit still runs its end-of-run
+                # validation and save below.
+                state = dict(locals())
+                live_feed = state.get("feed")
+                if live_feed is not None and hasattr(live_feed, "stop"):
+                    live_feed.stop()  # drain + join, never a leaked worker
+                cursor = int(state.get("step_in_epoch") or 0)
+                saved = 0 < cursor < n_batches
+                if saved:
+                    self._save_cursor(epoch, cursor, epoch_rng_state)
+                    if getattr(self, "_async_ckpt", None) is not None:
+                        self._async_ckpt.wait()  # on disk before fit returns
+                print(f"fit: interrupted mid-epoch {epoch} at step {cursor}; "
+                      "feed stopped, cursor checkpoint "
+                      f"{'saved' if saved else 'skipped'}; stopping",
+                      flush=True)
+                self._stop_requested = True
+                break
             for i, m in enumerate(host_metrics):
                 m = {k: float(v) for k, v in m.items()}
                 # reference step key: (epoch-1)*num_batches + i (autoencoder.py:245);

@@ -53,6 +53,9 @@ SITES = (
     "train.step",    # models/estimator.py, before each optimizer step
     "ckpt.save",     # utils/checkpoint.py, before writing checkpoint files
     "ckpt.commit",   # utils/checkpoint.py, before the atomic rename
+    "serve.enqueue", # serve/service.py submit, at request admission
+    "serve.batch",   # serve/service.py dispatch, before the device call
+    "serve.swap",    # serve/corpus.py swap, before the standby build
 )
 
 # Post-crash directives consumed by the chaos harness, not fired in-line.

@@ -6,12 +6,20 @@ AsyncCheckpointer). The contract the reliability subsystem enforces:
 
   * bounded — `max_attempts` total tries, then the original exception
     propagates unchanged (a persistent fault must fail loudly, not loop);
-  * backed off — sleep `backoff_s * factor**i` between tries, so a struggling
-    filesystem or link is not hammered;
+    `max_elapsed_s` additionally caps CUMULATIVE backoff sleep across the
+    whole run() — a serving path cannot afford a retry budget that outlives
+    the request deadline, so a tripped cap propagates the failure early and
+    records the trip like any other recovery event;
+  * backed off with full jitter — the base delay grows `backoff_s *
+    factor**i`, and each actual sleep is drawn uniformly from [0, delay]
+    (AWS-style full jitter): serve workers that all saw the same transient
+    blip desynchronize instead of stampeding the device in lockstep.
+    `jitter=False` restores the deterministic schedule;
   * never silent — every retry is appended to `policy.events`, mirrored into
     the active telemetry tracer as a `reliability/retry` span, and the
     estimator folds the events into the run manifest (`manifest["faults"]
-    ["retries"]`) so `telemetry report` shows them.
+    ["retries"]`) so `telemetry report` shows them. Cap trips land in the
+    same three places with `"cap_tripped": True`.
 
 What counts as transient: the injector's TransientFault (chaos runs), plus
 the OS-level blip classes a real deployment sees — interrupted syscalls,
@@ -21,6 +29,7 @@ multiplies it.
 """
 
 import errno
+import random
 import time
 
 from . import faults as _faults
@@ -48,57 +57,92 @@ class RetryPolicy:
     """Run callables with bounded, recorded, backed-off retries.
 
     :param max_attempts: total tries (1 = no retry).
-    :param backoff_s: sleep before retry i is `backoff_s * factor**(i-1)`.
+    :param backoff_s: base delay before retry i is `backoff_s * factor**(i-1)`;
+        with jitter the actual sleep is uniform in [0, base delay].
+    :param jitter: full jitter on each backoff sleep (default on). Events
+        always record the deterministic base as `backoff_s` and the drawn
+        value as `sleep_s`.
+    :param max_elapsed_s: cumulative cap on backoff sleep across one run();
+        None = unbounded. A sleep that would cross the cap is skipped and the
+        failure propagates, with a `cap_tripped` event recorded first.
     :param retryable: predicate deciding which exceptions earn a retry.
     :param on_retry: optional callback(event_dict) — the estimator uses it to
         collect retries for the run manifest.
     :param sleep: injection point for tests (defaults to time.sleep).
+    :param rng: uniform [0,1) draw for the jitter (defaults to random.random;
+        inject a seeded Random().random for reproducible schedules).
     """
 
     def __init__(self, max_attempts=3, backoff_s=0.05, factor=2.0,
-                 retryable=is_transient, on_retry=None, sleep=time.sleep):
+                 jitter=True, max_elapsed_s=None,
+                 retryable=is_transient, on_retry=None, sleep=time.sleep,
+                 rng=random.random):
         assert int(max_attempts) >= 1
         self.max_attempts = int(max_attempts)
         self.backoff_s = float(backoff_s)
         self.factor = float(factor)
+        self.jitter = bool(jitter)
+        self.max_elapsed_s = (None if max_elapsed_s is None
+                              else float(max_elapsed_s))
         self.retryable = retryable
         self.on_retry = on_retry
         self._sleep = sleep
+        self._rng = rng
         self.events = []  # every retry ever taken under this policy
+
+    def _record(self, event):
+        """Land one recovery event everywhere the contract promises: the
+        policy's own log, the active injector's cumulative log, the caller's
+        manifest callback, and the trace timeline."""
+        from .. import telemetry
+
+        self.events.append(event)
+        inj = _faults.active_injector()
+        if inj is not None:
+            inj.note_retry(event)  # survives restarts: the final attempt's
+            # manifest must still show earlier recoveries
+        if self.on_retry is not None:
+            try:
+                self.on_retry(event)
+            # jaxcheck: disable=R9 (guards the recording callback itself; the retry event is already in self.events and the injector log)
+            except Exception:
+                pass
+        # a zero-length span is enough to land the retry (with its
+        # site/attempt args) in the trace timeline next to the work
+        # it interrupted
+        with telemetry.span("reliability/retry", fence=False, args=event):
+            pass
 
     def run(self, fn, *args, site="", **kwargs):
         """Call fn(*args, **kwargs), retrying transient failures. The last
-        failure propagates unchanged once attempts are exhausted."""
-        from .. import telemetry
-
+        failure propagates unchanged once attempts are exhausted or the
+        cumulative backoff cap trips."""
         delay = self.backoff_s
+        elapsed = 0.0
         for attempt in range(1, self.max_attempts + 1):
             try:
                 return fn(*args, **kwargs)
             except Exception as exc:
                 if attempt >= self.max_attempts or not self.retryable(exc):
                     raise
+                sleep_s = delay * self._rng() if self.jitter else delay
                 event = {"site": site, "attempt": attempt,
                          "max_attempts": self.max_attempts,
                          "error": f"{type(exc).__name__}: {exc}",
-                         "backoff_s": round(delay, 4)}
-                self.events.append(event)
-                inj = _faults.active_injector()
-                if inj is not None:
-                    inj.note_retry(event)  # survives restarts: the final
-                    # attempt's manifest must still show earlier recoveries
-                if self.on_retry is not None:
-                    try:
-                        self.on_retry(event)
-                    # jaxcheck: disable=R9 (guards the recording callback itself; the retry event is already in self.events and the injector log)
-                    except Exception:
-                        pass
-                # a zero-length span is enough to land the retry (with its
-                # site/attempt args) in the trace timeline next to the work
-                # it interrupted
-                with telemetry.span("reliability/retry", fence=False,
-                                    args=event):
-                    pass
-                self._sleep(delay)
+                         "backoff_s": round(delay, 4),
+                         "sleep_s": round(sleep_s, 4)}
+                if (self.max_elapsed_s is not None
+                        and elapsed + sleep_s > self.max_elapsed_s):
+                    # the remaining retry budget cannot cover this sleep:
+                    # fail NOW (deadline honesty) but never silently — the
+                    # trip is recorded like any other recovery event
+                    event["cap_tripped"] = True
+                    event["elapsed_s"] = round(elapsed, 4)
+                    event["max_elapsed_s"] = self.max_elapsed_s
+                    self._record(event)
+                    raise
+                self._record(event)
+                self._sleep(sleep_s)
+                elapsed += sleep_s
                 delay *= self.factor
         raise AssertionError("unreachable")  # pragma: no cover

@@ -1,0 +1,39 @@
+"""Resilient serving: the inference path of the news recommender.
+
+The training side of this repo got the chaos treatment in `reliability/`;
+this package gives the SERVING side the same discipline — every request gets
+a reply-or-shed decision before its deadline, degraded modes are explicit
+and recorded, and the corpus refresh is a health-gated hot swap that rolls
+back rather than serving a bad build. Full story in docs/serving.md.
+
+    corpus = ServingCorpus(config)
+    corpus.swap(params, articles)          # build + gate + promote
+    svc = RecommendationService(params, config, corpus, top_k=10)
+    svc.warmup()
+    fut = svc.submit(user_vector, deadline_s=0.05)
+    reply = fut.result(timeout=0.05)       # .status: ok | shed | error
+    svc.stop()
+"""
+
+from .chaos_serve import (ServePlanResult, chaos_serve_soak, overload_trace,
+                          run_serve_plan, serve_fault_plan)
+from .corpus import CorpusSlot, ServingCorpus, SwapRejected
+from .graph import block_indices, make_corpus_encode_fn, make_serve_fn
+from .service import RecommendationService, Reply, ReplyFuture
+
+__all__ = [
+    "CorpusSlot",
+    "RecommendationService",
+    "Reply",
+    "ReplyFuture",
+    "ServePlanResult",
+    "ServingCorpus",
+    "SwapRejected",
+    "block_indices",
+    "chaos_serve_soak",
+    "make_corpus_encode_fn",
+    "make_serve_fn",
+    "overload_trace",
+    "run_serve_plan",
+    "serve_fault_plan",
+]

@@ -1,0 +1,246 @@
+"""Chaos-serve soak: seeded fault plans x overload traces against the service.
+
+`reliability/chaos.py` proves the TRAINING loop recovers from injected
+faults; this module proves the SERVING loop keeps its reply-or-shed promise
+under the same discipline. Each seeded plan pairs:
+
+  * a FaultPlan over the serve fire-points (serve.enqueue / serve.batch /
+    serve.swap, kinds transient|fatal|preempt) — the round-robin family pick
+    guarantees any 6 consecutive seeds cover every serve fault family; and
+  * an overload trace — a seeded arrival schedule of request bursts with
+    mixed deadlines: generous ones that must be answered, hopeless ones
+    (microseconds) that must be shed, plus bursts sized past the admission
+    queue so queue_full shedding and the degraded modes actually engage.
+
+Mid-plan, the harness attempts a hot corpus swap. Under an injected
+`serve.swap` fault the swap must ROLL BACK: version unchanged, rollback
+recorded in `corpus.events`, and a probe request answered by the OLD corpus
+afterwards.
+
+A plan passes when:
+  * exactly-one-outcome: submitted == replied + shed + errors, and every
+    future resolved within the harness deadline (zero deadlocks, zero silent
+    drops);
+  * fault honesty: a plan that injected faults shows them in the injector
+    log, and transient batch faults show absorbed retries;
+  * swap honesty: a swap-faulted plan rolled back and kept serving; an
+    unfaulted plan promoted to a new version;
+  * bounded latency: p95 of answered requests stays under the generous
+    deadline even when the plan ran degraded.
+"""
+
+import dataclasses
+import time
+
+import numpy as np
+
+from ..models.dae_core import DAEConfig, init_params
+from ..reliability import faults as _faults
+from ..reliability.faults import FaultInjector, FaultPlan, FaultSpec
+from ..reliability.retry import RetryPolicy
+from .corpus import ServingCorpus
+from .service import RecommendationService
+
+# CPU-sized service shapes: small enough for tier-1, busy enough to overload
+_N_ARTICLES = 96
+_N_FEATURES = 24
+_N_COMPONENTS = 8
+
+# generous deadline every answered request must honor (CPU dispatch is ~ms;
+# the budget absorbs scheduler jitter on a loaded test box)
+_SLA_S = 5.0
+_HOPELESS_S = 1e-6   # provably unmeetable once the floor is warm
+_HARNESS_DEADLINE_S = 60.0
+
+
+@dataclasses.dataclass
+class ServePlanResult:
+    seed: int
+    ok: bool
+    detail: str
+    n_submitted: int
+    n_replied: int
+    n_shed: int
+    n_errors: int
+    n_unresolved: int
+    p95_ms: float
+    degraded: bool
+    swap_faulted: bool
+    swap_rolled_back: bool
+    served_after_swap: bool
+    injected: list
+    retries: list
+    duration_s: float
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def serve_fault_plan(seed, n_requests):
+    """Seeded plan over the serve fire-points. Six families, round-robin on
+    the seed (mirrors FaultPlan.generate's discipline for the train sites)."""
+    rng = np.random.default_rng(seed)
+    # batch faults always land on the FIRST dispatch: the trace guarantees an
+    # answerable first burst, so the fault provably fires there — and never
+    # on the end-of-plan probe
+    batch_at = 1
+    families = (
+        lambda: (FaultSpec("serve.batch", batch_at, "transient",
+                           note="flaky device dispatch"),),
+        lambda: (FaultSpec("serve.batch", batch_at, "fatal",
+                           note="device fault mid-batch"),),
+        lambda: (FaultSpec("serve.enqueue",
+                           int(rng.integers(1, max(2, n_requests))),
+                           "transient", note="admission blip"),),
+        lambda: (FaultSpec("serve.enqueue",
+                           int(rng.integers(1, max(2, n_requests))), "fatal",
+                           note="admission failure"),),
+        lambda: (FaultSpec("serve.swap", 1, "fatal",
+                           note="standby build dies -> rollback"),),
+        lambda: (FaultSpec("serve.batch", batch_at, "preempt",
+                           note="serving task preempted mid-batch"),),
+    )
+    specs = list(families[seed % len(families)]())
+    for _ in range(int(rng.integers(0, 3))):
+        specs.append(FaultSpec(
+            "serve.batch" if rng.random() < 0.5 else "serve.enqueue",
+            int(rng.integers(1, max(2, n_requests))), "transient",
+            note="extra transient"))
+    return FaultPlan(seed=int(seed), specs=tuple(specs))
+
+
+def overload_trace(seed, n_requests):
+    """Seeded arrival schedule: [(n_burst, deadline_s, gap_s)]. Front-loaded
+    bursts overflow the admission queue; a sprinkle of hopeless deadlines
+    exercises unmeetable-shedding; the rest must be answered within SLA."""
+    rng = np.random.default_rng(1000 + seed)
+    trace = []
+    left = n_requests
+    while left > 0:
+        burst = int(min(left, rng.integers(1, 25)))
+        # the first burst is always answerable: batch-site faults are planned
+        # at the first dispatch and must land on real requests, not the probe
+        hopeless = bool(trace) and rng.random() < 0.25
+        trace.append((burst, _HOPELESS_S if hopeless else _SLA_S,
+                      float(rng.random() * 0.002)))
+        left -= burst
+    return trace
+
+
+def _make_service(seed, collapse_ceiling=0.98):
+    config = DAEConfig(n_features=_N_FEATURES, n_components=_N_COMPONENTS,
+                       enc_act_func="tanh", triplet_strategy="none",
+                       corr_type="masking", corr_frac=0.0)
+    import jax
+
+    params = init_params(jax.random.PRNGKey(7 + seed), config)
+    rng = np.random.default_rng(2000 + seed)
+    articles = rng.random((_N_ARTICLES, _N_FEATURES), dtype=np.float32)
+    corpus = ServingCorpus(config, block=32,
+                           collapse_ceiling=collapse_ceiling)
+    corpus.swap(params, articles, note="initial")
+    service = RecommendationService(
+        params, config, corpus, top_k=5, max_batch=8, max_inflight=16,
+        flush_slack_s=0.02, linger_s=0.002, default_deadline_s=_SLA_S,
+        retry=RetryPolicy(max_attempts=3, backoff_s=0.001, max_elapsed_s=0.5))
+    service.warmup()
+    return service, params, articles
+
+
+def run_serve_plan(seed, n_requests=48, log=None):
+    """Execute one fault-plan x overload-trace pair. Returns ServePlanResult."""
+    t0 = time.monotonic()
+    service, params, articles = _make_service(seed)
+    corpus = service.corpus
+    plan = serve_fault_plan(seed, n_requests)
+    injector = FaultInjector(plan)
+    swap_faulted = any(s.site == "serve.swap" for s in plan.specs)
+    version_before = corpus.version
+    rng = np.random.default_rng(3000 + seed)
+    futures = []
+    served_after_swap = False
+    try:
+        with _faults.install(injector):
+            swap_at = len(overload_trace(seed, n_requests)) // 2
+            for i, (burst, deadline_s, gap_s) in enumerate(
+                    overload_trace(seed, n_requests)):
+                for _ in range(burst):
+                    q = articles[int(rng.integers(0, _N_ARTICLES))]
+                    futures.append(service.submit(q, deadline_s=deadline_s))
+                if i == swap_at:
+                    # hot swap under fire: fresh articles, old ones keep
+                    # serving until promotion (or forever, on rollback)
+                    fresh = rng.random((_N_ARTICLES, _N_FEATURES),
+                                       dtype=np.float32)
+                    corpus.swap(params, fresh, note=f"refresh-{seed}")
+                time.sleep(gap_s)
+            # post-swap probe OUTSIDE the trace accounting: whatever the swap
+            # did, the service must still answer
+            probe = service.submit(articles[0], deadline_s=_SLA_S)
+            probe_reply = probe.result(timeout=_HARNESS_DEADLINE_S)
+            served_after_swap = probe_reply.ok
+            futures.append(probe)
+            replies, unresolved = [], 0
+            harness_deadline = time.monotonic() + _HARNESS_DEADLINE_S
+            for f in futures:
+                try:
+                    replies.append(f.result(
+                        timeout=max(0.0, harness_deadline - time.monotonic())))
+                except TimeoutError:
+                    unresolved += 1  # a deadlock/silent drop — fails the plan
+    finally:
+        service.stop()
+    n_ok = sum(1 for r in replies if r.status == "ok")
+    n_shed = sum(1 for r in replies if r.status == "shed")
+    n_err = sum(1 for r in replies if r.status == "error")
+    ok_lat = [r.latency_s for r in replies if r.status == "ok"]
+    p95_ms = (round(float(np.percentile(ok_lat, 95)) * 1e3, 3)
+              if ok_lat else 0.0)
+    rolled_back = any(e["event"] == "swap_rollback" for e in corpus.events)
+    promoted = corpus.version > version_before
+    summary = service.summary()
+    problems = []
+    if unresolved:
+        problems.append(f"{unresolved} futures never resolved")
+    if summary["counts"]["submitted"] != n_ok + n_shed + n_err + unresolved:
+        problems.append(
+            f"outcome leak: submitted {summary['counts']['submitted']} != "
+            f"ok {n_ok} + shed {n_shed} + err {n_err}")
+    if plan.specs and not injector.fired:
+        # the mandatory family is planned where it provably lands (batch
+        # call 1 / an enqueue within the trace / the mid-plan swap)
+        problems.append("plan fired no faults (plan/trace mismatch)")
+    if swap_faulted and not rolled_back:
+        problems.append("serve.swap fault did not roll back")
+    if swap_faulted and promoted:
+        problems.append("swap promoted despite injected fault")
+    if not swap_faulted and not promoted:
+        problems.append("fault-free swap failed to promote")
+    if not served_after_swap:
+        problems.append("service stopped answering after the swap")
+    if ok_lat and p95_ms > _SLA_S * 1e3:
+        problems.append(f"p95 {p95_ms} ms blew the {_SLA_S}s SLA")
+    result = ServePlanResult(
+        seed=int(seed), ok=not problems, detail="; ".join(problems) or "ok",
+        n_submitted=summary["counts"]["submitted"], n_replied=n_ok,
+        n_shed=n_shed, n_errors=n_err, n_unresolved=unresolved,
+        p95_ms=p95_ms, degraded=bool(summary["degraded_events"]),
+        swap_faulted=swap_faulted, swap_rolled_back=rolled_back,
+        served_after_swap=served_after_swap,
+        injected=list(injector.fired), retries=list(injector.retries),
+        duration_s=round(time.monotonic() - t0, 2))
+    if log:
+        log(f"serve plan {seed}: {'OK' if result.ok else 'FAIL'} "
+            f"({result.n_replied} ok / {result.n_shed} shed / "
+            f"{result.n_errors} err, p95 {result.p95_ms} ms) {result.detail}")
+    return result
+
+
+def chaos_serve_soak(n_plans=6, n_requests=48, log=None):
+    """Replay `n_plans` seeded plans (seeds 0..n-1; any 6 consecutive seeds
+    cover every serve fault family). Returns {"results", "all_ok", ...}."""
+    results = [run_serve_plan(seed, n_requests=n_requests, log=log)
+               for seed in range(n_plans)]
+    n_ok = sum(1 for r in results if r.ok)
+    return {"results": results, "n_ok": n_ok, "n_plans": n_plans,
+            "all_ok": n_ok == n_plans}

@@ -1,0 +1,171 @@
+"""Double-buffered HBM-resident serving corpus with a health-gated hot swap.
+
+A production recommender refreshes its article corpus while serving (new
+articles arrive continuously; the paper's whole premise is fresh-news
+recommendation). The refresh must never take the service down and must never
+promote a bad build — so the swap protocol here is:
+
+  1. BUILD the standby slot while the active slot keeps serving: upload the
+     new article set with `train/resident.build_resident` and embed it in one
+     dispatch (serve/graph.make_corpus_encode_fn). Requests answered during
+     the build are tagged `stale_corpus` by the service — a first-class
+     degraded mode, recorded, never silent.
+  2. HEALTH-GATE the standby before promotion: the sentinel's collapse score
+     (telemetry/health.embedding_health — masked mean pairwise cosine) over a
+     sample of the new embeddings, plus a finiteness check. A collapsed or
+     NaN-poisoned embedding table would serve confidently-wrong results with
+     healthy-looking latency; the gate refuses it.
+  3. PROMOTE atomically (one reference assignment under the lock) or ROLL
+     BACK: any build/gate failure leaves the active slot untouched and
+     serving, and appends a `swap_rollback` event to `corpus.events` (which
+     the service folds into its manifest fragment).
+
+`reliability/faults.py` fires `serve.swap` at the top of every build, so the
+chaos-serve soak can prove the rollback path: an injected swap fault must
+leave the OLD corpus serving, version unchanged.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+from ..reliability import faults as _faults
+from ..telemetry.health import embedding_health
+from ..train.resident import build_resident
+from .graph import DEFAULT_BLOCK, block_indices, make_corpus_encode_fn
+
+# refuse to promote an embedding table whose sampled mean pairwise cosine is
+# above this: the encoder has collapsed and every query would get the same
+# articles (telemetry/health.py uses the same score to flag training runs)
+COLLAPSE_CEILING = 0.98
+
+_GATE_SAMPLE = 256  # rows sampled for the collapse gate
+
+
+class CorpusSlot:
+    """One immutable buffer: unit-norm embeddings [N_pad, D] on device, a
+    valid-row mask, and provenance. Never mutated after build — the service
+    snapshots a reference and scores against it lock-free."""
+
+    __slots__ = ("emb", "valid", "n", "version", "note", "built_s")
+
+    def __init__(self, emb, valid, n, version, note, built_s):
+        self.emb = emb
+        self.valid = valid
+        self.n = int(n)
+        self.version = int(version)
+        self.note = note
+        self.built_s = built_s
+
+
+class SwapRejected(RuntimeError):
+    """The standby build failed its health gate; the active slot still serves."""
+
+
+class ServingCorpus:
+    """Double-buffered corpus: `active` serves while `swap()` builds, gates,
+    and promotes (or rolls back). Thread-safe; the swap runs on the caller's
+    thread so the microbatcher never blocks on a refresh."""
+
+    def __init__(self, config, *, block=DEFAULT_BLOCK,
+                 collapse_ceiling=COLLAPSE_CEILING, device_put=None):
+        self.config = config
+        self.block = int(block)
+        self.collapse_ceiling = float(collapse_ceiling)
+        self._device_put = device_put
+        self._encode_corpus = make_corpus_encode_fn(config)
+        self._lock = threading.Lock()
+        self._active = None
+        self._version = 0
+        self._refreshing = threading.Event()
+        self.events = []  # swap / swap_rollback records, in order
+
+    # ------------------------------------------------------------ read side
+    @property
+    def active(self):
+        """The serving slot (None before the first successful swap)."""
+        with self._lock:
+            return self._active
+
+    @property
+    def version(self):
+        with self._lock:
+            return self._version
+
+    @property
+    def refreshing(self):
+        """True while a standby build is in flight — the service tags replies
+        `stale_corpus` for the duration."""
+        return self._refreshing.is_set()
+
+    # ----------------------------------------------------------- swap side
+    def swap(self, params, articles, note=""):
+        """Build a standby slot from `articles` (dense [N, F] or scipy CSR),
+        health-gate it, and promote it. Returns the promoted CorpusSlot.
+
+        On ANY failure (injected serve.swap fault, build error, gate refusal)
+        the active slot keeps serving: the failure is recorded as a
+        `swap_rollback` event and re-raised only when there is no active slot
+        to fall back to (a failed FIRST build has nothing to serve)."""
+        t0 = time.monotonic()
+        self._refreshing.set()
+        try:
+            with telemetry.span("serve/corpus_swap", fence=False,
+                                args={"note": note}):
+                standby = self._build(params, articles, note)
+            gate = self._health_gate(standby)
+            if not gate["ok"]:
+                raise SwapRejected(
+                    f"standby corpus failed the health gate: {gate}")
+        except Exception as exc:
+            with self._lock:
+                fallback = self._active
+                event = {"event": "swap_rollback", "note": note,
+                         "error": f"{type(exc).__name__}: {exc}",
+                         "active_version": self._version,
+                         "duration_s": round(time.monotonic() - t0, 4)}
+                self.events.append(event)
+            if fallback is None:
+                raise  # nothing to roll back TO: the caller must know
+            return fallback
+        finally:
+            self._refreshing.clear()
+        with self._lock:
+            self._version += 1
+            standby.version = self._version
+            self._active = standby
+            self.events.append({
+                "event": "swap", "note": note, "version": self._version,
+                "n_articles": standby.n, "collapse": gate["collapse"],
+                "duration_s": round(time.monotonic() - t0, 4)})
+        return standby
+
+    def _build(self, params, articles, note):
+        _faults.fire("serve.swap", note=note)
+        n = int(articles.shape[0])
+        resident = build_resident(articles, device_put=self._device_put)
+        blocks = block_indices(n, self.block)
+        emb = self._encode_corpus(params, resident, blocks)
+        n_pad = blocks.size
+        valid = np.zeros(n_pad, np.float32)
+        valid[:n] = 1.0
+        put = self._device_put or jax.device_put
+        return CorpusSlot(emb=emb, valid=put(valid), n=n, version=-1,
+                          note=note, built_s=time.monotonic())
+
+    def _health_gate(self, slot):
+        """Finiteness + collapse score on a sample of the standby embeddings.
+        One deliberate host sync — the swap path is off the request path."""
+        sample = slot.emb[:min(_GATE_SAMPLE, slot.n)]
+        finite = bool(jax.device_get(jnp.all(jnp.isfinite(sample))))
+        stats = jax.device_get(embedding_health(sample))
+        collapse = float(stats["health/embedding_collapse"])
+        ok = finite and np.isfinite(collapse) and (
+            collapse <= self.collapse_ceiling)
+        return {"ok": ok, "finite": finite, "collapse": round(collapse, 6),
+                "ceiling": self.collapse_ceiling}

@@ -1,0 +1,89 @@
+"""The serving dataflow: jitted encode -> score -> top-k over a resident corpus.
+
+Two compiled programs, both shaped for the high-latency dispatch link the
+training side already engineered around (bench.py:_hard_sync measures
+~23-70 ms per host->device round trip over the axon tunnel):
+
+  * `make_corpus_encode_fn` — embeds the WHOLE corpus in one dispatch: a
+    `lax.scan` over fixed-size index blocks gathers rows from the HBM-resident
+    arrays with the same `jnp.take` gather `train/resident.py` uses for
+    one-dispatch epochs, densifies sparse rows on device, encodes, and
+    L2-normalizes. The [N_pad, D] embedding matrix never leaves the device —
+    it IS the serving corpus (serve/corpus.py double-buffers two of them).
+
+  * `make_serve_fn` — answers one microbatch in one dispatch: encode the
+    [B, F] query batch, normalize, score every corpus row by cosine (one
+    [B, D] x [D, N] matmul on the MXU), mask padded corpus rows to -inf, and
+    `lax.top_k`. `k` is baked into the compiled program (it shapes the
+    output), so the service precompiles one variant per (bucket, k) pair —
+    the degraded top-k-truncation mode is just a dispatch to the smaller-k
+    variant, not a recompile under overload.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..models import dae_core
+
+# corpus index blocks per scan step: big enough to amortize the gather,
+# small enough that (block x F) dense stays far below the step's working set
+DEFAULT_BLOCK = 512
+
+
+def _gather_rows(resident, idx, config):
+    """Dense [len(idx), F] rows from a `train.resident.build_resident` dict —
+    the resident gather, reused verbatim: `jnp.take` on the resident arrays,
+    sparse rows densified on device (ops/sparse_ingest layout)."""
+    if "x" in resident:
+        return jnp.take(resident["x"], idx, axis=0)
+    from ..ops.sparse_ingest import densify_on_device
+
+    ind = jnp.take(resident["indices"], idx, axis=0)
+    val = jnp.take(resident["values"], idx, axis=0)
+    return densify_on_device(ind, val, config.n_features)
+
+
+def _normalize(h):
+    return h / (jnp.linalg.norm(h, axis=-1, keepdims=True) + 1e-9)
+
+
+def block_indices(n_rows, block=DEFAULT_BLOCK):
+    """[S, block] int32 index blocks covering 0..n_rows-1, tail padded by
+    repeating index 0 (the pad rows are masked out of scoring via the valid
+    vector, so the duplicate gather is inert)."""
+    n_pad = int(-(-max(int(n_rows), 1) // block) * block)
+    idx = np.zeros(n_pad, np.int32)
+    idx[:n_rows] = np.arange(n_rows, dtype=np.int32)
+    return idx.reshape(-1, block)
+
+
+def make_corpus_encode_fn(config):
+    """Jitted whole-corpus embed: (params, resident, idx_blocks [S, block])
+    -> unit-norm embeddings [S*block, D], one dispatch for the whole build."""
+
+    def run(params, resident, idx_blocks):
+        def body(carry, idx):
+            x = _gather_rows(resident, idx, config)
+            return carry, _normalize(dae_core.encode(params, x, config))
+
+        _, emb = jax.lax.scan(body, None, idx_blocks)
+        return emb.reshape(-1, emb.shape[-1])
+
+    return telemetry.instrument(jax.jit(run), "serve/corpus_encode")
+
+
+def make_serve_fn(config, k):
+    """Jitted microbatch answer: (params, emb [N_pad, D], valid [N_pad],
+    queries [B, F]) -> (scores [B, k], indices [B, k]), cosine-ranked."""
+    k = int(k)
+    assert k >= 1
+
+    def run(params, emb, valid, queries):
+        h = _normalize(dae_core.encode(params, queries, config))
+        scores = h @ emb.T
+        scores = jnp.where(valid[None, :] > 0, scores, -jnp.inf)
+        return jax.lax.top_k(scores, k)
+
+    return telemetry.instrument(jax.jit(run), f"serve/topk{k}")

@@ -1,0 +1,413 @@
+"""Deadline-aware recommendation service: admission -> microbatch -> reply.
+
+This is `train/pipeline.py`'s bounded-queue machinery run in reverse. The
+training feed has one consumer (the step) pulling from a background producer;
+serving has many producers (request threads) feeding one background consumer
+(the batcher thread) that coalesces requests into shape-bucketed microbatches
+for the jitted encode->score->top-k graph (serve/graph.py). The same
+discipline carries over: a bounded queue (admission is load shedding, not
+buffering), timeout-polled gets (a wedged device can never deadlock the
+loop), and stop() that drains and joins.
+
+Request lifecycle — every submitted request ends in EXACTLY ONE of:
+
+  reply   the request rode a microbatch to the device and got its top-k
+          (the reply says whether the deadline was met and which degraded
+          modes, if any, shaped the answer);
+  shed    an explicit admission/queue decision with a reason: queue full,
+          deadline provably unmeetable (less than the observed device floor
+          remains), deadline expired while queued, or service shutdown;
+  error   the device call failed after bounded retries (or a fatal injected
+          fault landed); the error text rides the reply.
+
+Nothing times out silently and nothing blocks forever — the chaos-serve soak
+(serve/chaos_serve.py) replays seeded fault plans x overload traces and
+asserts exactly-one-outcome over every request.
+
+Microbatch flush policy (the deadline-aware part): the batcher fires when the
+batch is FULL, when the OLDEST request's deadline slack has shrunk to the
+flush threshold (slack-triggered flush — a request is never parked past the
+point where the device floor would blow its deadline), or when the batch has
+lingered `linger_s` with spare slack (idle latency bound). Under overload
+(queue occupancy past the watermark) the service degrades EXPLICITLY rather
+than failing implicitly: top-k truncates to `degraded_top_k` (a precompiled
+smaller-k variant, not a recompile) and batching coarsens (linger stretches
+so dispatches amortize better). Each degraded episode is recorded in
+`service.events` and lands in the manifest fragment — degraded modes are
+first-class, never silent.
+"""
+
+import dataclasses
+import queue
+import threading
+import time
+
+import numpy as np
+
+import jax
+
+from .. import telemetry
+from ..reliability import faults as _faults
+from ..reliability.retry import RetryPolicy
+from ..train.pipeline import bucket_sizes
+from .graph import make_serve_fn
+
+_LATENCY_WINDOW = 4096  # replies kept for p50/p95 (bounded, like the queue)
+
+
+@dataclasses.dataclass
+class Reply:
+    """Terminal outcome of one request. status: "ok" | "shed" | "error"."""
+
+    status: str
+    indices: object = None    # np [k] int corpus rows (status == "ok")
+    scores: object = None     # np [k] f32 cosine scores
+    reason: str = ""          # shed/error explanation
+    latency_s: float = 0.0    # submit -> resolve wall clock
+    deadline_met: bool = False
+    degraded: tuple = ()      # subset of ("topk_truncated", "coarse_batching",
+    #                           "stale_corpus") that shaped this reply
+    corpus_version: int = 0
+
+    @property
+    def ok(self):
+        return self.status == "ok"
+
+
+class _Pending:
+    __slots__ = ("query", "deadline", "t_submit", "future")
+
+    def __init__(self, query, deadline, t_submit):
+        self.query = query
+        self.deadline = deadline
+        self.t_submit = t_submit
+        self.future = ReplyFuture()
+
+
+class ReplyFuture:
+    """Per-request future: resolved exactly once with a Reply."""
+
+    __slots__ = ("_event", "_reply")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._reply = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """The Reply, blocking up to `timeout` (None = forever is for tests
+        only; production callers pass their deadline slack)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("reply not ready")
+        return self._reply
+
+    def _set(self, reply):
+        if self._event.is_set():  # pragma: no cover - single-resolver design
+            return False
+        self._reply = reply
+        self._event.set()
+        return True
+
+
+class RecommendationService:
+    """Admission-controlled, deadline-propagating serving front end.
+
+    :param params: trained DAE params (the encode weights).
+    :param config: the model's DAEConfig.
+    :param corpus: a serve.corpus.ServingCorpus (swap() at least once before
+        submitting, or every request errors with no_corpus).
+    :param top_k: articles per reply (compiled into the serve graph).
+    :param degraded_top_k: the overload variant (precompiled; <= top_k).
+    :param max_batch: microbatch ceiling; buckets halve down from it.
+    :param max_inflight: bounded admission queue depth — beyond it, shed.
+    :param flush_slack_s: flush when the oldest deadline is this close.
+    :param linger_s: idle flush bound — a lone request never waits longer
+        than this for companions (stretched under overload: coarse batching).
+    :param default_deadline_s: applied when submit() gets no deadline.
+    :param overload_watermark: queue-occupancy fraction that enters degraded
+        mode.
+    :param retry: RetryPolicy for transient device faults on the batch path
+        (default: 3 attempts, full jitter, 0.25 s cumulative cap).
+    """
+
+    def __init__(self, params, config, corpus, *, top_k=10,
+                 degraded_top_k=None, max_batch=32, max_inflight=64,
+                 flush_slack_s=0.02, linger_s=0.005, default_deadline_s=1.0,
+                 overload_watermark=0.75, retry=None):
+        assert int(top_k) >= 1 and int(max_batch) >= 1
+        self.params = params
+        self.config = config
+        self.corpus = corpus
+        self.top_k = int(top_k)
+        self.degraded_top_k = int(degraded_top_k if degraded_top_k is not None
+                                  else max(1, self.top_k // 2))
+        assert 1 <= self.degraded_top_k <= self.top_k
+        self.max_batch = int(max_batch)
+        self.max_inflight = int(max_inflight)
+        self.flush_slack_s = float(flush_slack_s)
+        self.linger_s = float(linger_s)
+        self.default_deadline_s = float(default_deadline_s)
+        self.overload_watermark = float(overload_watermark)
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, backoff_s=0.002, max_elapsed_s=0.25)
+        self.buckets = bucket_sizes(self.max_batch, n_buckets=3,
+                                    floor=min(8, self.max_batch))
+        self._serve_fns = {k: make_serve_fn(config, k)
+                           for k in {self.top_k, self.degraded_top_k}}
+        self._q = queue.Queue(maxsize=self.max_inflight)
+        self._stop = threading.Event()
+        self._floor_s = 0.0       # fastest observed device batch (the proof
+        # floor for "deadline provably unmeetable"; 0 until warm = admit all)
+        self._degraded = False    # inside an overload episode?
+        self._latencies = []      # bounded reply-latency window
+        self._lock = threading.Lock()
+        self.counts = {"submitted": 0, "replied": 0, "shed": 0, "errors": 0,
+                       "deadline_missed": 0, "batches": 0}
+        self.events = []          # degraded-mode transitions, in order
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-batcher")
+        self._thread.start()
+
+    # ------------------------------------------------------------ admission
+    def submit(self, query, deadline_s=None):
+        """Admit one query (dense [F] feature vector). Returns a ReplyFuture
+        that ALWAYS resolves — with a reply, an explicit shed, or an error."""
+        now = time.monotonic()
+        deadline_s = (self.default_deadline_s if deadline_s is None
+                      else float(deadline_s))
+        p = _Pending(np.asarray(query, np.float32).reshape(-1),
+                     now + deadline_s, now)
+        with self._lock:
+            self.counts["submitted"] += 1
+        if self._stop.is_set():
+            return self._shed(p, "shutdown")
+        try:
+            # transient admission blips ride the jittered retry policy;
+            # anything fatal is an explicit error reply, not a hang
+            self.retry.run(_faults.fire, "serve.enqueue",
+                           site="serve.enqueue")
+        except Exception as exc:
+            return self._error(p, f"{type(exc).__name__}: {exc}")
+        floor = self._floor_s
+        if floor > 0.0 and deadline_s < floor:
+            # provably unmeetable: the device has never answered a batch
+            # faster than `floor` — shedding NOW costs the caller nothing
+            # and spares the queue
+            return self._shed(p, "deadline_unmeetable")
+        try:
+            self._q.put_nowait(p)
+        except queue.Full:
+            return self._shed(p, "queue_full")
+        if self._stop.is_set() and not self._thread.is_alive():
+            # raced a concurrent stop(): the batcher is gone, so nothing will
+            # ever pull this queue again — shed the stragglers explicitly
+            # rather than leak an unresolved future
+            while True:
+                try:
+                    self._shed(self._q.get_nowait(), "shutdown")
+                except queue.Empty:
+                    break
+        return p.future
+
+    # ------------------------------------------------------- batcher thread
+    def _loop(self):
+        pending = []
+        while True:
+            now = time.monotonic()
+            if pending:
+                oldest_slack = min(p.deadline for p in pending) - now
+                age = now - min(p.t_submit for p in pending)
+                linger = self.linger_s * (4.0 if self._degraded else 1.0)
+                if (len(pending) >= self.max_batch
+                        or oldest_slack <= self.flush_slack_s
+                        or age >= linger or self._stop.is_set()):
+                    self._dispatch(pending)
+                    pending = []
+                    continue
+                poll = max(0.0005, min(0.005, linger - age,
+                                       oldest_slack - self.flush_slack_s))
+            else:
+                if self._stop.is_set() and self._q.empty():
+                    return
+                poll = 0.005
+            try:
+                pending.append(self._q.get(timeout=poll))
+            except queue.Empty:
+                pass
+
+    def _dispatch(self, pending):
+        now = time.monotonic()
+        live = []
+        for p in pending:
+            if p.deadline <= now:
+                self._shed(p, "deadline_expired_in_queue")
+            else:
+                live.append(p)
+        if not live:
+            return
+        degraded = self._note_overload()
+        k = self.degraded_top_k if degraded else self.top_k
+        slot = self.corpus.active
+        if slot is None:
+            for p in live:
+                self._error(p, "no_corpus")
+            return
+        tags = []
+        if degraded:
+            tags.append("coarse_batching")
+            if k < self.top_k:
+                tags.append("topk_truncated")
+        if self.corpus.refreshing:
+            tags.append("stale_corpus")
+        tags = tuple(tags)
+        b = len(live)
+        target = min((s for s in self.buckets if s >= b),
+                     default=self.buckets[-1])
+        batch = np.zeros((max(target, b), live[0].query.shape[0]), np.float32)
+        for i, p in enumerate(live):
+            batch[i] = p.query
+        serve_fn = self._serve_fns[k]
+        t0 = time.monotonic()
+        try:
+            with telemetry.span("serve/batch",
+                                args={"n": b, "bucket": int(batch.shape[0]),
+                                      "k": k, "degraded": list(tags),
+                                      "corpus_version": slot.version}) as sp:
+                def call():
+                    _faults.fire("serve.batch", n=b)
+                    out = serve_fn(self.params, slot.emb, slot.valid, batch)
+                    jax.block_until_ready(out)
+                    return out
+
+                scores, indices = self.retry.run(call, site="serve.batch")
+                sp.fence_on(scores)
+        # jaxcheck: disable=R9 (nothing is swallowed: every request in the batch gets an explicit error Reply carrying this exception, counted in counts["errors"])
+        except Exception as exc:
+            detail = f"{type(exc).__name__}: {exc}"
+            for p in live:
+                self._error(p, detail)
+            return
+        wall = time.monotonic() - t0
+        with self._lock:
+            self.counts["batches"] += 1
+            self._floor_s = wall if self._floor_s == 0.0 else min(
+                self._floor_s, wall)
+        scores = np.asarray(scores)
+        indices = np.asarray(indices)
+        for i, p in enumerate(live):
+            self._reply(p, indices[i], scores[i], tags, slot.version)
+
+    def _note_overload(self):
+        """Degraded-mode hysteresis: enter past the watermark, leave when the
+        queue empties. Transitions are recorded — never silent."""
+        occupancy = self._q.qsize() / max(1, self.max_inflight)
+        if not self._degraded and occupancy >= self.overload_watermark:
+            self._degraded = True
+            self._record_event("degraded_enter", occupancy=round(occupancy, 3),
+                               top_k=self.degraded_top_k)
+        elif self._degraded and occupancy == 0.0:
+            self._degraded = False
+            self._record_event("degraded_exit", occupancy=0.0)
+        return self._degraded
+
+    def _record_event(self, event, **info):
+        with self._lock:
+            self.events.append({"event": event, "t": time.monotonic(), **info})
+
+    # ------------------------------------------------------------ terminals
+    def _finish(self, p, reply):
+        if not p.future._set(reply):
+            return p.future  # lost a shed/shed race: first decision stands
+        with self._lock:
+            key = {"ok": "replied", "shed": "shed", "error": "errors"}
+            self.counts[key[reply.status]] += 1
+            if reply.status == "ok":
+                if not reply.deadline_met:
+                    self.counts["deadline_missed"] += 1
+                self._latencies.append(reply.latency_s)
+                del self._latencies[:-_LATENCY_WINDOW]
+        # a zero-length per-request span: the request's terminal decision
+        # lands on the trace timeline next to the batch that produced it
+        with telemetry.span("serve/request", fence=False,
+                            args={"status": reply.status,
+                                  "reason": reply.reason,
+                                  "latency_ms": round(reply.latency_s * 1e3,
+                                                      3),
+                                  "degraded": list(reply.degraded)}):
+            pass
+        return p.future
+
+    def _reply(self, p, indices, scores, degraded, version):
+        now = time.monotonic()
+        return self._finish(p, Reply(
+            status="ok", indices=indices, scores=scores,
+            latency_s=now - p.t_submit, deadline_met=now <= p.deadline,
+            degraded=degraded, corpus_version=version))
+
+    def _shed(self, p, reason):
+        return self._finish(p, Reply(
+            status="shed", reason=reason,
+            latency_s=time.monotonic() - p.t_submit))
+
+    def _error(self, p, detail):
+        return self._finish(p, Reply(
+            status="error", reason=detail,
+            latency_s=time.monotonic() - p.t_submit))
+
+    # ------------------------------------------------------------ lifecycle
+    def warmup(self):
+        """Compile every (bucket, k) variant and seed the device floor, so
+        first requests measure dispatch, not tracing. One-time, blocking."""
+        slot = self.corpus.active
+        assert slot is not None, "swap a corpus in before warmup()"
+        f = int(self.config.n_features)
+        for k, fn in sorted(self._serve_fns.items()):
+            for b in self.buckets:
+                out = fn(self.params, slot.emb, slot.valid,
+                         np.zeros((b, f), np.float32))
+                jax.block_until_ready(out)
+        # floor := fastest warm repeat of the smallest variant
+        t0 = time.monotonic()
+        out = self._serve_fns[self.top_k](
+            self.params, slot.emb, slot.valid,
+            np.zeros((self.buckets[0], f), np.float32))
+        jax.block_until_ready(out)
+        self._floor_s = time.monotonic() - t0
+
+    def stop(self, timeout=5.0):
+        """Drain and join: the batcher flushes everything already admitted,
+        then exits; anything racing into the queue after is shed explicitly."""
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        while True:
+            try:
+                self._shed(self._q.get_nowait(), "shutdown")
+            except queue.Empty:
+                break
+
+    # ------------------------------------------------------------ reporting
+    def latency_stats(self):
+        with self._lock:
+            lat = np.asarray(self._latencies, np.float64)
+        if lat.size == 0:
+            return {"n": 0, "p50_ms": None, "p95_ms": None}
+        return {"n": int(lat.size),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+                "p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 3),
+                "mean_ms": round(float(lat.mean()) * 1e3, 3)}
+
+    def summary(self):
+        """Manifest fragment: counts, latency percentiles, degraded-mode and
+        corpus-swap ledgers, retry events — the never-silent record."""
+        with self._lock:
+            counts = dict(self.counts)
+            events = list(self.events)
+        return {"counts": counts, "latency": self.latency_stats(),
+                "degraded_events": events,
+                "corpus_events": list(self.corpus.events),
+                "retries": list(self.retry.events),
+                "buckets": list(self.buckets), "top_k": self.top_k,
+                "degraded_top_k": self.degraded_top_k,
+                "floor_ms": round(self._floor_s * 1e3, 3)}

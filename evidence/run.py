@@ -434,6 +434,38 @@ def main(argv=None):
 
         chaos_out = staged("chaos soak (8 seeded fault plans, crash-exact "
                            "resume)", _chaos)
+
+        def _chaos_serve():
+            # ISSUE 8 acceptance: seeded fault plans x overload traces
+            # against the full serving stack (serve/chaos_serve.py). Each
+            # plan asserts in-process: every submitted request ends in
+            # EXACTLY one of {reply, explicit shed, explicit error}; an
+            # injected serve.swap fault rolls back with the OLD corpus still
+            # serving; p95 stays within SLA even in degraded mode.
+            from dae_rnn_news_recommendation_tpu.serve import chaos_serve_soak
+
+            out = chaos_serve_soak(n_plans=6, n_requests=48, log=print)
+            return {"n_ok": out["n_ok"], "n_plans": out["n_plans"],
+                    "all_ok": out["all_ok"],
+                    "plans": [{"seed": r.seed, "ok": r.ok,
+                               "detail": r.detail,
+                               "n_submitted": r.n_submitted,
+                               "n_replied": r.n_replied,
+                               "n_shed": r.n_shed,
+                               "n_errors": r.n_errors,
+                               "n_unresolved": r.n_unresolved,
+                               "p95_ms": r.p95_ms,
+                               "degraded": r.degraded,
+                               "swap_faulted": r.swap_faulted,
+                               "swap_rolled_back": r.swap_rolled_back,
+                               "served_after_swap": r.served_after_swap,
+                               "n_injected": len(r.injected),
+                               "n_retries": len(r.retries),
+                               "duration_s": round(r.duration_s, 2)}
+                              for r in out["results"]]}
+
+        chaos_serve_out = staged("chaos-serve soak (6 seeded fault plans x "
+                                 "overload traces)", _chaos_serve)
     finally:
         os.chdir(cwd)
 
@@ -679,6 +711,41 @@ def main(argv=None):
              "; allclose is the bar off-CPU")
           + f"); {n_recorded}/{chaos_out['n_plans']} run manifests record "
           "their faults — zero silent recoveries")
+    sv_plans = chaos_serve_out["plans"]
+    n_leak = sum(1 for pl in sv_plans
+                 if pl["n_replied"] + pl["n_shed"] + pl["n_errors"]
+                 != pl["n_submitted"] or pl["n_unresolved"] > 0)
+    check("chaos_serve_reply_or_shed",
+          chaos_serve_out["all_ok"] and n_leak == 0,
+          f"{chaos_serve_out['n_ok']}/{chaos_serve_out['n_plans']} serve "
+          "fault plans passed; every submitted request ended in exactly one "
+          "of reply/shed/error across all plans — zero unresolved futures, "
+          "zero silent drops"
+          + ("" if n_leak == 0 else f" (OUTCOME LEAK in {n_leak} plans)"))
+    sv_swap = [pl for pl in sv_plans if pl["swap_faulted"]]
+    check("chaos_serve_swap_rollback",
+          bool(sv_swap) and all(pl["swap_rolled_back"]
+                                and pl["served_after_swap"]
+                                for pl in sv_swap),
+          (f"{len(sv_swap)} plans injected serve.swap faults; every one "
+           "rolled back (version unchanged, swap_rollback recorded) with "
+           "the old corpus still answering the post-swap probe")
+          if sv_swap else
+          "no plan exercised serve.swap — the 6-family round-robin should "
+          "always include seed 4's swap-fatal plan")
+    if platform == "tpu":
+        serve_qps = bench_extra.get("serve_queries_per_sec")
+        serve_p95 = bench_extra.get("serve_latency_p95_ms")
+        check("serve_bench_recorded",
+              serve_qps is not None and float(serve_qps) > 0
+              and serve_p95 is not None and float(serve_p95) > 0,
+              (f"bench sidecar serve_queries_per_sec {serve_qps} with "
+               f"p50/p95 {bench_extra.get('serve_latency_p50_ms')}/"
+               f"{serve_p95} ms (admission->microbatch->device->reply, "
+               "fenced per batch)") if serve_qps is not None else
+              ("evidence/bench_tpu.json has no serve_queries_per_sec — the "
+               "sidecar predates the serving corner; rerun bench.py on TPU "
+               "to capture it"))
     check("user_category_top1", user["category_top1_accuracy"] > 0.6,
           f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.6 "
           "(chance ~1/8; scored against 5-candidate category means — one "
@@ -725,6 +792,7 @@ def main(argv=None):
         "starspace": {"best_loss": ss_loss, "best_epoch": ss_epoch},
         "user_model": dict(user),
         "chaos_soak": chaos_out,
+        "chaos_serve_soak": chaos_serve_out,
         "checks": checks,
     }
     # the 3-seed spread behind the calibrated thresholds rides along in the
@@ -995,6 +1063,29 @@ def _write_md(p):
             lines.append(
                 f"| {pl['seed']} | {pl['ok']} | {pl['bitwise']} | "
                 f"{pl['restarts']} | {pl['n_injected']} | {pl['n_retries']} | "
+                f"{pl['duration_s']} |")
+    cs = p.get("chaos_serve_soak")
+    if cs:
+        lines += [
+            "",
+            "## Chaos-serve soak (serving subsystem)",
+            "",
+            f"{cs['n_ok']}/{cs['n_plans']} seeded fault plans x overload "
+            "traces against the deadline-aware serving stack "
+            "(docs/serving.md): every submitted request ends in exactly one "
+            "of reply / explicit shed / explicit error, injected serve.swap "
+            "faults roll back with the old corpus still serving, and p95 "
+            "stays within SLA even in degraded mode:",
+            "",
+            "| plan | ok | replied | shed | errors | swap fault | rolled "
+            "back | p95 ms | s |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for pl in cs["plans"]:
+            lines.append(
+                f"| {pl['seed']} | {pl['ok']} | {pl['n_replied']} | "
+                f"{pl['n_shed']} | {pl['n_errors']} | {pl['swap_faulted']} | "
+                f"{pl['swap_rolled_back']} | {pl['p95_ms']} | "
                 f"{pl['duration_s']} |")
     lines += ["", "## Checks", ""]
     for name, c in p["checks"].items():

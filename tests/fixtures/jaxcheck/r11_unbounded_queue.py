@@ -1,0 +1,61 @@
+"""Planted R11: unbounded queues and blocking get()/join() without timeouts
+in serve/feed loops — the exact failure shapes that deadlock a microbatcher
+or turn overload into silent unbounded buffering. Clean twins: bounded
+construction, timeout-polled gets with a liveness check, join(timeout=...),
+and a reasoned disable on a deliberately unbounded drained mailbox."""
+
+import queue
+import threading
+
+
+def unbounded_admission_queue():
+    q = queue.Queue()  # planted: R11
+    return q
+
+
+def blocking_consumer_loop(worker_alive):
+    q = queue.Queue(maxsize=8)
+    while True:
+        item = q.get()  # planted: R11
+        if item is None:
+            return
+
+
+def join_without_timeout(run):
+    q = queue.Queue(maxsize=4)
+    t = threading.Thread(target=run, args=(q,))
+    t.start()
+    t.join()  # planted: R11
+    return q
+
+
+# ---------------------------------------------------------------- clean twins
+
+def bounded_polling_consumer(stop):
+    q = queue.Queue(maxsize=8)
+    t = threading.Thread(target=stop.wait)
+    t.start()
+    while True:
+        try:
+            item = q.get(timeout=0.2)  # bounded poll + liveness check
+        except queue.Empty:
+            if not t.is_alive():
+                raise RuntimeError("producer died without its sentinel")
+            continue
+        if item is None:
+            break
+    t.join(timeout=5)  # bounded join: a wedged worker surfaces, not hangs
+
+
+def nonblocking_get(q):
+    while True:
+        try:
+            return q.get(block=False)
+        except queue.Empty:
+            return None
+
+
+def drained_result_mailbox(n_workers):
+    # jaxcheck: disable=R11 (result mailbox, not an admission queue: exactly n_workers puts happen and the caller drains all of them before returning)
+    box = queue.Queue()
+    return box

@@ -28,7 +28,8 @@ def bench():
 
 TINY = dict(batch=64, n_batches=2, warmup=1, prefetch=1,
             train_batch=32, train_steps=2, train_warmup=1,
-            stream_rows=128, stream_batch=64, stream_epochs=1)
+            stream_rows=128, stream_batch=64, stream_epochs=1,
+            serve_corpus=64, serve_requests=8)
 
 
 def test_bench_functions_produce_finite_rates(bench):

@@ -62,3 +62,58 @@ def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
     step = int(stopped[0].split()[1])
     assert 2 <= step < 500, joined[-1000:]  # stopped early, checkpoint present
     assert "stopping early" in joined
+
+
+def test_keyboard_interrupt_mid_epoch_saves_cursor_and_joins_feed(
+        tmp_path, monkeypatch, capsys):
+    """A KeyboardInterrupt that lands MID-epoch (past the graceful signal
+    handler: a second Ctrl-C, or one on the consumer thread) must stop the
+    pipelined feed (worker joined, not leaked), persist the epoch's progress
+    as a mid-epoch cursor checkpoint, and let fit() return normally."""
+    import glob
+    import threading
+
+    import scipy.sparse as sp
+
+    from dae_rnn_news_recommendation_tpu.models import DenoisingAutoencoder
+    from dae_rnn_news_recommendation_tpu.train import pipeline as pipeline_mod
+
+    monkeypatch.chdir(tmp_path)
+
+    class InterruptingFeed(pipeline_mod.PipelinedFeed):
+        """The real feed, but the consumer gets Ctrl-C'd after 2 batches."""
+
+        def __iter__(self):
+            for i, batch in enumerate(super().__iter__()):
+                if i == 2:
+                    raise KeyboardInterrupt
+                yield batch
+
+    # the estimator imports PipelinedFeed from train.pipeline at fit() time
+    monkeypatch.setattr(pipeline_mod, "PipelinedFeed", InterruptingFeed)
+    x = sp.random(100, 32, density=0.3, format="csr", random_state=0,
+                  dtype=np.float32)
+    m = DenoisingAutoencoder(
+        model_name="ki", main_dir="ki", n_components=4, num_epochs=5,
+        batch_size=10, opt="ada_grad", learning_rate=0.1, verbose=False,
+        seed=0, use_tensorboard=False, feed="pipelined",
+        triplet_strategy="none",
+        results_root=str(tmp_path / "results"))
+    m.fit(x)  # must RETURN, not propagate the interrupt
+    out = capsys.readouterr().out
+    assert "interrupted mid-epoch 1 at step 2" in out
+    assert "cursor checkpoint saved" in out
+    assert m._stop_requested  # epochs 2..5 never ran
+    # the cursor checkpoint is on disk (step_<E>_<2>) and resumable
+    cursors = glob.glob(os.path.join(m.model_path, "step_*_2"))
+    assert cursors, os.listdir(m.model_path)
+    from dae_rnn_news_recommendation_tpu.utils.checkpoint import (
+        load_checkpoint)
+    state = load_checkpoint(cursors[0], {"params": m.params,
+                                         "opt_state": m.opt_state,
+                                         "epoch": np.asarray(0)})
+    assert set(state) >= {"params", "opt_state"}
+    # the feed worker joined: nothing named pipelined-feed is left running
+    leaked = [t for t in threading.enumerate()
+              if t.name.startswith("pipelined-feed") and t.is_alive()]
+    assert leaked == []

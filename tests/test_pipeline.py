@@ -388,3 +388,48 @@ def test_epoch_cache_empty_seal_stays_not_ready():
     cache = EpochCache(10)
     cache.seal()
     assert not cache.ready
+
+
+def test_epoch_cache_budget_trip_mid_epoch_evicts_deterministically():
+    """The budget can trip MID-epoch, after real batches are already pinned.
+    The trip must evict EVERY pinned slot at once (weakref-observable — the
+    device buffers free with the refs), always at the same offer for the
+    same sequence, and later offers of the same epoch must stay no-ops: a
+    half-warm cache never survives to replay half an epoch."""
+    import gc
+    import weakref
+
+    from dae_rnn_news_recommendation_tpu.train.pipeline import EpochCache
+
+    class Staged:  # dicts can't be weakref'd; pinned batches can
+        def __init__(self, i):
+            self.i = i
+
+    def run_epoch(budget):
+        cache = EpochCache(budget)
+        refs, trip_at = [], None
+        for i, nbytes in enumerate([100, 100, 100, 100, 100]):
+            b = Staged(i)
+            refs.append(weakref.ref(b))
+            cache.offer(b, nbytes)
+            del b
+            if cache.disabled and trip_at is None:
+                trip_at = i
+        return cache, refs, trip_at
+
+    cache, refs, trip_at = run_epoch(250)
+    assert trip_at == 2  # first offer that crosses 250, never earlier/later
+    assert cache.disabled and "budget" in cache.disabled_reason
+    assert cache.n_batches == 0 and cache.nbytes == 0
+    gc.collect()
+    assert all(r() is None for r in refs)  # nothing keeps a slot alive
+    # the epoch keeps running: offers 3 and 4 already happened post-trip and
+    # stayed no-ops; sealing the "complete" epoch must not resurrect it
+    cache.seal()
+    assert not cache.ready
+    with pytest.raises(AssertionError):
+        next(cache.replay())
+    # determinism: the same sequence trips at the same slot every time
+    for _ in range(3):
+        _, _, again = run_epoch(250)
+        assert again == trip_at

@@ -198,6 +198,82 @@ def test_retry_events_mirror_into_active_injector():
     assert [e["site"] for e in inj.retries] == ["feed.h2d", "ckpt.save"]
 
 
+def test_retry_full_jitter_draws_within_the_base_delay():
+    """Each sleep is uniform in [0, base]: the event records both the
+    deterministic base (`backoff_s`) and the drawn value (`sleep_s`), and an
+    injected rng makes the schedule exactly reproducible."""
+    draws = iter([0.5, 0.25])
+    slept = []
+
+    def always():
+        raise TransientFault("blip")
+
+    policy = RetryPolicy(max_attempts=3, backoff_s=0.08, factor=2.0,
+                         sleep=slept.append, rng=lambda: next(draws))
+    with pytest.raises(TransientFault):
+        policy.run(always, site="serve.batch")
+    assert [e["backoff_s"] for e in policy.events] == [0.08, 0.16]
+    assert [e["sleep_s"] for e in policy.events] == [0.04, 0.04]
+    assert slept == [pytest.approx(0.04), pytest.approx(0.04)]
+    for e in policy.events:
+        assert 0.0 <= e["sleep_s"] <= e["backoff_s"]
+
+
+def test_retry_jitter_off_restores_the_deterministic_schedule():
+    slept = []
+
+    def always():
+        raise TransientFault("blip")
+
+    policy = RetryPolicy(max_attempts=3, backoff_s=0.05, jitter=False,
+                         sleep=slept.append,
+                         rng=lambda: 1 / 0)  # must never be consulted
+    with pytest.raises(TransientFault):
+        policy.run(always)
+    assert slept == [pytest.approx(0.05), pytest.approx(0.1)]
+
+
+def test_retry_cumulative_cap_trips_recorded_and_propagates():
+    """`max_elapsed_s` bounds TOTAL backoff sleep: once the next sleep would
+    cross it, the original failure propagates immediately — but the trip is
+    recorded in policy.events and the active injector first (never silent)."""
+    slept = []
+
+    def always():
+        raise TransientFault("persistent blip")
+
+    plan = FaultPlan(seed=0, specs=())
+    inj = FaultInjector(plan)
+    policy = RetryPolicy(max_attempts=10, backoff_s=0.1, factor=2.0,
+                         jitter=False, max_elapsed_s=0.25,
+                         sleep=slept.append)
+    with faults_mod.install(inj):
+        with pytest.raises(TransientFault, match="persistent blip"):
+            policy.run(always, site="serve.batch")
+    # sleeps 0.1 then 0.2 would total 0.3 > 0.25: only the first happens
+    assert slept == [pytest.approx(0.1)]
+    trip = policy.events[-1]
+    assert trip["cap_tripped"] is True
+    assert trip["max_elapsed_s"] == pytest.approx(0.25)
+    assert trip["elapsed_s"] == pytest.approx(0.1)
+    assert inj.retries[-1].get("cap_tripped") is True
+
+
+def test_retry_cap_never_trips_under_budget():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientFault("blip")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, backoff_s=0.01, jitter=False,
+                         max_elapsed_s=10.0, sleep=_no_sleep)
+    assert policy.run(flaky) == "ok"
+    assert not any(e.get("cap_tripped") for e in policy.events)
+
+
 # -------------------------------------------------------- feed propagation
 
 def _batches(n, rows=4, cols=6):

@@ -1,0 +1,336 @@
+"""Serving-path contracts: graph correctness, deadline admission, degraded
+modes, and the health-gated hot corpus swap (ISSUE 8 tentpole).
+
+The invariant every test leans on: a submitted request ends in EXACTLY ONE of
+{reply, explicit shed, explicit error} — never a hang, never a silent drop.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dae_rnn_news_recommendation_tpu.models.dae_core import (DAEConfig,
+                                                             init_params)
+from dae_rnn_news_recommendation_tpu.reliability import faults
+from dae_rnn_news_recommendation_tpu.reliability.retry import RetryPolicy
+from dae_rnn_news_recommendation_tpu.serve import (RecommendationService,
+                                                   ServingCorpus,
+                                                   make_serve_fn)
+
+N, F, D = 64, 24, 8
+SLA = 10.0  # generous: CPU test boxes stall; admission logic is what's tested
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = DAEConfig(n_features=F, n_components=D,
+                       triplet_strategy="none", corr_frac=0.0)
+    params = init_params(jax.random.PRNGKey(3), config)
+    articles = np.random.default_rng(3).random((N, F), dtype=np.float32)
+    return config, params, articles
+
+
+def make_corpus(config, params, articles, **kw):
+    corpus = ServingCorpus(config, block=16, **kw)
+    corpus.swap(params, articles, note="initial")
+    return corpus
+
+
+def make_service(config, params, corpus, **kw):
+    kw.setdefault("top_k", 5)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_inflight", 16)
+    svc = RecommendationService(params, config, corpus, **kw)
+    svc.warmup()
+    return svc
+
+
+# ------------------------------------------------------------------- graph
+
+def test_topk_graph_matches_numpy_ranking(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    slot = corpus.active
+    fn = make_serve_fn(config, 7)
+    queries = articles[:5]
+    scores, idx = jax.device_get(
+        fn(params, slot.emb, slot.valid, queries))
+    # oracle: encode everything densely on host via the same jitted encode
+    from dae_rnn_news_recommendation_tpu.train.step import make_encode_fn
+
+    enc = make_encode_fn(config)
+    unit = lambda h: h / (np.linalg.norm(h, axis=-1, keepdims=True) + 1e-9)
+    emb = unit(np.asarray(jax.device_get(enc(params, articles))))
+    qh = unit(np.asarray(jax.device_get(enc(params, queries))))
+    oracle = (qh @ emb.T).argsort(axis=1)[:, ::-1][:, :7]
+    np.testing.assert_array_equal(idx, oracle)
+    assert np.all(np.diff(scores, axis=1) <= 1e-6)  # descending
+
+
+def test_query_of_a_corpus_row_ranks_itself_first(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    svc = make_service(config, params, corpus)
+    try:
+        fut = svc.submit(articles[11], deadline_s=SLA)
+        reply = fut.result(timeout=SLA)
+        assert reply.ok and reply.indices[0] == 11
+        assert reply.deadline_met and reply.degraded == ()
+        assert reply.corpus_version == corpus.version
+    finally:
+        svc.stop()
+
+
+# --------------------------------------------------------------- admission
+
+def test_every_submission_gets_exactly_one_outcome(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    svc = make_service(config, params, corpus)
+    try:
+        futs = [svc.submit(articles[i % N], deadline_s=SLA)
+                for i in range(40)]
+        replies = [f.result(timeout=SLA) for f in futs]
+    finally:
+        svc.stop()
+    c = svc.counts
+    assert c["submitted"] == 40
+    assert c["replied"] + c["shed"] + c["errors"] == 40
+    assert all(r.status in ("ok", "shed", "error") for r in replies)
+    # a shed is never anonymous
+    assert all(r.reason for r in replies if r.status == "shed")
+
+
+def test_provably_unmeetable_deadline_is_shed_at_admission(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    svc = make_service(config, params, corpus)
+    try:
+        assert svc._floor_s > 0  # warmup seeded the proof floor
+        reply = svc.submit(articles[0], deadline_s=1e-9).result(timeout=SLA)
+        assert reply.status == "shed"
+        assert reply.reason == "deadline_unmeetable"
+    finally:
+        svc.stop()
+
+
+def test_queue_overflow_sheds_instead_of_buffering(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    # a 2-deep admission queue and a batcher parked behind a slow flush
+    svc = make_service(config, params, corpus, max_inflight=2,
+                       linger_s=0.5, flush_slack_s=0.01)
+    try:
+        futs = [svc.submit(articles[i % N], deadline_s=SLA)
+                for i in range(12)]
+        replies = [f.result(timeout=SLA) for f in futs]
+    finally:
+        svc.stop()
+    sheds = [r for r in replies if r.status == "shed"]
+    assert any(r.reason == "queue_full" for r in sheds)
+    assert all(r.status in ("ok", "shed") for r in replies)
+
+
+def test_stop_resolves_everything_still_queued(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    svc = make_service(config, params, corpus)
+    futs = [svc.submit(articles[i % N], deadline_s=SLA) for i in range(6)]
+    svc.stop()
+    replies = [f.result(timeout=5) for f in futs]  # nothing may hang
+    assert all(r.status in ("ok", "shed") for r in replies)
+    post = svc.submit(articles[0], deadline_s=SLA).result(timeout=5)
+    assert post.status == "shed" and post.reason == "shutdown"
+    assert not svc._thread.is_alive()
+
+
+# ------------------------------------------------------------ fault injection
+
+def test_transient_batch_fault_is_retried_and_recorded(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    plan = faults.FaultPlan(seed=0, specs=(
+        faults.FaultSpec("serve.batch", 1, "transient"),))
+    inj = faults.FaultInjector(plan)
+    svc = make_service(config, params, corpus, retry=RetryPolicy(
+        max_attempts=3, backoff_s=0.001, rng=lambda: 1.0))
+    try:
+        with faults.install(inj):
+            reply = svc.submit(articles[4], deadline_s=SLA).result(
+                timeout=SLA)
+        assert reply.ok and reply.indices[0] == 4  # absorbed, answer intact
+        assert [e["site"] for e in inj.retries] == ["serve.batch"]
+        assert inj.fired and inj.fired[0]["kind"] == "transient"
+    finally:
+        svc.stop()
+
+
+def test_fatal_batch_fault_is_an_explicit_error_not_a_hang(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    plan = faults.FaultPlan(seed=0, specs=(
+        faults.FaultSpec("serve.batch", 1, "fatal"),))
+    svc = make_service(config, params, corpus)
+    try:
+        with faults.install(faults.FaultInjector(plan)):
+            reply = svc.submit(articles[0], deadline_s=SLA).result(
+                timeout=SLA)
+        assert reply.status == "error"
+        assert "InjectedFault" in reply.reason
+        # the service keeps serving after the fault
+        again = svc.submit(articles[1], deadline_s=SLA).result(timeout=SLA)
+        assert again.ok
+    finally:
+        svc.stop()
+
+
+def test_fatal_enqueue_fault_is_an_explicit_error(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    plan = faults.FaultPlan(seed=0, specs=(
+        faults.FaultSpec("serve.enqueue", 1, "fatal"),))
+    svc = make_service(config, params, corpus)
+    try:
+        with faults.install(faults.FaultInjector(plan)):
+            reply = svc.submit(articles[0], deadline_s=SLA).result(
+                timeout=SLA)
+        assert reply.status == "error" and "serve.enqueue" in reply.reason
+    finally:
+        svc.stop()
+
+
+# ------------------------------------------------------------ degraded modes
+
+def test_overload_enters_recorded_degraded_mode_with_truncated_topk(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    # max_batch=1 so the batcher dispatches one request at a time, and an
+    # injected transient on the FIRST dispatch makes its retry sleep 0.3 s —
+    # a deterministic stall during which the remaining submissions pile up
+    # past the watermark, so the next dispatch provably runs degraded.
+    svc = make_service(config, params, corpus, top_k=6, degraded_top_k=2,
+                       max_batch=1, max_inflight=16,
+                       overload_watermark=0.5, linger_s=0.001,
+                       flush_slack_s=0.001,
+                       retry=RetryPolicy(max_attempts=3, backoff_s=0.3,
+                                         rng=lambda: 1.0))
+    plan = faults.FaultPlan(seed=0, specs=(
+        faults.FaultSpec("serve.batch", 1, "transient"),))
+    try:
+        with faults.install(faults.FaultInjector(plan)):
+            futs = [svc.submit(articles[i % N], deadline_s=SLA)
+                    for i in range(12)]
+            replies = [f.result(timeout=SLA) for f in futs]
+    finally:
+        svc.stop()
+    degraded = [r for r in replies
+                if r.ok and "topk_truncated" in r.degraded]
+    assert degraded, "overload never engaged the degraded mode"
+    assert all(len(r.indices) == 2 for r in degraded)
+    assert all("coarse_batching" in r.degraded for r in degraded)
+    events = [e["event"] for e in svc.events]
+    assert "degraded_enter" in events  # recorded, never silent
+    assert any(e["event"] == "degraded_enter" and "occupancy" in e
+               for e in svc.events)
+
+
+def test_swap_during_serving_tags_stale_and_promotes(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    svc = make_service(config, params, corpus)
+    try:
+        v0 = corpus.version
+        fresh = np.random.default_rng(9).random((N, F), dtype=np.float32)
+        stale_seen = []
+
+        def swapper():
+            corpus.swap(params, fresh, note="refresh")
+
+        t = threading.Thread(target=swapper)
+        t.start()
+        while t.is_alive():
+            r = svc.submit(articles[0], deadline_s=SLA).result(timeout=SLA)
+            if r.ok and "stale_corpus" in r.degraded:
+                stale_seen.append(r)
+        t.join(timeout=10)
+        assert corpus.version == v0 + 1
+        assert any(e["event"] == "swap" for e in corpus.events)
+        # post-swap replies come from the new version
+        r = svc.submit(fresh[7], deadline_s=SLA).result(timeout=SLA)
+        assert r.ok and r.corpus_version == v0 + 1 and r.indices[0] == 7
+    finally:
+        svc.stop()
+
+
+# ----------------------------------------------------------------- hot swap
+
+def test_injected_swap_fault_rolls_back_to_the_serving_corpus(setup):
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    svc = make_service(config, params, corpus)
+    try:
+        v0 = corpus.version
+        plan = faults.FaultPlan(seed=0, specs=(
+            faults.FaultSpec("serve.swap", 1, "fatal"),))
+        fresh = np.random.default_rng(10).random((N, F), dtype=np.float32)
+        with faults.install(faults.FaultInjector(plan)):
+            slot = corpus.swap(params, fresh, note="doomed")
+        assert corpus.version == v0  # rollback: version unchanged
+        assert slot is corpus.active
+        rb = [e for e in corpus.events if e["event"] == "swap_rollback"]
+        assert rb and "InjectedFault" in rb[0]["error"]
+        # the OLD corpus still serves
+        r = svc.submit(articles[5], deadline_s=SLA).result(timeout=SLA)
+        assert r.ok and r.indices[0] == 5 and r.corpus_version == v0
+    finally:
+        svc.stop()
+
+
+def test_health_gate_refuses_a_collapsed_corpus(setup):
+    config, params, articles = setup
+    # every article identical -> every embedding identical -> mean pairwise
+    # cosine 1 > ceiling: the textbook collapse the gate exists to refuse
+    collapsed = np.tile(articles[:1], (N, 1))
+    corpus = make_corpus(config, params, articles)
+    v0 = corpus.version
+    slot = corpus.swap(params, collapsed, note="collapsed")
+    assert corpus.version == v0 and slot is corpus.active
+    rb = [e for e in corpus.events if e["event"] == "swap_rollback"]
+    assert rb and "health gate" in rb[0]["error"]
+
+
+def test_failed_first_swap_raises_with_nothing_to_serve(setup):
+    config, params, articles = setup
+    corpus = ServingCorpus(config, block=16)
+    plan = faults.FaultPlan(seed=0, specs=(
+        faults.FaultSpec("serve.swap", 1, "fatal"),))
+    with faults.install(faults.FaultInjector(plan)):
+        with pytest.raises(faults.InjectedFault):
+            corpus.swap(params, articles, note="first")
+    assert corpus.active is None
+
+
+# ---------------------------------------------------------------- telemetry
+
+def test_serving_emits_fenced_batch_spans_and_request_spans(setup):
+    import dae_rnn_news_recommendation_tpu.telemetry as telemetry
+
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    svc = make_service(config, params, corpus)
+    telemetry.enable()
+    try:
+        svc.submit(articles[0], deadline_s=SLA).result(timeout=SLA)
+        time.sleep(0.05)  # let the batcher's span land
+    finally:
+        svc.stop()
+        tracer = telemetry.disable()
+    names = [e["name"] for e in tracer.events()]
+    assert "serve/batch" in names
+    assert "serve/request" in names
+    batch = next(e for e in tracer.events() if e["name"] == "serve/batch")
+    assert batch["args"]["n"] == 1 and batch["args"]["k"] == 5

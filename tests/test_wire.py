@@ -287,3 +287,59 @@ def test_wire_cache_requires_repeating_batch_order(workdir):
     m = _fit(workdir, "shuf", wire_feed="f32",
              wire_cache_budget_bytes=1 << 30, shuffle=True)
     assert m._wire_cache is None  # shuffle on: epoch 2 needs a new order
+
+
+# --------------------------------------------------------- batcher edges
+
+def test_wire_batcher_all_empty_rows_batch_is_inert():
+    """A batch whose rows are ALL empty (every article filtered out, or a
+    zero stripe of the corpus) must ship a fully zeroed payload — words,
+    first, nnz, values — and unpack to pure padding, exactly like the
+    all-zero row the codec round-trips inside a mixed batch."""
+    from dae_rnn_news_recommendation_tpu.data.batcher import (
+        WireSparseIngestBatcher)
+
+    dense = np.zeros((6, 300), np.float32)
+    dense[:3] = sp.random(3, 300, density=0.1, format="csr", random_state=4,
+                          dtype=np.float32).toarray()  # rows 3-5 stay empty
+    csr = sp.csr_matrix(dense)
+    batcher = WireSparseIngestBatcher(batch_size=3, shuffle=False)
+    batches = list(batcher.epoch(csr, labels=np.arange(6)))
+    assert len(batches) == 2
+    empty = batches[1]  # rows 3..5: no padding, just genuinely empty rows
+    spec = empty["x_wire_spec"]
+    assert not empty["x_wire_words"].any()
+    assert not empty["x_wire_first"].any()
+    assert not empty["x_wire_nnz"].any()
+    assert not empty["x_wire_values"].any()
+    assert empty["row_valid"].all()  # empty != padded: rows are real
+    np.testing.assert_array_equal(empty["labels"], [3, 4, 5])
+    # unpack: every slot is the inert pad column with a zero value
+    packed = {k[len("x_wire_"):]: v for k, v in empty.items()
+              if k.startswith("x_wire_")}
+    out = wire.unpack_wire_host(packed)
+    assert (out["indices"] == spec.pad_index).all()
+    assert not out["values"].any()
+    ref = pad_csr_batch(csr[3:6], k=out["k"])
+    np.testing.assert_array_equal(out["indices"], ref["indices"])
+    np.testing.assert_array_equal(out["values"], ref["values"])
+
+
+def test_wire_batcher_all_empty_rows_batch_is_inert_quantized():
+    # same contract under i8: the per-row scale must stay a safe nonzero
+    from dae_rnn_news_recommendation_tpu.data.batcher import (
+        WireSparseIngestBatcher)
+
+    dense = np.zeros((4, 128), np.float32)
+    dense[0, 5] = 0.7
+    csr = sp.csr_matrix(dense)
+    batcher = WireSparseIngestBatcher(batch_size=2, shuffle=False,
+                                      wire_mode="i8")
+    empty = list(batcher.epoch(csr))[1]
+    assert not empty["x_wire_nnz"].any()
+    assert not empty["x_wire_values"].any()
+    assert np.isfinite(empty["x_wire_scale"]).all()
+    packed = {k[len("x_wire_"):]: v for k, v in empty.items()
+              if k.startswith("x_wire_")}
+    out = wire.unpack_wire_host(packed)
+    assert not out["values"].any()
