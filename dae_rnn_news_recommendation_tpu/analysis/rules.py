@@ -1341,3 +1341,126 @@ def check_r11(ctx):
 
     visit(ctx.tree, in_loop=False)
     return out
+
+
+# ------------------------------------------------------------------- R12
+
+_R12_LOW_DTYPES = {"bfloat16", "int8", "float16", "int4",
+                   "float8_e4m3fn", "float8_e5m2"}
+_R12_DTYPE_CTORS = {"jnp.dtype", "jax.numpy.dtype", "np.dtype",
+                    "numpy.dtype"}
+_R12_MATMUL_CALLS = {"jnp.matmul", "jnp.dot", "jnp.einsum", "jnp.tensordot",
+                     "jax.numpy.matmul", "jax.numpy.dot", "jax.numpy.einsum",
+                     "jax.numpy.tensordot", "lax.dot", "lax.dot_general",
+                     "jax.lax.dot", "jax.lax.dot_general"}
+
+
+def _r12_dtype_is_low(node, low_dtype_names):
+    """True when a dtype expression may name a sub-fp32 type: a low literal
+    (`jnp.bfloat16`, `"int8"`) or a variable bound from `jnp.dtype(...)` in
+    this scope (a config-driven compute dtype is *statically maybe-low*; R12
+    treats maybe as yes — the escape hatches are an explicit
+    `preferred_element_type` or a reasoned disable)."""
+    if node is None:
+        return False
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str) and node.value in _R12_LOW_DTYPES
+    d = dotted(node)
+    if d is None:
+        return False
+    return d.split(".")[-1] in _R12_LOW_DTYPES or d in low_dtype_names
+
+
+def _r12_scope_evidence(root):
+    """(low_dtype_names, low_value_names) bound in THIS scope only.
+
+    low_dtype_names: names assigned from `jnp.dtype(<non-constant>)` or
+    `jnp.dtype("<low literal>")` — the repo's `dt = jnp.dtype(
+    config.compute_dtype)` idiom lands here.
+    low_value_names: names assigned from `<expr>.astype(<maybe-low dtype>)`
+    or from a call carrying a `dtype=<maybe-low>` keyword (densify/ones/...
+    builders that materialize directly in the compute dtype).
+
+    Two passes, because `scope_walk` order is not source order: dtype
+    bindings must be complete before value bindings consult them."""
+    assigns = [n for n in scope_walk(root)
+               if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)]
+    low_dtypes, low_values = set(), set()
+    for node in assigns:
+        call = node.value
+        if call_name(call) in _R12_DTYPE_CTORS and call.args:
+            arg = call.args[0]
+            if (not isinstance(arg, ast.Constant)
+                    or _r12_dtype_is_low(arg, low_dtypes)):
+                low_dtypes.update(d for t in node.targets
+                                  if (d := dotted(t)))
+    for node in assigns:
+        call = node.value
+        if ((isinstance(call.func, ast.Attribute)
+             and call.func.attr == "astype" and call.args
+             and _r12_dtype_is_low(call.args[0], low_dtypes))
+                or _r12_dtype_is_low(_kw(call, "dtype"), low_dtypes)):
+            low_values.update(d for t in node.targets if (d := dotted(t)))
+    return low_dtypes, low_values
+
+
+def _r12_operand_low(node, low_dtypes, low_values):
+    """True when a matmul operand visibly carries a maybe-low dtype: an
+    inline `.astype(low)` (possibly behind a `.T`/`.mT` transpose) or a name
+    bound from one in this scope."""
+    while isinstance(node, ast.Attribute) and node.attr in ("T", "mT"):
+        node = node.value
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype" and node.args):
+        return _r12_dtype_is_low(node.args[0], low_dtypes)
+    d = dotted(node)
+    return d is not None and d in low_values
+
+
+@rule("R12", "low-precision matmul without preferred_element_type")
+def check_r12(ctx):
+    """A bf16/int8-input matmul accumulates (and returns) in the input dtype
+    unless told otherwise: on TPU the MXU takes bf16/int8 operands but only
+    keeps its fp32 accumulator when the HLO dot carries
+    `preferred_element_type=f32`. Without it, `jnp.matmul(x.astype(bf16), w)`
+    rounds every partial sum to 8 mantissa bits — a silent recall cliff at
+    serving k (the int8 corpus path is only rank-preserving because
+    ops/topk_fused accumulates f32). Flagged: `jnp.matmul/dot/einsum/
+    tensordot` and `lax.dot/dot_general` calls where an operand is visibly
+    cast to (or built in) a maybe-sub-fp32 dtype — including the repo's
+    `dt = jnp.dtype(config.compute_dtype)` binding idiom — and no
+    `preferred_element_type` keyword is present; plus the `@` operator on
+    such operands, which cannot carry the keyword at all. Sites where narrow
+    accumulation IS the contract (e.g. dae_core's compute-dtype parity with
+    the reference model) carry a reasoned `# jaxcheck: disable=R12`."""
+    out = []
+    scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.Lambda))]
+    for root in scopes:
+        low_dtypes, low_values = _r12_scope_evidence(root)
+
+        def low(arg, _ld=low_dtypes, _lv=low_values):
+            return _r12_operand_low(arg, _ld, _lv)
+
+        for node in scope_walk(root):
+            if (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.MatMult)
+                    and (low(node.left) or low(node.right))):
+                out.append(ctx.finding(
+                    node, "`@` on a low-precision operand accumulates in "
+                    "that dtype and the operator cannot carry "
+                    "preferred_element_type — rewrite as jnp.matmul(..., "
+                    "preferred_element_type=jnp.float32)"))
+            elif (isinstance(node, ast.Call)
+                    and call_name(node) in _R12_MATMUL_CALLS
+                    and _kw(node, "preferred_element_type") is None
+                    and any(low(a) for a in node.args)):
+                out.append(ctx.finding(
+                    node, f"`{call_name(node)}` with a low-precision "
+                    "operand and no preferred_element_type: partial sums "
+                    "round to the input dtype — pass preferred_element_type"
+                    "=jnp.float32 (or carry a reasoned disable where narrow "
+                    "accumulation is the numerical contract)"))
+    return out

@@ -94,6 +94,7 @@ def encode(params, x, config):
     act = resolve_activation(config.enc_act_func)
     dt = jnp.dtype(config.compute_dtype)
     w = params["W"].astype(dt)
+    # jaxcheck: disable=R12 (compute_dtype is the numerical contract: bf16 rounding of the pre-activation is what the reference-parity tests pin; output is cast back to f32 and serving re-ranks in f32 via ops/topk_fused)
     h = jnp.matmul(x.astype(dt), w, precision=_precision(config)).astype(jnp.float32)
     h = h + params["bh"]
     return act(h) - act(params["bh"])
@@ -104,6 +105,7 @@ def decode(params, h, config):
     act = resolve_activation(config.dec_act_func)
     dt = jnp.dtype(config.compute_dtype)
     w = params["W"].astype(dt)
+    # jaxcheck: disable=R12 (same compute_dtype contract as encode: the decode matmul must round like the reference model; forcing f32 accumulation here would break bf16/f32 parity tests)
     y = jnp.matmul(h.astype(dt), w.T, precision=_precision(config)).astype(jnp.float32)
     return act(y + params["bv"])
 

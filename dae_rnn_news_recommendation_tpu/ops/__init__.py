@@ -14,6 +14,7 @@ from .corruption import (  # noqa: F401
     masking_noise_sparse_host,
 )
 from .losses import reconstruction_loss_per_row, weighted_loss, LOSS_FUNCS  # noqa: F401
+from .normalize import l2_normalize, NORMALIZE_EPS  # noqa: F401
 from .sparse_ingest import (  # noqa: F401
     pad_csr_batch,
     sparse_encode_matmul,
@@ -30,13 +31,18 @@ from .triplet import (  # noqa: F401
 )
 _PALLAS_EXPORTS = ("batch_all_triplet_loss_pallas", "masking_noise_pallas")
 
+# topk_fused lives in its own module but is lazy for the same reason: its
+# import pulls jax.experimental.pallas
+_TOPK_EXPORTS = ("topk_fused",)
+
 # __all__ lists only the eager names: a star-import must not trigger __getattr__,
 # which would eagerly pull in jax.experimental.pallas. __dir__ still advertises
 # the Pallas names for completion.
 __all__ = [
     "xavier_init", "masking_noise", "salt_and_pepper_noise", "decay_noise",
     "corrupt", "masking_noise_sparse_host", "reconstruction_loss_per_row",
-    "weighted_loss", "LOSS_FUNCS", "pad_csr_batch", "sparse_encode_matmul",
+    "weighted_loss", "LOSS_FUNCS", "l2_normalize", "NORMALIZE_EPS",
+    "pad_csr_batch", "sparse_encode_matmul",
     "densify_on_device", "sparse_encode", "anchor_positive_mask",
     "anchor_negative_mask", "triplet_mask", "batch_all_triplet_loss",
     "batch_hard_triplet_loss", "precomputed_triplet_loss",
@@ -50,8 +56,12 @@ def __getattr__(name):
         from . import pallas_kernels
 
         return getattr(pallas_kernels, name)
+    if name in _TOPK_EXPORTS:
+        from . import topk_fused
+
+        return getattr(topk_fused, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def __dir__():
-    return sorted(set(globals()) | set(_PALLAS_EXPORTS))
+    return sorted(set(globals()) | set(_PALLAS_EXPORTS) | set(_TOPK_EXPORTS))

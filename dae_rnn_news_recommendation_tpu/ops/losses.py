@@ -15,15 +15,11 @@ keep XLA shapes static without changing the math.
 
 import jax.numpy as jnp
 
+from .normalize import l2_normalize as _l2_normalize
+
 LOSS_FUNCS = ("cross_entropy", "mean_squared", "cosine_proximity")
 
 _EPS = 1e-16
-
-
-def _l2_normalize(x, axis=-1, eps=1e-12):
-    # matches tf.nn.l2_normalize: x * rsqrt(max(sum(x^2), eps))
-    sq = jnp.sum(jnp.square(x), axis=axis, keepdims=True)
-    return x * jnp.reciprocal(jnp.sqrt(jnp.maximum(sq, eps)))
 
 
 def reconstruction_loss_per_row(x, decode, loss_func="cross_entropy"):

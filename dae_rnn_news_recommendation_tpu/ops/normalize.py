@@ -1,0 +1,28 @@
+"""The one L2-normalize used by every cosine surface in the repo.
+
+Before r09 three copies had drifted: serve/graph divided by `norm + 1e-9`,
+while ops/losses (cosine-proximity loss) and parallel/ring (ring similarity)
+both used the tf.nn.l2_normalize form with eps 1e-12. Cosine scores compared
+across those paths (serving top-k vs mining similarity vs eval) were computed
+under two different epsilons — invisible at fp32 for healthy embeddings, but a
+real divergence for near-zero rows. One helper, one epsilon, pinned by test.
+
+tf.nn.l2_normalize form on purpose: `x * rsqrt(max(sum(x^2), eps))` maps an
+exactly-zero row to exactly zero (0 * rsqrt(eps)), whereas the `x / (norm+eps)`
+form does so only approximately and changes every healthy row by O(eps/norm).
+"""
+
+import jax.numpy as jnp
+
+# the reference epsilon (tf.nn.l2_normalize default), shared by serving,
+# mining, eval and the ring similarity — pinned by tests/test_ops.py
+NORMALIZE_EPS = 1e-12
+
+
+def l2_normalize(x, axis=-1, eps=NORMALIZE_EPS):
+    """tf.nn.l2_normalize: x * rsqrt(max(sum(x^2, axis), eps)).
+
+    Zero rows map to zero rows (not NaN); everything else to unit L2 norm.
+    """
+    sq = jnp.sum(jnp.square(x), axis=axis, keepdims=True)
+    return x * jnp.reciprocal(jnp.sqrt(jnp.maximum(sq, eps)))

@@ -13,6 +13,19 @@
    (pltpu.prng_seed / prng_random_bits): one read-mask-write pass with on-chip
    randomness instead of counter-based threefry bit generation.
 
+3. `topk_fused` (lives in ops/topk_fused.py, registered here) — the serving
+   scorer: cosine scores + running top-k in one kernel. The [N_pad, D] corpus
+   streams through VMEM in panels along the innermost grid axis while a
+   [bq, 128] score/index accumulator pair rides the output revisit guarantee
+   (consecutive same-index steps), so the [B, N] score matrix never exists in
+   HBM — the unfused serve graph materializes it at 4·B·N bytes per batch and
+   reads it back through lax.top_k. int8/bf16 corpora dot in fp32 via
+   `preferred_element_type` with per-row scales applied post-dot. Parity
+   contract (tests/test_topk_fused.py): bitwise scores and tie-exact indices
+   vs masked-matmul + `lax.top_k`, including all-rows-invalid and k>n_valid.
+   Off-TPU it lowers to exactly that reference graph (serve keeps one code
+   path; see docs/serving.md).
+
 STATUS: DISPATCHED AT LARGE BATCH / ON-TPU MASKING (promoted round 6 for the
 regimes the dense path cannot reach; small-batch mining stays on XLA). The
 round-3/5 measurements stand: on a real v5e-1 XLA wins dense-representable

@@ -240,6 +240,7 @@ def sparse_encode(params, indices, values, config, chunk=256, via_dense=False):
                                   dtype=dt)[:, :f]
         else:
             x = densify_on_device(indices, values, f, dtype=dt)
+        # jaxcheck: disable=R12 (via_dense is the parity oracle for the sparse kernel: it must accumulate exactly like dae_core.encode's compute_dtype matmul, narrow rounding included)
         pre = jnp.matmul(x, w, precision=_precision(config))
     else:
         if values is None:

@@ -1,0 +1,194 @@
+"""Fused cosine->top-k: score a corpus panel-by-panel in VMEM, never HBM.
+
+The r07 serving graph answered a microbatch with `h @ emb.T` followed by
+`lax.top_k` — which materializes the full [B, N] score matrix in HBM (N is
+the corpus; at paper scale that intermediate dwarfs the embeddings it was
+computed from, the exact assumed-dense tensor PAPERS.md's Sparton/densifying
+papers warn about). This kernel streams the [N_pad, D] corpus through VMEM in
+[block, D] panels and carries a per-query top-k accumulator across panels:
+
+  grid (B_pad/bq, N_pad/block), panel axis INNERMOST — compiled Pallas TPU
+  only guarantees an output block survives across CONSECUTIVE same-index grid
+  steps (see ops/pallas_kernels.py's bwd kernels for the probed rule), and the
+  accumulator is exactly such an output block, revisited once per panel.
+
+Per step: one [bq, D] x [D, block] MXU dot (f32 accumulation forced via
+`preferred_element_type` whatever the corpus dtype — bf16 and int8 panels are
+dequantized in VMEM, int8 by a per-row scale vector), invalid rows masked to
+-inf, then k unrolled selection steps merge the panel into the accumulator.
+Each selection extracts the (max score, lowest index achieving it) pair from
+the union of accumulator and panel and retires it — reproducing
+`lax.top_k`'s exact ordering contract (descending value, ties broken by
+ascending index), which the parity tests pin score-bitwise and index-exact.
+No sort, no concat: just max/min lane reductions and lane-iota selects, the
+shapes Mosaic is known to lower (everything >=2D, reductions keepdims).
+
+Only the accumulator [B_pad, 128] x2 ever returns to HBM: bytes moved per
+query drop from `N*D*itemsize + 2*N*4` (score matrix out + back through
+top_k) to `N*D*itemsize / B` amortized panel traffic (bench.py records the
+roofline under `serve_roofline`).
+
+Off-TPU `topk_fused` routes to a jnp fallback that IS
+`lax.top_k(masked scores)` — bitwise the oracle by construction — while
+`impl="pallas"` + interpret mode exercises the kernel's own selection logic
+on CPU (tests/test_topk_fused.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+# corpus rows per VMEM panel: 512 x 128 lanes of f32 panel + [bq, block]
+# scores stay ~1 MB per step, far under the ~16 MB VMEM budget, and 512 is a
+# multiple of every dtype's min sublane tile (8 f32 / 16 bf16 / 32 int8)
+DEFAULT_PANEL = 512
+
+# accumulator lane width: one lane tile; k must fit in it (serving k is ~5-10)
+_ACC_LANES = 128
+
+# "no entry here": larger than any real corpus index, so consumed/empty slots
+# lose every min-index tie-break
+_IDX_SENTINEL = np.iinfo(np.int32).max
+
+
+def _on_tpu():
+    return jax.default_backend() == "tpu"
+
+
+def _topk_kernel(q_ref, e_ref, v_ref, s_ref, os_ref, oi_ref, *, k, bq, block):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        os_ref[:] = jnp.full((bq, _ACC_LANES), -jnp.inf, jnp.float32)
+        oi_ref[:] = jnp.full((bq, _ACC_LANES), _IDX_SENTINEL, jnp.int32)
+
+    q = q_ref[:]                                    # [bq, D] f32 queries
+    panel = e_ref[:].astype(jnp.float32)            # [block, D] dequant to f32
+    ps = jax.lax.dot_general(q, panel, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ps = ps * s_ref[:]                              # per-row int8 scale (ones
+    ps = jnp.where(v_ref[:] > 0, ps, -jnp.inf)      # otherwise: bitwise no-op)
+    # invalid rows keep their REAL index: lax.top_k breaks -inf ties by
+    # ascending index over the whole masked row, and so must we
+    pidx = jax.lax.broadcasted_iota(jnp.int32, (bq, block), 1) + j * block
+
+    acc_s, acc_i = os_ref[:], oi_ref[:]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (bq, _ACC_LANES), 1)
+    new_s = jnp.full((bq, _ACC_LANES), -jnp.inf, jnp.float32)
+    new_i = jnp.full((bq, _ACC_LANES), _IDX_SENTINEL, jnp.int32)
+    for t in range(k):  # k static selection steps, unrolled
+        m = jnp.maximum(jnp.max(acc_s, axis=1, keepdims=True),
+                        jnp.max(ps, axis=1, keepdims=True))        # [bq, 1]
+        sel = jnp.minimum(                          # lowest index achieving m
+            jnp.min(jnp.where(acc_s == m, acc_i, _IDX_SENTINEL),
+                    axis=1, keepdims=True),
+            jnp.min(jnp.where(ps == m, pidx, _IDX_SENTINEL),
+                    axis=1, keepdims=True))                        # [bq, 1]
+        new_s = jnp.where(lane == t, m, new_s)
+        new_i = jnp.where(lane == t, sel, new_i)
+        # retire the selected entry from whichever side held it (indices are
+        # globally unique, so exactly one slot matches)
+        acc_s = jnp.where(acc_i == sel, -jnp.inf, acc_s)
+        acc_i = jnp.where(acc_i == sel, _IDX_SENTINEL, acc_i)
+        ps = jnp.where(pidx == sel, -jnp.inf, ps)
+        pidx = jnp.where(pidx == sel, _IDX_SENTINEL, pidx)
+    os_ref[:] = new_s
+    oi_ref[:] = new_i
+
+
+@functools.partial(jax.jit, static_argnames=("k", "block", "bq", "interpret"))
+def _topk_pallas(queries, emb, valid, scales, k, block, bq, interpret):
+    b, d = queries.shape
+    n = emb.shape[0]
+    bp = -(-b // bq) * bq
+    dp = -(-d // 128) * 128
+    n_pad = -(-n // block) * block
+    # zero-padding is inert: pad lanes contribute 0 to every dot, pad corpus
+    # rows are valid=0 (-inf, and their indices exceed every real row's, so
+    # they lose all -inf ties to real rows — parity holds on the caller's N)
+    q = jnp.pad(queries.astype(jnp.float32), ((0, bp - b), (0, dp - d)))
+    e = jnp.pad(emb, ((0, n_pad - n), (0, dp - d)))
+    v = jnp.pad(valid.astype(jnp.float32), (0, n_pad - n)).reshape(1, n_pad)
+    s = jnp.pad(scales.astype(jnp.float32), (0, n_pad - n),
+                constant_values=1.0).reshape(1, n_pad)
+    kernel = functools.partial(_topk_kernel, k=k, bq=bq, block=block)
+    out_s, out_i = pl.pallas_call(
+        kernel,
+        grid=(bp // bq, n_pad // block),   # panel axis innermost: consecutive
+        in_specs=[                         # revisits of the accumulator block
+            pl.BlockSpec((bq, dp), lambda i, j: (i, 0)),
+            pl.BlockSpec((block, dp), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, block), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bq, _ACC_LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((bq, _ACC_LANES), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, _ACC_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bp, _ACC_LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(q, e, v, s)
+    return out_s[:b, :k], out_i[:b, :k]
+
+
+def _topk_reference(queries, emb, valid, k, scales=None):
+    """The oracle the kernel must match: masked scores -> `lax.top_k`.
+
+    Also the off-TPU serving path. f32 accumulation is forced the same way
+    the kernel forces it (dequantize, then `preferred_element_type`), and the
+    int8 scale multiplies the SCORES (post-dot), bitwise-matching the kernel's
+    `(q . row_int8) * scale` order.
+    """
+    embf = emb.astype(jnp.float32)
+    scores = jax.lax.dot_general(queries.astype(jnp.float32), embf,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    if scales is not None:
+        scores = scores * scales[None, :].astype(jnp.float32)
+    scores = jnp.where(valid[None, :] > 0, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def topk_fused(queries, emb, valid, k, *, scales=None, block=DEFAULT_PANEL,
+               bq=None, impl=None, interpret=None):
+    """Top-k cosine matches of each query against a resident corpus.
+
+    :param queries: [B, D] float32, unit-normalized upstream
+    :param emb: [N, D] corpus embeddings — float32, bfloat16 or int8
+    :param valid: [N] mask; rows with valid <= 0 score -inf (but keep their
+        index for `lax.top_k`-exact -inf tie ordering)
+    :param k: static; output is ([B, k] f32 scores, [B, k] int32 indices),
+        descending score, ties broken by ascending index — `lax.top_k`'s
+        contract exactly
+    :param scales: [N] f32 per-row dequant scales (int8 corpus), else None
+    :param block: corpus rows per VMEM panel (multiple of 128)
+    :param impl: "pallas" | "jnp" | None (None: pallas on TPU, jnp elsewhere)
+    :param interpret: Pallas interpreter mode; None = not on TPU
+    """
+    k = int(k)
+    n = emb.shape[0]
+    if not 1 <= k <= n:
+        raise ValueError(f"k={k} outside [1, N={n}]")
+    if impl is None:
+        impl = "pallas" if _on_tpu() else "jnp"
+    if impl == "pallas" and (k > _ACC_LANES or k > block):
+        impl = "jnp"   # the accumulator holds k lanes; huge k is top_k's game
+    if impl == "jnp":
+        return _topk_reference(queries, emb, valid, k, scales)
+    if block % 128 != 0:
+        raise ValueError(f"block={block} must be a multiple of 128")
+    if interpret is None:
+        interpret = not _on_tpu()
+    if bq is None:
+        bq = min(256, -(-queries.shape[0] // 8) * 8)
+    if scales is None:
+        scales = jnp.ones((n,), jnp.float32)
+    return _topk_pallas(queries, emb, valid, scales, k=k, block=block, bq=bq,
+                        interpret=interpret)

@@ -25,7 +25,13 @@ shardings, let XLA insert the collectives over ICI.
             cross-anchor reductions; exact square-oracle semantics
 """
 
-from .mesh import get_mesh, get_mesh_2d, initialize_multihost  # noqa: F401
+from .mesh import (  # noqa: F401
+    get_mesh,
+    get_mesh_2d,
+    initialize_multihost,
+    row_sharding,
+    shard_rows,
+)
 from .dp import (  # noqa: F401
     make_parallel_train_step,
     make_parallel_eval_step,

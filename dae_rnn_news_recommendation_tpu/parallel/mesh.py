@@ -55,6 +55,33 @@ def get_mesh(n_devices=None, axis_name="data", devices=None):
     return jax.sharding.Mesh(np.asarray(devices[:n]), (axis_name,))
 
 
+def row_sharding(mesh, axis_name="data"):
+    """NamedSharding splitting axis 0 over `axis_name`, rest replicated —
+    the layout for any [N, ...] corpus-like array scored shard-locally
+    (serve/graph.make_sharded_serve_fn)."""
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(axis_name))
+
+
+def shard_rows(x, mesh, axis_name="data"):
+    """Place `x` (array or pytree of arrays) with rows sharded over the mesh.
+
+    Generalizes the 1-D data mesh from batch sharding to RESIDENT-array
+    sharding: pass as `ServingCorpus(device_put=...)` and the corpus
+    embeddings, valid mask and int8 scales all land row-sharded, so corpus
+    capacity scales with device count. Axis 0 must divide the mesh size
+    (serve/graph pads N to the corpus block, which covers any pow-2 mesh)."""
+    n_dev = int(mesh.shape[axis_name])
+    sharding = row_sharding(mesh, axis_name)
+
+    def put(leaf):
+        assert leaf.shape[0] % n_dev == 0, (
+            f"axis 0 ({leaf.shape[0]}) not divisible by mesh size {n_dev}")
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree_util.tree_map(put, x)
+
+
 def get_mesh_2d(data_parallel, model_parallel, axis_names=("data", "model"),
                 devices=None):
     """2-D mesh: batch sharded over `data`, features (the wide F axis of W) over

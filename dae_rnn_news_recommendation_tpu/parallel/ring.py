@@ -17,10 +17,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..ops.normalize import l2_normalize
 
-def _l2_normalize_rows(x, eps=1e-12):
-    sq = jnp.sum(jnp.square(x), axis=1, keepdims=True)
-    return x * jnp.reciprocal(jnp.sqrt(jnp.maximum(sq, eps)))
+
+def _l2_normalize_rows(x):
+    return l2_normalize(x, axis=1)
 
 
 def ring_pairwise_similarity(embeddings, mesh, axis_name="data", normalize=True,

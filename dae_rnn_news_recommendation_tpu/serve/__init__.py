@@ -17,11 +17,14 @@ back rather than serving a bad build. Full story in docs/serving.md.
 
 from .chaos_serve import (ServePlanResult, chaos_serve_soak, overload_trace,
                           run_serve_plan, serve_fault_plan)
-from .corpus import CorpusSlot, ServingCorpus, SwapRejected
-from .graph import block_indices, make_corpus_encode_fn, make_serve_fn
+from .corpus import (CORPUS_DTYPES, CorpusSlot, ServingCorpus, SwapRejected,
+                     dequantize_rows, quantize_corpus)
+from .graph import (block_indices, make_corpus_encode_fn, make_serve_fn,
+                    make_sharded_serve_fn)
 from .service import RecommendationService, Reply, ReplyFuture
 
 __all__ = [
+    "CORPUS_DTYPES",
     "CorpusSlot",
     "RecommendationService",
     "Reply",
@@ -31,9 +34,12 @@ __all__ = [
     "SwapRejected",
     "block_indices",
     "chaos_serve_soak",
+    "dequantize_rows",
     "make_corpus_encode_fn",
     "make_serve_fn",
+    "make_sharded_serve_fn",
     "overload_trace",
+    "quantize_corpus",
     "run_serve_plan",
     "serve_fault_plan",
 ]

@@ -34,6 +34,7 @@ import time
 
 import numpy as np
 
+from ..analysis.runtime import compile_guard
 from ..models.dae_core import DAEConfig, init_params
 from ..reliability import faults as _faults
 from ..reliability.faults import FaultInjector, FaultPlan, FaultSpec
@@ -68,6 +69,7 @@ class ServePlanResult:
     swap_faulted: bool
     swap_rolled_back: bool
     served_after_swap: bool
+    n_post_warm_compiles: int
     injected: list
     retries: list
     duration_s: float
@@ -160,7 +162,12 @@ def run_serve_plan(seed, n_requests=48, log=None):
     futures = []
     served_after_swap = False
     try:
-        with _faults.install(injector):
+        # everything past warmup() — the overload trace, the degraded-mode
+        # dispatches, the mid-plan hot swap — must hit only the variants the
+        # service compiled up front; a recompile here is a latency cliff the
+        # SLO never budgeted. Count mode (no max): a violation is reported as
+        # a plan problem, not an exception that would mask the trace results.
+        with compile_guard() as guard, _faults.install(injector):
             swap_at = len(overload_trace(seed, n_requests)) // 2
             for i, (burst, deadline_s, gap_s) in enumerate(
                     overload_trace(seed, n_requests)):
@@ -220,6 +227,10 @@ def run_serve_plan(seed, n_requests=48, log=None):
         problems.append("service stopped answering after the swap")
     if ok_lat and p95_ms > _SLA_S * 1e3:
         problems.append(f"p95 {p95_ms} ms blew the {_SLA_S}s SLA")
+    if guard.count > 0:
+        problems.append(
+            f"{guard.count} XLA compiles after warmup — degraded modes must "
+            "dispatch to precompiled variants, never retrace")
     result = ServePlanResult(
         seed=int(seed), ok=not problems, detail="; ".join(problems) or "ok",
         n_submitted=summary["counts"]["submitted"], n_replied=n_ok,
@@ -227,6 +238,7 @@ def run_serve_plan(seed, n_requests=48, log=None):
         p95_ms=p95_ms, degraded=bool(summary["degraded_events"]),
         swap_faulted=swap_faulted, swap_rolled_back=rolled_back,
         served_after_swap=served_after_swap,
+        n_post_warm_compiles=int(guard.count),
         injected=list(injector.fired), retries=list(injector.retries),
         duration_s=round(time.monotonic() - t0, 2))
     if log:

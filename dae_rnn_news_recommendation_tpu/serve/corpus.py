@@ -33,6 +33,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+CORPUS_DTYPES = ("float32", "bfloat16", "int8")
+
 from .. import telemetry
 from ..reliability import faults as _faults
 from ..telemetry.health import embedding_health
@@ -47,20 +49,60 @@ COLLAPSE_CEILING = 0.98
 _GATE_SAMPLE = 256  # rows sampled for the collapse gate
 
 
+def quantize_corpus(emb, dtype):
+    """[N_pad, D] f32 unit-norm embeddings -> (stored array, per-row scales).
+
+    float32: stored as-is, scales None. bfloat16: one cast, scales None (the
+    rows are unit-norm, so bf16's 8-bit mantissa costs ~3 decimal digits of
+    cosine resolution uniformly). int8: symmetric per-row absmax quantization
+    — `scale = absmax / 127`, zero rows get scale 1 so dequant stays exact —
+    stored with f32 scales the scorer applies AFTER the int8 dot (all
+    accumulation in fp32 via `preferred_element_type`; see ops/topk_fused)."""
+    if dtype == "float32":
+        return emb, None
+    if dtype == "bfloat16":
+        return emb.astype(jnp.bfloat16), None
+    if dtype == "int8":
+        absmax = jnp.max(jnp.abs(emb), axis=1)
+        scales = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(emb / scales[:, None]), -127, 127)
+        return q.astype(jnp.int8), scales
+    raise ValueError(f"corpus_dtype must be one of {CORPUS_DTYPES}: {dtype!r}")
+
+
+def dequantize_rows(emb, scales, rows):
+    """First `rows` corpus rows back in f32 (health gate / parity checks)."""
+    x = emb[:rows].astype(jnp.float32)
+    if scales is not None:
+        x = x * scales[:rows, None]
+    return x
+
+
 class CorpusSlot:
-    """One immutable buffer: unit-norm embeddings [N_pad, D] on device, a
-    valid-row mask, and provenance. Never mutated after build — the service
-    snapshots a reference and scores against it lock-free."""
+    """One immutable buffer: unit-norm embeddings [N_pad, D] on device (at
+    the corpus dtype, int8 alongside its per-row scales), a valid-row mask,
+    and provenance. Never mutated after build — the service snapshots a
+    reference and scores against it lock-free."""
 
-    __slots__ = ("emb", "valid", "n", "version", "note", "built_s")
+    __slots__ = ("emb", "valid", "scales", "dtype", "n", "version", "note",
+                 "built_s")
 
-    def __init__(self, emb, valid, n, version, note, built_s):
+    def __init__(self, emb, valid, n, version, note, built_s,
+                 scales=None, dtype="float32"):
         self.emb = emb
         self.valid = valid
+        self.scales = scales
+        self.dtype = dtype
         self.n = int(n)
         self.version = int(version)
         self.note = note
         self.built_s = built_s
+
+    def resident_bytes(self):
+        """Device bytes held by the scoring matrix (embeddings + scales; the
+        valid mask is dtype-invariant and excluded so dtypes compare clean)."""
+        return int(self.emb.nbytes) + (
+            int(self.scales.nbytes) if self.scales is not None else 0)
 
 
 class SwapRejected(RuntimeError):
@@ -73,10 +115,15 @@ class ServingCorpus:
     thread so the microbatcher never blocks on a refresh."""
 
     def __init__(self, config, *, block=DEFAULT_BLOCK,
-                 collapse_ceiling=COLLAPSE_CEILING, device_put=None):
+                 collapse_ceiling=COLLAPSE_CEILING, device_put=None,
+                 corpus_dtype="float32"):
+        if corpus_dtype not in CORPUS_DTYPES:
+            raise ValueError(
+                f"corpus_dtype must be one of {CORPUS_DTYPES}: {corpus_dtype!r}")
         self.config = config
         self.block = int(block)
         self.collapse_ceiling = float(collapse_ceiling)
+        self.corpus_dtype = corpus_dtype
         self._device_put = device_put
         self._encode_corpus = make_corpus_encode_fn(config)
         self._lock = threading.Lock()
@@ -151,17 +198,28 @@ class ServingCorpus:
         resident = build_resident(articles, device_put=self._device_put)
         blocks = block_indices(n, self.block)
         emb = self._encode_corpus(params, resident, blocks)
+        emb, scales = quantize_corpus(emb, self.corpus_dtype)
         n_pad = blocks.size
         valid = np.zeros(n_pad, np.float32)
         valid[:n] = 1.0
         put = self._device_put or jax.device_put
+        if self._device_put is not None:
+            # re-place through the caller's sharder (e.g. mesh.shard_rows):
+            # the encode ran wherever jit put it, the slot lives where scoring
+            # wants it
+            emb = put(emb)
+            scales = put(scales) if scales is not None else None
         return CorpusSlot(emb=emb, valid=put(valid), n=n, version=-1,
-                          note=note, built_s=time.monotonic())
+                          note=note, built_s=time.monotonic(),
+                          scales=scales, dtype=self.corpus_dtype)
 
     def _health_gate(self, slot):
-        """Finiteness + collapse score on a sample of the standby embeddings.
+        """Finiteness + collapse score on a sample of the standby embeddings
+        (DEQUANTIZED — the gate judges what scoring will actually see, so a
+        broken quantization fails here, not in production ranking).
         One deliberate host sync — the swap path is off the request path."""
-        sample = slot.emb[:min(_GATE_SAMPLE, slot.n)]
+        sample = dequantize_rows(slot.emb, slot.scales,
+                                 min(_GATE_SAMPLE, slot.n))
         finite = bool(jax.device_get(jnp.all(jnp.isfinite(sample))))
         stats = jax.device_get(embedding_health(sample))
         collapse = float(stats["health/embedding_collapse"])

@@ -1,6 +1,6 @@
-"""The serving dataflow: jitted encode -> score -> top-k over a resident corpus.
+"""The serving dataflow: jitted encode -> fused score/top-k over a resident corpus.
 
-Two compiled programs, both shaped for the high-latency dispatch link the
+Three compiled programs, all shaped for the high-latency dispatch link the
 training side already engineered around (bench.py:_hard_sync measures
 ~23-70 ms per host->device round trip over the axon tunnel):
 
@@ -9,23 +9,43 @@ training side already engineered around (bench.py:_hard_sync measures
     arrays with the same `jnp.take` gather `train/resident.py` uses for
     one-dispatch epochs, densifies sparse rows on device, encodes, and
     L2-normalizes. The [N_pad, D] embedding matrix never leaves the device —
-    it IS the serving corpus (serve/corpus.py double-buffers two of them).
+    it IS the serving corpus (serve/corpus.py double-buffers two of them,
+    optionally quantized to bf16 or int8).
 
   * `make_serve_fn` — answers one microbatch in one dispatch: encode the
-    [B, F] query batch, normalize, score every corpus row by cosine (one
-    [B, D] x [D, N] matmul on the MXU), mask padded corpus rows to -inf, and
-    `lax.top_k`. `k` is baked into the compiled program (it shapes the
-    output), so the service precompiles one variant per (bucket, k) pair —
+    [B, F] query batch, normalize, and rank every corpus row by cosine. The
+    default (`fused=True`) routes through `ops.topk_fused`: on TPU the corpus
+    streams through VMEM in panels and the [B, N] score matrix never touches
+    HBM; off-TPU it lowers to the same masked-matmul + `lax.top_k` the r07
+    graph ran, bitwise. `fused=False` keeps the r07 materializing path
+    compiled and dispatchable — it is the bench baseline the fused kernel is
+    gated against, not a deprecated alias. `k` is baked into the compiled
+    program, so the service precompiles one variant per (bucket, k) pair —
     the degraded top-k-truncation mode is just a dispatch to the smaller-k
     variant, not a recompile under overload.
+
+  * `make_sharded_serve_fn` — the same fused scorer over a row-sharded corpus:
+    each device holds N/n_dev rows (place them with `parallel.mesh.shard_rows`,
+    e.g. via `ServingCorpus(device_put=...)`), computes its local top-k with
+    the fused kernel, offsets local indices to global, and one k-way
+    `lax.top_k` over the gathered [B, n_dev*k] candidates merges the shards.
+    Device order equals global row order, so the merge's positional tie-break
+    reproduces single-device index ordering exactly.
 """
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.6 re-homed shard_map; 0.4.x only has the experimental name
+    from jax.experimental.shard_map import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    _shard_map = jax.shard_map
 
 from .. import telemetry
 from ..models import dae_core
+from ..ops.normalize import l2_normalize
 
 # corpus index blocks per scan step: big enough to amortize the gather,
 # small enough that (block x F) dense stays far below the step's working set
@@ -45,10 +65,6 @@ def _gather_rows(resident, idx, config):
     return densify_on_device(ind, val, config.n_features)
 
 
-def _normalize(h):
-    return h / (jnp.linalg.norm(h, axis=-1, keepdims=True) + 1e-9)
-
-
 def block_indices(n_rows, block=DEFAULT_BLOCK):
     """[S, block] int32 index blocks covering 0..n_rows-1, tail padded by
     repeating index 0 (the pad rows are masked out of scoring via the valid
@@ -66,7 +82,7 @@ def make_corpus_encode_fn(config):
     def run(params, resident, idx_blocks):
         def body(carry, idx):
             x = _gather_rows(resident, idx, config)
-            return carry, _normalize(dae_core.encode(params, x, config))
+            return carry, l2_normalize(dae_core.encode(params, x, config))
 
         _, emb = jax.lax.scan(body, None, idx_blocks)
         return emb.reshape(-1, emb.shape[-1])
@@ -74,16 +90,70 @@ def make_corpus_encode_fn(config):
     return telemetry.instrument(jax.jit(run), "serve/corpus_encode")
 
 
-def make_serve_fn(config, k):
+def make_serve_fn(config, k, *, fused=True):
     """Jitted microbatch answer: (params, emb [N_pad, D], valid [N_pad],
-    queries [B, F]) -> (scores [B, k], indices [B, k]), cosine-ranked."""
+    scales [N_pad]|None, queries [B, F]) -> (scores [B, k], indices [B, k]),
+    cosine-ranked. `scales` carries the int8 corpus's per-row dequant factors
+    (None for float32/bfloat16 corpora)."""
     k = int(k)
     assert k >= 1
 
-    def run(params, emb, valid, queries):
-        h = _normalize(dae_core.encode(params, queries, config))
-        scores = h @ emb.T
+    def run(params, emb, valid, scales, queries):
+        h = l2_normalize(dae_core.encode(params, queries, config))
+        if fused:
+            # trace-time import: pallas loads only when a fused graph is built
+            # (same lazy discipline as ops/__init__'s _PALLAS_EXPORTS)
+            from ..ops.topk_fused import topk_fused
+
+            return topk_fused(h, emb, valid, k, scales=scales)
+        # the r07 materializing path, kept compiled as the bench baseline:
+        # [B, N] scores in HBM, then a full-width top_k over them
+        scores = h @ emb.astype(jnp.float32).T
+        if scales is not None:
+            scores = scores * scales[None, :]
         scores = jnp.where(valid[None, :] > 0, scores, -jnp.inf)
         return jax.lax.top_k(scores, k)
 
-    return telemetry.instrument(jax.jit(run), f"serve/topk{k}")
+    name = f"serve/topk{k}" + ("" if fused else "_unfused")
+    return telemetry.instrument(jax.jit(run), name)
+
+
+def make_sharded_serve_fn(config, k, mesh, axis_name="data"):
+    """`make_serve_fn`, but the corpus is row-sharded over `mesh`.
+
+    Expects emb/valid/scales placed with `parallel.mesh.shard_rows` (N_pad
+    divisible by the mesh size, shard rows >= k). Each device runs the fused
+    kernel over its local rows, local indices are offset by
+    `axis_index * shard_rows` to global, and the [B, n_dev*k] gathered
+    candidates collapse through one final `lax.top_k` whose positional
+    tie-break — device-major, slot-minor — IS ascending global index order,
+    so scores and indices match the single-device graph (scores to fp32
+    merge roundoff, indices exactly)."""
+    k = int(k)
+    assert k >= 1
+    n_dev = int(mesh.shape[axis_name])
+
+    def run(params, emb, valid, scales, queries):
+        n_pad = emb.shape[0]
+        assert n_pad % n_dev == 0, f"N_pad={n_pad} not divisible by {n_dev}"
+        assert n_pad // n_dev >= k, f"shard rows {n_pad // n_dev} < k={k}"
+        h = l2_normalize(dae_core.encode(params, queries, config))
+        if scales is None:
+            scales = jnp.ones((n_pad,), jnp.float32)
+
+        def local(emb_l, valid_l, scales_l, h_l):
+            from ..ops.topk_fused import topk_fused
+
+            s, i = topk_fused(h_l, emb_l, valid_l, k, scales=scales_l)
+            return s, i + jax.lax.axis_index(axis_name) * emb_l.shape[0]
+
+        s_cat, i_cat = _shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis_name, None), P(axis_name), P(axis_name),
+                      P(None, None)),
+            out_specs=(P(None, axis_name), P(None, axis_name)))(
+                emb, valid, scales, h)
+        s_top, pos = jax.lax.top_k(s_cat, k)     # [B, n_dev*k] -> [B, k]
+        return s_top, jnp.take_along_axis(i_cat, pos, axis=1)
+
+    return telemetry.instrument(jax.jit(run), f"serve/topk{k}_sharded")

@@ -47,6 +47,7 @@ import numpy as np
 import jax
 
 from .. import telemetry
+from ..analysis.runtime import CompileWatcher
 from ..reliability import faults as _faults
 from ..reliability.retry import RetryPolicy
 from ..train.pipeline import bucket_sizes
@@ -135,7 +136,7 @@ class RecommendationService:
     def __init__(self, params, config, corpus, *, top_k=10,
                  degraded_top_k=None, max_batch=32, max_inflight=64,
                  flush_slack_s=0.02, linger_s=0.005, default_deadline_s=1.0,
-                 overload_watermark=0.75, retry=None):
+                 overload_watermark=0.75, retry=None, fused=True):
         assert int(top_k) >= 1 and int(max_batch) >= 1
         self.params = params
         self.config = config
@@ -154,8 +155,12 @@ class RecommendationService:
             max_attempts=3, backoff_s=0.002, max_elapsed_s=0.25)
         self.buckets = bucket_sizes(self.max_batch, n_buckets=3,
                                     floor=min(8, self.max_batch))
-        self._serve_fns = {k: make_serve_fn(config, k)
+        self.fused = bool(fused)
+        self._serve_fns = {k: make_serve_fn(config, k, fused=self.fused)
                            for k in {self.top_k, self.degraded_top_k}}
+        self._warmup_compiles = None   # set by warmup()
+        self._post_warm_watcher = None  # counts compiles after warmup() —
+        # the serving SLO assumes zero (every (bucket, k) variant is warm)
         self._q = queue.Queue(maxsize=self.max_inflight)
         self._stop = threading.Event()
         self._floor_s = 0.0       # fastest observed device batch (the proof
@@ -277,7 +282,8 @@ class RecommendationService:
                                       "corpus_version": slot.version}) as sp:
                 def call():
                     _faults.fire("serve.batch", n=b)
-                    out = serve_fn(self.params, slot.emb, slot.valid, batch)
+                    out = serve_fn(self.params, slot.emb, slot.valid,
+                                   slot.scales, batch)
                     jax.block_until_ready(out)
                     return out
 
@@ -358,29 +364,40 @@ class RecommendationService:
 
     # ------------------------------------------------------------ lifecycle
     def warmup(self):
-        """Compile every (bucket, k) variant and seed the device floor, so
-        first requests measure dispatch, not tracing. One-time, blocking."""
+        """Compile every (bucket, k) variant — primary AND degraded k — and
+        seed the device floor, so first requests measure dispatch, not
+        tracing. One-time, blocking. Compile counts are watched: the warmup
+        total lands in `summary()["compiles"]`, and a post-warmup watcher
+        stays live so the chaos soak can assert the degraded modes never
+        trigger a recompile (they dispatch to variants warmed here)."""
         slot = self.corpus.active
         assert slot is not None, "swap a corpus in before warmup()"
         f = int(self.config.n_features)
-        for k, fn in sorted(self._serve_fns.items()):
-            for b in self.buckets:
-                out = fn(self.params, slot.emb, slot.valid,
-                         np.zeros((b, f), np.float32))
-                jax.block_until_ready(out)
-        # floor := fastest warm repeat of the smallest variant
-        t0 = time.monotonic()
-        out = self._serve_fns[self.top_k](
-            self.params, slot.emb, slot.valid,
-            np.zeros((self.buckets[0], f), np.float32))
-        jax.block_until_ready(out)
-        self._floor_s = time.monotonic() - t0
+        watcher = CompileWatcher().start()
+        try:
+            for k, fn in sorted(self._serve_fns.items()):
+                for b in self.buckets:
+                    out = fn(self.params, slot.emb, slot.valid, slot.scales,
+                             np.zeros((b, f), np.float32))
+                    jax.block_until_ready(out)
+            # floor := fastest warm repeat of the smallest variant
+            t0 = time.monotonic()
+            out = self._serve_fns[self.top_k](
+                self.params, slot.emb, slot.valid, slot.scales,
+                np.zeros((self.buckets[0], f), np.float32))
+            jax.block_until_ready(out)
+            self._floor_s = time.monotonic() - t0
+        finally:
+            self._warmup_compiles = watcher.stop()
+        self._post_warm_watcher = CompileWatcher().start()
 
     def stop(self, timeout=5.0):
         """Drain and join: the batcher flushes everything already admitted,
         then exits; anything racing into the queue after is shed explicitly."""
         self._stop.set()
         self._thread.join(timeout=timeout)
+        if self._post_warm_watcher is not None:
+            self._post_warm_watcher.stop()  # .count survives for summary()
         while True:
             try:
                 self._shed(self._q.get_nowait(), "shutdown")
@@ -410,4 +427,9 @@ class RecommendationService:
                 "retries": list(self.retry.events),
                 "buckets": list(self.buckets), "top_k": self.top_k,
                 "degraded_top_k": self.degraded_top_k,
-                "floor_ms": round(self._floor_s * 1e3, 3)}
+                "floor_ms": round(self._floor_s * 1e3, 3),
+                "compiles": {
+                    "warmup": self._warmup_compiles,
+                    "post_warmup": (self._post_warm_watcher.count
+                                    if self._post_warm_watcher is not None
+                                    else None)}}

@@ -459,6 +459,7 @@ def main(argv=None):
                                "swap_faulted": r.swap_faulted,
                                "swap_rolled_back": r.swap_rolled_back,
                                "served_after_swap": r.served_after_swap,
+                               "n_post_warm_compiles": r.n_post_warm_compiles,
                                "n_injected": len(r.injected),
                                "n_retries": len(r.retries),
                                "duration_s": round(r.duration_s, 2)}
@@ -746,6 +747,42 @@ def main(argv=None):
               ("evidence/bench_tpu.json has no serve_queries_per_sec — the "
                "sidecar predates the serving corner; rerun bench.py on TPU "
                "to capture it"))
+        # ISSUE 9 acceptance, from the committed bench sidecar: the fused
+        # Pallas scorer beats the r07 materializing path >= 1.5x at the
+        # record corpus, and the int8 resident corpus compresses >= ~3x while
+        # preserving fp32 ranking (recall floor rationale below)
+        speedup = bench_extra.get("serve_fused_speedup")
+        check("serve_fused_speedup",
+              speedup is not None and float(speedup) >= 1.5,
+              (f"bench sidecar serve_fused_speedup {speedup}x >= 1.5x "
+               f"(fused {serve_qps} qps vs unfused "
+               f"{bench_extra.get('serve_queries_per_sec_unfused')} qps at "
+               f"corpus {bench_extra.get('serve_corpus_rows')})")
+              if speedup is not None else
+              ("evidence/bench_tpu.json has no serve_fused_speedup — the "
+               "sidecar predates the fused-scorer corner; rerun bench.py on "
+               "TPU to capture it"))
+        int8_ratio = bench_extra.get("serve_int8_bytes_ratio")
+        recalls = bench_extra.get("serve_recall_at_10_vs_fp32") or {}
+        int8_recall = recalls.get("int8") if isinstance(recalls, dict) else None
+        # Recall floor is 0.98, not the 0.999 one might expect: the bench
+        # corpus is init-params embeddings (near-isotropic), so the median
+        # rank-10/11 cosine gap (~1.2e-3) sits within ~2x of the int8
+        # score-noise bound (~6e-4) — an order-statistics worst case where
+        # even bf16 measures 0.997, and centering/asymmetric schemes were
+        # measured to buy nothing (docs/serving.md). Re-measure on a trained
+        # corpus before tightening.
+        check("serve_int8_corpus",
+              int8_ratio is not None and float(int8_ratio) <= 0.35
+              and int8_recall is not None and float(int8_recall) >= 0.98,
+              (f"bench sidecar int8 corpus holds {int8_ratio}x the fp32 "
+               f"resident bytes (<= 0.35x) at recall@10 {int8_recall} "
+               ">= 0.98 vs fp32 "
+               f"(bytes: {bench_extra.get('serve_corpus_bytes')})")
+              if int8_ratio is not None else
+              ("evidence/bench_tpu.json has no serve_int8_bytes_ratio — the "
+               "sidecar predates the quantized-corpus corner; rerun bench.py "
+               "on TPU to capture it"))
     check("user_category_top1", user["category_top1_accuracy"] > 0.6,
           f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.6 "
           "(chance ~1/8; scored against 5-candidate category means — one "

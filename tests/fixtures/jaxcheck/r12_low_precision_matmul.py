@@ -1,0 +1,67 @@
+"""Planted R12: low-precision matmuls that silently accumulate in the input
+dtype. The serving-recall contract (docs/serving.md) is fp32 accumulation
+over bf16/int8 operands via `preferred_element_type` — without it the MXU
+rounds every partial sum to the narrow dtype. Clean twins: the same matmuls
+carrying `preferred_element_type=jnp.float32`, an fp32-cast matmul (no low
+evidence), and a reasoned compute-dtype-contract disable."""
+
+import jax
+import jax.numpy as jnp
+
+
+def bf16_cast_operand(x, w):
+    return jnp.matmul(x.astype(jnp.bfloat16), w)  # planted: R12
+
+
+def int8_bound_then_matmul_op(h, w):
+    w8 = w.astype("int8")
+    return h @ w8.T  # planted: R12
+
+
+def config_compute_dtype_idiom(params, x, config):
+    # the repo's dae_core shape: dt is only *maybe* low — R12 treats maybe
+    # as yes, because the config default IS bfloat16
+    dt = jnp.dtype(config.compute_dtype)
+    w = params["W"].astype(dt)
+    return jnp.matmul(x.astype(dt), w)  # planted: R12
+
+
+def einsum_low_operand(x, w):
+    xq = x.astype(jnp.bfloat16)
+    return jnp.einsum("bf,fd->bd", xq, w)  # planted: R12
+
+
+def dot_general_low_operand(q, e):
+    eq = e.astype(jnp.int8)
+    return jax.lax.dot_general(q, eq, (((1,), (1,)), ((), ())))  # planted: R12
+
+
+# ---------------------------------------------------------------- clean twins
+
+def bf16_with_preferred(x, w):
+    return jnp.matmul(x.astype(jnp.bfloat16), w,
+                      preferred_element_type=jnp.float32)
+
+
+def dtype_var_with_preferred(params, x, config):
+    dt = jnp.dtype(config.compute_dtype)
+    w = params["W"].astype(dt)
+    return jnp.matmul(x.astype(dt), w,
+                      preferred_element_type=jnp.float32)
+
+
+def fp32_cast_is_not_low(h, emb):
+    # widening cast: accumulation dtype == operand dtype == fp32, no hazard
+    return h @ emb.astype(jnp.float32).T
+
+
+def fp32_dtype_binding_is_not_low(x, w):
+    dt = jnp.dtype("float32")
+    return jnp.matmul(x.astype(dt), w)
+
+
+def narrow_accumulation_is_the_contract(params, x, config):
+    dt = jnp.dtype(config.compute_dtype)
+    w = params["W"].astype(dt)
+    # jaxcheck: disable=R12 (compute-dtype parity with the reference model: the narrow rounding is the numerical contract under test)
+    return jnp.matmul(x.astype(dt), w)

@@ -37,6 +37,9 @@ def test_chaos_serve_smoke_plan(seed):
     if result.swap_faulted:
         assert result.swap_rolled_back
     assert result.served_after_swap
+    # warmup precompiled every (bucket, k) variant: degraded modes, hot swap
+    # and overload must dispatch, never retrace (compile_guard-counted)
+    assert result.n_post_warm_compiles == 0
 
 
 @pytest.mark.slow

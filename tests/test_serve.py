@@ -51,24 +51,45 @@ def make_service(config, params, corpus, **kw):
 
 # ------------------------------------------------------------------- graph
 
-def test_topk_graph_matches_numpy_ranking(setup):
+def _unit(h):
+    # host twin of ops.normalize.l2_normalize (tf.nn.l2_normalize form)
+    sq = np.sum(np.square(h), axis=-1, keepdims=True)
+    return h * (1.0 / np.sqrt(np.maximum(sq, 1e-12)))
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_topk_graph_matches_numpy_ranking(setup, fused):
     config, params, articles = setup
     corpus = make_corpus(config, params, articles)
     slot = corpus.active
-    fn = make_serve_fn(config, 7)
+    fn = make_serve_fn(config, 7, fused=fused)
     queries = articles[:5]
     scores, idx = jax.device_get(
-        fn(params, slot.emb, slot.valid, queries))
+        fn(params, slot.emb, slot.valid, slot.scales, queries))
     # oracle: encode everything densely on host via the same jitted encode
     from dae_rnn_news_recommendation_tpu.train.step import make_encode_fn
 
     enc = make_encode_fn(config)
-    unit = lambda h: h / (np.linalg.norm(h, axis=-1, keepdims=True) + 1e-9)
-    emb = unit(np.asarray(jax.device_get(enc(params, articles))))
-    qh = unit(np.asarray(jax.device_get(enc(params, queries))))
+    emb = _unit(np.asarray(jax.device_get(enc(params, articles))))
+    qh = _unit(np.asarray(jax.device_get(enc(params, queries))))
     oracle = (qh @ emb.T).argsort(axis=1)[:, ::-1][:, :7]
     np.testing.assert_array_equal(idx, oracle)
     assert np.all(np.diff(scores, axis=1) <= 1e-6)  # descending
+
+
+def test_fused_and_unfused_serve_graphs_agree_bitwise(setup):
+    """The fused scorer must be a drop-in for the r07 materializing path:
+    identical scores (bitwise) and identical tie-broken indices."""
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    slot = corpus.active
+    queries = articles[:9]
+    a = jax.device_get(make_serve_fn(config, 7, fused=True)(
+        params, slot.emb, slot.valid, slot.scales, queries))
+    b = jax.device_get(make_serve_fn(config, 7, fused=False)(
+        params, slot.emb, slot.valid, slot.scales, queries))
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
 
 
 def test_query_of_a_corpus_row_ranks_itself_first(setup):
