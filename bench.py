@@ -192,11 +192,13 @@ SIZES = {
                 # of 8000 rows -> 800): at larger B the O(B^2)-per-article
                 # batch_all mining dominates and hides the feed design
                 stream_rows=16000, stream_batch=800, stream_epochs=2,
-                serve_corpus=8192, serve_requests=512),
+                serve_corpus=8192, serve_requests=512,
+                churn_corpus=8192, churn_batch=512, churn_cycles=8),
     "cpu": dict(batch=2048, n_batches=6, warmup=1, prefetch=2,
                 train_batch=256, train_steps=6, train_warmup=1,
                 stream_rows=2048, stream_batch=512, stream_epochs=1,
-                serve_corpus=1024, serve_requests=128),
+                serve_corpus=1024, serve_requests=128,
+                churn_corpus=1024, churn_batch=256, churn_cycles=4),
 }
 
 # Where the stream feed's H2D transfer is issued, per backend — a RECORDED
@@ -1062,6 +1064,64 @@ def _bench_serve(jax, params, config, sz):
     return out
 
 
+def _bench_churn(jax, params, config, sz):
+    """Continuous-refresh figures (refresh/): steady-state incremental ingest
+    cycles against a resident corpus — micro-batch encode throughput of the
+    new articles, and the p50/p95 wall of the versioned swap_incremental
+    (build + append + age bookkeeping + health gate + promote). The swap
+    percentiles are per-ledger-record `duration_s`, stamped inside the corpus
+    under its own lock, so they include everything a serving replica would
+    block behind. Drift ceilings are opened wide: the bench measures the
+    fault-free steady-state path; trip behavior is tested, not benched."""
+    import scipy.sparse as sp
+
+    from dae_rnn_news_recommendation_tpu.refresh import (ChurnConfig,
+                                                         ChurnSupervisor)
+    from dae_rnn_news_recommendation_tpu.serve import ServingCorpus
+
+    n_corpus = sz.get("churn_corpus", 1024)
+    n_batch = sz.get("churn_batch", 256)
+    n_cycles = sz.get("churn_cycles", 4)
+    articles = sp.random(n_corpus, F, density=0.005, format="csr",
+                         random_state=13, dtype=np.float32)
+    corpus = ServingCorpus(config, block=512)
+    sup = ChurnSupervisor(
+        params, config, corpus,
+        churn=ChurnConfig(microbatch=n_batch, drift_centroid_max=4.0,
+                          drift_collapse_max=4.0))
+    sup.bootstrap(articles, note="bench")
+
+    def fresh_batch(i):
+        return sp.random(n_batch, F, density=0.005, format="csr",
+                         random_state=100 + i, dtype=np.float32)
+
+    _phase("churn: warmup cycle (encode scan + drift graph compiles)")
+    warm = sup.ingest(fresh_batch(0), note="warmup")
+    assert warm["action"] == "incremental", warm
+    _phase(f"churn: {n_cycles} steady-state ingest cycles")
+    reports = [sup.ingest(fresh_batch(1 + i)) for i in range(n_cycles)]
+    assert all(r["action"] == "incremental" for r in reports), reports
+    encode_s = sum(r["encode_s"] for r in reports)
+    swaps_ms = sorted(r["swap_s"] * 1e3 for r in reports)
+    out = {
+        "churn_encode_articles_per_sec": round(
+            n_cycles * n_batch / max(encode_s, 1e-9), 1),
+        "refresh_swap_p50_ms": round(float(np.percentile(swaps_ms, 50)), 2),
+        "refresh_swap_p95_ms": round(float(np.percentile(swaps_ms, 95)), 2),
+        "churn_cycle_p95_ms": round(float(np.percentile(
+            sorted(r["cycle_s"] * 1e3 for r in reports), 95)), 2),
+        "churn_shape": (f"{n_cycles} cycles x {n_batch} new articles onto "
+                        f"{n_corpus} resident, microbatch {n_batch}, "
+                        f"{F}->{D}"),
+        "churn_final_version": corpus.version,
+        "churn_final_rows": corpus.active.n,
+    }
+    # the gate must have passed every cycle or the figures above measured a
+    # rollback path by accident
+    assert corpus.version == 2 + n_cycles, corpus.ledger
+    return out
+
+
 def child_main():
     _phase("child started; initializing backend")
     import jax
@@ -1270,6 +1330,11 @@ def child_main():
         extra.update(_bench_serve(jax, params, config, sz))
     except Exception as e:
         extra["serve_error"] = repr(e)[-300:]
+    try:
+        _phase("churn: incremental refresh encode + swap percentiles")
+        extra.update(_bench_churn(jax, params, config, sz))
+    except Exception as e:
+        extra["churn_error"] = repr(e)[-300:]
 
     unit_kind = "sparse-ingest stream"
     if platform == "tpu":

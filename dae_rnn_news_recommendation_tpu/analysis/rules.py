@@ -1,4 +1,4 @@
-"""jaxcheck rules R1-R10 — AST checkers for the JAX hazard classes this repo
+"""jaxcheck rules R1-R13 — AST checkers for the JAX hazard classes this repo
 has been bitten by (see docs/jaxcheck.md for the catalog with in-repo
 examples of each).
 
@@ -1463,4 +1463,84 @@ def check_r12(ctx):
                     "round to the input dtype — pass preferred_element_type"
                     "=jnp.float32 (or carry a reasoned disable where narrow "
                     "accumulation is the numerical contract)"))
+    return out
+
+
+# ------------------------------------------------------------------- R13
+
+_R13_WALL = {"time.time"}
+# identifier parts that mark a name as deadline/timeout state (matched on
+# underscore-split parts, not substrings: `send`/`pending` stay clean)
+_R13_TOKENS = {"deadline", "deadlines", "timeout", "timeouts", "expire",
+               "expires", "expiry", "due", "cutoff", "until"}
+
+
+def _r13_deadline_name(name):
+    if not name:
+        return False
+    parts = name.lower().replace(".", "_").split("_")
+    return bool(set(parts) & _R13_TOKENS)
+
+
+def _r13_wall_call(node):
+    """The first time.time() Call under `node`, else None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and call_name(sub) in _R13_WALL:
+            return sub
+    return None
+
+
+@rule("R13", "wall-clock time.time() in deadline/timeout arithmetic")
+def check_r13(ctx):
+    """Deadline and timeout arithmetic in the serve/feed/refresh loops must
+    use time.monotonic(): time.time() is WALL clock — NTP steps, leap
+    smearing, and manual clock changes move it backwards or jump it forward,
+    so a deadline derived from it fires early, late, or never (a request
+    that never sheds, a watchdog that kills a healthy worker). Flagged:
+    assignments of time.time() arithmetic to deadline-ish names
+    (`deadline = time.time() + budget`), comparisons against deadline-ish
+    names (`while time.time() < deadline`), elapsed-vs-limit comparisons
+    (`time.time() - t0 > timeout_s`), and deadline-ish keyword arguments fed
+    from time.time(). Plain wall-clock TIMESTAMPS (log/manifest `ts` fields,
+    `train_time` durations, tfevents filenames) are not deadline state and
+    pass; a genuine wall-clock deadline contract (e.g. an absolute cron-like
+    due time from an external system) carries a reasoned
+    `# jaxcheck: disable=R13`."""
+    out = []
+    seen = set()
+
+    def flag(node, what):
+        if node.lineno in seen:
+            return
+        seen.add(node.lineno)
+        out.append(ctx.finding(
+            node, f"{what} uses wall-clock time.time() — NTP steps/clock "
+            "jumps make the deadline fire early, late, or never; use "
+            "time.monotonic() for intervals (keep time.time() only for "
+            "log/manifest timestamps)"))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            wall = _r13_wall_call(node.value)
+            if wall and any(_r13_deadline_name(d)
+                            for d in assign_target_names(node)):
+                flag(node, "deadline/timeout assignment")
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + node.comparators
+            wall_sides = [s for s in sides if _r13_wall_call(s)]
+            if not wall_sides:
+                continue
+            names = set()
+            for s in sides:
+                if s not in wall_sides:
+                    names |= names_in(s)
+            elapsed = any(isinstance(s, ast.BinOp) and
+                          isinstance(s.op, ast.Sub) for s in wall_sides)
+            if elapsed or any(_r13_deadline_name(n) for n in names):
+                flag(node, "deadline/timeout comparison")
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg and _r13_deadline_name(kw.arg) and \
+                        _r13_wall_call(kw.value):
+                    flag(node, f"`{kw.arg}=` argument")
     return out

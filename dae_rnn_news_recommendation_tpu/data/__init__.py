@@ -6,3 +6,4 @@ from .batcher import (  # noqa: F401
     gen_batches_triplet,
 )
 from .io import save_file, read_file  # noqa: F401
+from .incremental import IncrementalVectorizer  # noqa: F401

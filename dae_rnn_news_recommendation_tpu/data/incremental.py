@@ -1,0 +1,114 @@
+"""Incremental vectorization against a FROZEN vocabulary (corpus churn).
+
+The offline pipeline (articles.py) fits a CountVectorizer once and the DAE's
+input width is that vocabulary size forever after — refitting on every batch
+of fresh articles would silently renumber every feature column and invalidate
+the trained encoder. The churn path (refresh/) therefore never refits: new
+articles are transformed against the frozen vocabulary, and out-of-vocabulary
+terms are HASH-BUCKETED into the existing feature space (the hashing-trick
+compromise: a stable crc32 of the term picks a column, colliding with
+in-vocabulary terms by design) instead of being dropped on the floor. A new
+slang term that suddenly dominates the news cycle still produces signal mass
+the encoder can see, at the cost of bounded collision noise — and the OOV
+fraction is recorded per batch so drift in it is observable long before the
+embedding drift gate trips.
+
+crc32 (not Python hash()) so bucketing is stable across processes and
+PYTHONHASHSEED — a chaos restart must re-vectorize a replayed batch to the
+byte-identical matrix, or the crash-exact story breaks at the feed.
+"""
+
+import zlib
+
+import numpy as np
+import scipy.sparse as sp
+from sklearn.feature_extraction.text import CountVectorizer
+
+
+def _stable_bucket(term, n_buckets):
+    """Deterministic term -> bucket, stable across processes and runs."""
+    return zlib.crc32(term.encode("utf-8")) % n_buckets
+
+
+class IncrementalVectorizer:
+    """Transform new article text with a frozen vocabulary + OOV hashing.
+
+    `vocabulary` is a {term: column} dict (a fitted CountVectorizer's
+    `vocabulary_`) or any mapping; `n_features` defaults to its width and must
+    match the trained model's input width. `oov_buckets` restricts OOV hashes
+    to the LAST `oov_buckets` columns (isolating collision noise to a tail
+    region); the default hashes over the whole space like a standard hashing
+    vectorizer.
+
+    Stateless across calls except for cumulative OOV accounting — transform
+    never mutates the vocabulary, so the same input always yields the same
+    matrix (the property the chaos_churn replay asserts).
+    """
+
+    def __init__(self, vocabulary, *, n_features=None, tokenizer=None,
+                 oov_buckets=None, lowercase=True):
+        self.vocabulary = dict(vocabulary)
+        self.n_features = int(n_features if n_features is not None
+                              else len(self.vocabulary))
+        assert self.n_features >= max(self.vocabulary.values(), default=-1) + 1
+        self.oov_buckets = oov_buckets
+        if oov_buckets is not None:
+            assert 0 < oov_buckets <= self.n_features
+        # reuse sklearn's analyzer (tokenization + lowercasing + ngrams) so
+        # incremental tokenization is bit-compatible with the offline fit
+        self._analyze = CountVectorizer(
+            tokenizer=tokenizer, lowercase=lowercase,
+            token_pattern=None if tokenizer is not None else r"(?u)\b\w\w+\b",
+        ).build_analyzer()
+        self.n_docs = 0
+        self.n_terms = 0
+        self.n_oov = 0
+
+    @classmethod
+    def from_fitted(cls, count_vectorizer, **kw):
+        """Freeze a fitted CountVectorizer's vocabulary (and tokenizer)."""
+        return cls(count_vectorizer.vocabulary_,
+                   tokenizer=count_vectorizer.tokenizer, **kw)
+
+    def _column(self, term):
+        col = self.vocabulary.get(term)
+        if col is not None:
+            return col, False
+        if self.oov_buckets is None:
+            return _stable_bucket(term, self.n_features), True
+        return (self.n_features - self.oov_buckets
+                + _stable_bucket(term, self.oov_buckets)), True
+
+    def transform(self, texts):
+        """[n_docs] iterable of strings -> CSR [n_docs, n_features] float32
+        term counts (OOV terms counted in their hash bucket)."""
+        indptr, indices, data = [0], [], []
+        n_terms = n_oov = 0
+        for text in texts:
+            counts = {}
+            for term in self._analyze(text):
+                col, oov = self._column(term)
+                counts[col] = counts.get(col, 0) + 1
+                n_terms += 1
+                n_oov += oov
+            cols = sorted(counts)
+            indices.extend(cols)
+            data.extend(counts[c] for c in cols)
+            indptr.append(len(indices))
+        self.n_docs += len(indptr) - 1
+        self.n_terms += n_terms
+        self.n_oov += n_oov
+        return sp.csr_matrix(
+            (np.asarray(data, np.float32), np.asarray(indices, np.int64),
+             np.asarray(indptr, np.int64)),
+            shape=(len(indptr) - 1, self.n_features))
+
+    @property
+    def oov_fraction(self):
+        """Cumulative fraction of tokens that hashed instead of matched."""
+        return self.n_oov / max(self.n_terms, 1)
+
+    def stats(self):
+        return {"n_docs": self.n_docs, "n_terms": self.n_terms,
+                "n_oov": self.n_oov,
+                "oov_fraction": round(self.oov_fraction, 6)}

@@ -533,6 +533,28 @@ class DenoisingAutoencoder:
         self._write_fault_manifest()
         return self
 
+    def finetune(self, train_set, *, num_epochs=1, train_set_label=None,
+                 validation_set=None, validation_set_label=None):
+        """Warm-start fine-tune: resume from the newest VERIFIED checkpoint
+        under this model's dir and run `num_epochs` more epochs — the entry
+        the corpus-churn refresh loop (refresh/churn.py) calls when drift
+        trips or on its periodic schedule.
+
+        This is `fit(restore_previous_model=True)` with a scoped epoch
+        budget, so it rides the crash-exact resume machinery unchanged: a
+        fine-tune killed mid-epoch restarts from the step-cadence cursor
+        checkpoint and replays the identical trajectory (the chaos_churn
+        soak asserts bitwise params parity on CPU)."""
+        prev = self.num_epochs
+        self.num_epochs = int(num_epochs)
+        try:
+            return self.fit(train_set, validation_set=validation_set,
+                            train_set_label=train_set_label,
+                            validation_set_label=validation_set_label,
+                            restore_previous_model=True)
+        finally:
+            self.num_epochs = prev
+
     def _log_param_histograms(self, train_writer, gstep):
         """Parameter histograms in the scalars' global-batch-step domain
         (reference tf.summary.histogram for W and biases, autoencoder.py:391-393,

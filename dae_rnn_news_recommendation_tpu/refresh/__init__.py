@@ -1,0 +1,31 @@
+"""Continuous corpus churn: crash-safe incremental refresh of the serving
+corpus, with drift-gated promotion.
+
+News articles live for hours, not epochs — the pipeline (vectorize -> DAE
+encode -> resident corpus) is only production-real if new articles stream in,
+get encoded, and start serving without a full refit and without ever serving
+a corrupt or drifted corpus. This package is the seam between the crash-exact
+training side (reliability/) and the health-gated serving side (serve/):
+
+    vec = IncrementalVectorizer.from_fitted(count_vectorizer)   # frozen vocab
+    sup = ChurnSupervisor(params, config, corpus,
+                          churn=ChurnConfig(max_rows=10_000,
+                                            max_age_versions=48),
+                          vectorizer=vec, finetune_fn=my_finetune)
+    sup.bootstrap(initial_articles)       # full build + gate + promote
+    for batch in article_stream:
+        report = sup.ingest(batch)        # vectorize -> encode -> drift gate
+                                          # -> incremental swap (or
+                                          # fine-tune-then-rebuild on a trip)
+
+Every refresh step has a fault site (`refresh.ingest` / `refresh.encode` /
+`refresh.swap` / `refresh.finetune`) and the chaos_churn soak
+(reliability/chaos_churn.py) replays seeded fault plans through the whole
+loop, asserting the served corpus is always a health-gated, version-monotonic
+state and that a crashed fine-tune resumes bitwise-exact. Full story in
+docs/reliability.md ("Corpus churn & refresh") and docs/serving.md.
+"""
+
+from .churn import ChurnConfig, ChurnSupervisor, DriftTripped
+
+__all__ = ["ChurnConfig", "ChurnSupervisor", "DriftTripped"]

@@ -1,0 +1,306 @@
+"""The churn supervisor: stream -> frozen-vocab vectorize -> micro-batch
+encode -> drift gate -> incremental swap (or fine-tune-then-rebuild).
+
+One `ingest()` call is one refresh cycle:
+
+  1. `refresh.ingest` fires; raw texts are vectorized against the FROZEN
+     vocabulary (data/incremental.IncrementalVectorizer — OOV terms hash into
+     the existing feature space, never a refit). Pre-vectorized matrices pass
+     through.
+  2. `refresh.encode` fires per micro-batch; the batch is encoded through the
+     same jitted scan graph the corpus build uses (serve/graph.
+     make_corpus_encode_fn), at a FIXED micro-batch shape so the whole stream
+     reuses one compile.
+  3. The drift gate compares the fresh embeddings against the active corpus
+     version's gate stats (telemetry/health.drift_health, in-graph): a
+     centroid shift or collapse delta past the configured ceilings means the
+     encoder is stale for this data — appending would serve drifted
+     embeddings, so the swap is BLOCKED and the supervisor fine-tunes from
+     checkpoint (`refresh.finetune`, models/estimator.finetune) and rebuilds
+     the corpus with the fresh params instead.
+  4. Otherwise `ServingCorpus.swap_incremental` appends the rows (age-based
+     eviction, tail health gate, version-monotonic promote, rollback on any
+     failure) — `refresh.swap` fires inside.
+
+Transient faults at ingest/encode are absorbed by a bounded RetryPolicy
+(recorded, never silent); fatal/preempt faults propagate to the caller — the
+chaos harness (reliability/chaos_churn.py) is the supervisor-of-supervisors
+that restarts the interrupted cycle, exactly like the training soak restarts
+a killed fit. The supervisor keeps a host-side mirror of the rows currently
+resident (trimmed in lockstep with the corpus's evictions) so a
+fine-tune-then-rebuild always has the full training set for the rows it is
+about to re-encode.
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from ..reliability import faults as _faults
+from ..reliability.retry import RetryPolicy
+from ..serve.graph import block_indices, make_corpus_encode_fn
+from ..telemetry.health import drift_health
+from ..train.resident import build_resident
+
+
+class DriftTripped(RuntimeError):
+    """Embedding drift past the ceilings with no fine-tune path configured:
+    the swap is blocked and the caller must decide (the configured-finetune
+    path handles this automatically with fine-tune-then-rebuild)."""
+
+
+@dataclasses.dataclass
+class ChurnConfig:
+    """Refresh-loop policy knobs.
+
+    :param microbatch: encode micro-batch rows (one compiled shape).
+    :param max_rows: corpus capacity; oldest-version rows evict beyond it.
+    :param max_age_versions: rows older than this many corpus versions evict
+        on the next incremental swap (news expiry). None = keep forever.
+    :param drift_centroid_max: centroid cosine-shift ceiling for the gate.
+    :param drift_collapse_max: |collapse delta| ceiling for the gate.
+    :param finetune_every: fine-tune-then-rebuild every N successful cycles
+        (0 = only on drift trips / explicit finetune() calls).
+    """
+
+    microbatch: int = 64
+    max_rows: int = None
+    max_age_versions: int = None
+    drift_centroid_max: float = 0.25
+    drift_collapse_max: float = 0.20
+    finetune_every: int = 0
+
+
+class ChurnSupervisor:
+    """Drives continuous refresh of a ServingCorpus from an article stream.
+
+    :param params: current encoder params (replaced after each fine-tune).
+    :param config: the model's DAEConfig (the encode graph's shape source).
+    :param corpus: a serve.corpus.ServingCorpus; bootstrap() seeds it.
+    :param churn: a ChurnConfig (default: ChurnConfig()).
+    :param vectorizer: data/incremental.IncrementalVectorizer for raw-text
+        batches; pre-vectorized [n, F] batches need none.
+    :param finetune_fn: `fn(train_rows) -> new_params` — typically a closure
+        over models/estimator.finetune. Without one, a drift trip raises
+        DriftTripped instead of fine-tuning.
+    :param retry: RetryPolicy absorbing transient ingest/encode faults
+        (default: 3 attempts, small jittered backoff).
+    """
+
+    def __init__(self, params, config, corpus, *, churn=None, vectorizer=None,
+                 finetune_fn=None, retry=None):
+        self.params = params
+        self.config = config
+        self.corpus = corpus
+        self.churn = churn or ChurnConfig()
+        self.vectorizer = vectorizer
+        self.finetune_fn = finetune_fn
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, backoff_s=0.005, max_elapsed_s=0.5)
+        self._encode_fn = make_corpus_encode_fn(config)
+        self._drift_fn = jax.jit(drift_health)
+        self._store = []      # host mirror of resident rows, age order
+        self.n_cycles = 0
+        self.history = []     # one report dict per ingest cycle
+        self.drift_trips = []
+        self.finetunes = []
+
+    # ------------------------------------------------------------- lifecycle
+    def bootstrap(self, articles, note="bootstrap"):
+        """Seed the corpus with a full build + gate + promote, and start the
+        host-side row mirror the fine-tune rebuilds train on."""
+        slot = self.corpus.swap(self.params, articles, note=note)
+        self._store = [articles]
+        return slot
+
+    # ----------------------------------------------------------- one cycle
+    def ingest(self, batch, note=""):
+        """One refresh cycle over `batch` (raw-text iterable when a
+        vectorizer is configured, else a dense [n, F] / scipy CSR matrix).
+        Returns the cycle report (also appended to `history`)."""
+        self.n_cycles += 1
+        cycle = self.n_cycles
+        t0 = time.monotonic()
+        self.retry.run(_faults.fire, "refresh.ingest", site="refresh.ingest",
+                       cycle=cycle)
+        X = self._vectorize(batch)
+        t_enc = time.monotonic()
+        emb = self._encode(X)
+        encode_s = time.monotonic() - t_enc
+        drift = self._drift(emb)
+        report = {"cycle": cycle, "n_new": int(X.shape[0]), "drift": drift,
+                  "note": note, "encode_s": round(encode_s, 4)}
+        if self.vectorizer is not None:
+            report["oov_fraction"] = round(self.vectorizer.oov_fraction, 6)
+        if drift is not None and drift["tripped"]:
+            self.drift_trips.append({"cycle": cycle, **drift})
+            report.update(self._finetune_rebuild(
+                X, reason=f"drift trip at cycle {cycle}"))
+            report["action"] = "finetune_rebuild"
+        else:
+            report.update(self._append(X, emb, cycle))
+        if (report["action"] == "incremental"
+                and self.churn.finetune_every
+                and cycle % self.churn.finetune_every == 0):
+            report.update(self._finetune_rebuild(
+                None, reason=f"periodic (every {self.churn.finetune_every})"))
+            report["action"] = "incremental+finetune_rebuild"
+        report["cycle_s"] = round(time.monotonic() - t0, 4)
+        self.history.append(report)
+        return report
+
+    def finetune(self, reason="requested"):
+        """Explicit fine-tune-then-rebuild over the resident rows."""
+        out = self._finetune_rebuild(None, reason=reason)
+        self.history.append({"cycle": self.n_cycles, "action": "finetune",
+                             **out})
+        return out
+
+    # -------------------------------------------------------------- stages
+    def _vectorize(self, batch):
+        if hasattr(batch, "shape"):
+            return batch
+        assert self.vectorizer is not None, (
+            "raw-text batches need an IncrementalVectorizer")
+        return self.vectorizer.transform(batch)
+
+    def _encode(self, X):
+        """Fixed-shape micro-batch encode through the jitted scan graph; the
+        rows come back unit-norm f32 on host, ready for the drift gate and
+        the swap append."""
+        mb = int(self.churn.microbatch)
+        outs = []
+        for start in range(0, int(X.shape[0]), mb):
+            chunk = X[start:start + mb]
+            self.retry.run(_faults.fire, "refresh.encode",
+                           site="refresh.encode", rows=int(chunk.shape[0]))
+            resident = build_resident(chunk)
+            blocks = block_indices(int(chunk.shape[0]), mb)
+            outs.append(np.asarray(jax.device_get(self._encode_fn(
+                self.params, resident, blocks)))[: int(chunk.shape[0])])
+        return np.concatenate(outs, axis=0)
+
+    def _drift(self, emb):
+        """Drift report of the fresh embeddings vs the active version's gate
+        stats, or None before any reference exists. Padded to the micro-batch
+        multiple so every cycle reuses one compiled drift graph."""
+        slot = self.corpus.active
+        ref = getattr(slot, "stats", None) or {}
+        if "centroid" not in ref:
+            return None
+        mb = int(self.churn.microbatch)
+        n = emb.shape[0]
+        n_pad = int(np.ceil(n / mb)) * mb
+        padded = np.zeros((n_pad, emb.shape[1]), np.float32)
+        padded[:n] = emb
+        valid = np.zeros(n_pad, np.float32)
+        valid[:n] = 1.0
+        rep = jax.device_get(self._drift_fn(
+            jnp.asarray(padded), jnp.asarray(ref["centroid"], jnp.float32),
+            jnp.float32(ref["collapse"]), row_valid=jnp.asarray(valid)))
+        shift = float(rep["health/drift_centroid_shift"])
+        delta = float(rep["health/drift_collapse_delta"])
+        return {"centroid_shift": round(shift, 6),
+                "collapse_delta": round(delta, 6),
+                "ref_version": slot.version,
+                "tripped": bool(shift > self.churn.drift_centroid_max
+                                or delta > self.churn.drift_collapse_max)}
+
+    def _append(self, X, emb, cycle):
+        """Incremental swap + host-mirror bookkeeping. A rollback (injected
+        refresh.swap fault, gate refusal) leaves both the corpus AND the
+        mirror untouched — the caller sees action='rollback' and owns the
+        retry, so a replayed cycle reconverges to the fault-free state."""
+        before = self.corpus.version
+        self.corpus.swap_incremental(
+            self.params, X, emb=emb, max_rows=self.churn.max_rows,
+            max_age_versions=self.churn.max_age_versions,
+            note=f"churn-{cycle}")
+        led = self.corpus.ledger[-1]
+        if not led["ok"] or self.corpus.version == before:
+            return {"action": "rollback", "version": self.corpus.version,
+                    "error": led.get("error", "")}
+        self._store.append(X)
+        self._trim_store(led["n_evicted"])
+        return {"action": "incremental", "version": led["version"],
+                "n_added": led["n_added"], "n_evicted": led["n_evicted"],
+                "gate": led["gate"], "swap_s": led["duration_s"]}
+
+    def _finetune_rebuild(self, X_new, reason):
+        """The drift response: fine-tune the encoder from its newest
+        checkpoint over everything resident (plus the triggering batch), then
+        FULL-rebuild the corpus with the fresh params — never an incremental
+        append of embeddings the gate just called stale."""
+        self.retry.run(_faults.fire, "refresh.finetune",
+                       site="refresh.finetune", reason=reason)
+        if self.finetune_fn is None:
+            raise DriftTripped(
+                f"{reason}: drift past ceilings and no finetune_fn "
+                "configured — refusing to swap stale embeddings")
+        rows = self._store + ([X_new] if X_new is not None else [])
+        train = _stack(rows)
+        t0 = time.monotonic()
+        self.params = self.finetune_fn(train)
+        finetune_s = round(time.monotonic() - t0, 4)
+        slot = self.corpus.swap(self.params, train,
+                                note=f"finetune-rebuild: {reason}")
+        self._store = [train]
+        out = {"reason": reason, "finetune_s": finetune_s,
+               "version": slot.version, "n_rows": int(train.shape[0])}
+        self.finetunes.append(out)
+        return out
+
+    def _trim_store(self, n_evicted):
+        """Mirror the corpus's oldest-first eviction: drop `n_evicted` rows
+        off the front of the host store (splitting a block if needed)."""
+        n = int(n_evicted)
+        while n > 0 and self._store:
+            head = self._store[0]
+            rows = int(head.shape[0])
+            if rows <= n:
+                self._store.pop(0)
+                n -= rows
+            else:
+                self._store[0] = head[n:]
+                n = 0
+
+    # ------------------------------------------------------------ reporting
+    def resident_rows(self):
+        return sum(int(b.shape[0]) for b in self._store)
+
+    def summary(self):
+        return {"n_cycles": self.n_cycles,
+                "resident_rows": self.resident_rows(),
+                "corpus_version": self.corpus.version,
+                "drift_trips": list(self.drift_trips),
+                "finetunes": list(self.finetunes),
+                "retries": list(self.retry.events),
+                "ledger": list(self.corpus.ledger)}
+
+    def dump_history(self, path):
+        """Write the cycle history + summary as JSON for `telemetry report
+        --churn` (dropped as churn_history.json next to a trace, the report
+        auto-detects it like the health bundle). Atomic tmp+rename so a
+        crash mid-dump never leaves a torn file for the report to choke on."""
+        payload = {"history": self.history, "summary": {
+            k: v for k, v in self.summary().items() if k != "ledger"}}
+        payload["summary"]["finetunes"] = len(self.finetunes)
+        payload["summary"]["retries"] = len(self.retry.events)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, default=str)
+        os.replace(tmp, path)
+        return path
+
+
+def _stack(blocks):
+    if any(sp.issparse(b) for b in blocks):
+        return sp.vstack([sp.csr_matrix(b) for b in blocks], format="csr")
+    return np.concatenate([np.asarray(b) for b in blocks], axis=0)

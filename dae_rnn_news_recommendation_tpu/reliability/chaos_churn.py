@@ -1,0 +1,412 @@
+"""Chaos soak for the continuous-refresh loop: replay seeded fault plans
+through bootstrap -> N ingest cycles -> fine-tune, and prove the served
+corpus never leaves the health-gated, version-monotonic path.
+
+The shape mirrors `reliability/chaos.py` (ISSUE 6), lifted one level up the
+stack: instead of supervising a single `fit`, `run_churn_plan` supervises a
+whole ChurnSupervisor session. For each plan:
+
+  1. ONE base estimator trains fault-free (the production model the refresh
+     loop starts from). Its checkpoint lineage is copied to a `ref/` and a
+     `chaos/` directory so both runs fine-tune from byte-identical state.
+  2. A fault-free REFERENCE session: bootstrap the corpus, ingest the same
+     deterministic article stream, finish with a fine-tune-then-rebuild.
+     Its final params digest and promote count are the ground truth.
+  3. A CHAOS session replays the identical stream under
+     `faults.install(FaultInjector(plan))`. The harness is the restart
+     supervisor: an injected crash (`refresh.*` fatal, or a `train.step`
+     preemption INSIDE the fine-tune) is caught and the interrupted
+     operation is replayed; a `refresh.swap` crash surfaces as a corpus
+     ROLLBACK (the supervisor's ledger shows ok=False, version unchanged)
+     and the harness re-ingests that batch. The fine-tune closure computes
+     remaining epochs from the newest verified checkpoint, so a
+     mid-fine-tune preemption resumes crash-exact (r05 machinery).
+
+Acceptance per plan: the injector fired at least one fault; every promoted
+ledger record passed its health gate; promoted versions are strictly
+monotonic (+1 each) and the chaos session promotes exactly as many versions
+as the reference; every INJECTED swap crash ends in rollback followed by a
+verified newer version, and every rollback of any kind leaves a verified
+version serving; and on CPU the chaos session's final params are BITWISE
+identical to the
+reference's (allclose elsewhere, reported separately — same contract as the
+training soak).
+"""
+
+import dataclasses
+import os
+import shutil
+import time
+
+import numpy as np
+
+from . import faults as _faults
+from .chaos import (_completed_epochs, _drain_async, _params_allclose,
+                    params_digest, soak_data)
+from .faults import FaultInjector, FaultPlan, FaultSpec, InjectedFault
+
+BASE_EPOCHS = 2    # fault-free base fit shared by ref/ and chaos/
+FT_EPOCHS = 1      # the closing fine-tune adds this many epochs
+ROWS_PER_BATCH = 12
+
+
+def churn_fault_plan(seed, n_cycles=4):
+    """Seeded plan targeting the refresh loop. seed % 6 picks the family
+    (any 6 consecutive seeds cover all of them); the fatal/preempt call
+    index is drawn from the seed so replays are exact.
+
+      0  refresh.ingest fatal    — supervisor dies before vectorizing
+      1  refresh.encode fatal    — supervisor dies before an encode dispatch
+      2  refresh.encode transient— flaky dispatch, RetryPolicy absorbs it
+      3  refresh.swap fatal      — append dies inside the corpus: ROLLBACK
+      4  refresh.finetune fatal  — death before the warm-start fine-tune
+      5  train.step preempt      — preemption INSIDE the fine-tune fit;
+                                   resume must be crash-exact
+    """
+    rng = np.random.default_rng(seed)
+    cyc = int(rng.integers(2, n_cycles + 1))
+    families = (
+        (FaultSpec("refresh.ingest", cyc, "fatal",
+                   note="supervisor death before vectorize"),),
+        (FaultSpec("refresh.encode", cyc, "fatal",
+                   note="supervisor death before encode dispatch"),),
+        (FaultSpec("refresh.encode", cyc, "transient",
+                   note="flaky encode dispatch"),),
+        (FaultSpec("refresh.swap", cyc, "fatal",
+                   note="append death inside swap -> rollback"),),
+        (FaultSpec("refresh.finetune", 1, "fatal",
+                   note="death before warm-start fine-tune"),),
+        (FaultSpec("train.step", int(rng.integers(2, 6)), "preempt",
+                   note="preemption mid-fine-tune"),),
+    )
+    return FaultPlan(seed=int(seed), specs=families[seed % len(families)])
+
+
+def make_churn_estimator_factory(root, seed, **overrides):
+    """Estimator factory for the churn soak. Unlike the training soak's
+    factory, model_name/main_dir are tag-INDEPENDENT ("churn") and only
+    `results_root` varies per tag — that is what lets the base run's
+    checkpoint directory be copytree'd to ref/ and chaos/ with the lineage
+    (epoch numbering, resume sidecars) intact."""
+    from ..models.estimator import DenoisingAutoencoder
+
+    defaults = dict(
+        num_epochs=BASE_EPOCHS, batch_size=ROWS_PER_BATCH, verbose=False,
+        use_tensorboard=False, seed=11 + seed, opt="momentum", momentum=0.7,
+        learning_rate=0.05, corr_type="masking", corr_frac=0.3,
+        triplet_strategy="none", checkpoint_every=1, checkpoint_every_steps=2,
+        feed="pipelined", io_backoff_s=0.002, n_components=4)
+
+    def make(tag, num_epochs):
+        kw = dict(defaults)
+        kw.update(overrides)
+        kw["num_epochs"] = int(num_epochs)
+        return DenoisingAutoencoder(
+            model_name="churn", main_dir="churn/",
+            results_root=os.path.join(root, f"plan{seed}", tag), **kw)
+
+    return make
+
+
+def churn_stream(seed, n_cycles=4, rows=ROWS_PER_BATCH, n_features=24):
+    """The deterministic article stream both sessions ingest."""
+    rng = np.random.default_rng(1000 + seed)
+    return [rng.random((rows, n_features), dtype=np.float32)
+            for _ in range(n_cycles)]
+
+
+@dataclasses.dataclass
+class ChurnPlanResult:
+    plan: dict
+    ok: bool
+    bitwise: bool
+    allclose: bool
+    restarts: int
+    rollbacks: int
+    injected: list      # injector.fired
+    retries: list       # supervisor RetryPolicy events (absorbed transients)
+    versions: list      # promoted versions, chaos session, ledger order
+    ref_versions: list
+    n_finetunes: int
+    detail: str
+    duration_s: float
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def _make_finetune_fn(make, tag, total_epochs):
+    """fn(train_rows) -> params: warm-start fine-tune from the newest
+    VERIFIED checkpoint in `tag`'s directory, sized so base + fine-tune
+    always totals `total_epochs` — a crashed attempt's restart recomputes
+    the remainder from disk, exactly like chaos.run_plan."""
+
+    def finetune(train):
+        est = make(tag, 0)
+        completed = _completed_epochs(est.model_path)
+        remaining = (total_epochs if completed is None
+                     else max(total_epochs - completed, 0))
+        try:
+            est.finetune(train, num_epochs=remaining)
+        except BaseException:
+            _drain_async(est)
+            raise
+        return est.params
+
+    return finetune
+
+
+def _audit_ledger(ledger):
+    """Monotonicity + gate audit of a supervisor's corpus ledger. Returns
+    (ok_versions, rollbacks, problems)."""
+    problems = []
+    promoted = [rec for rec in ledger if rec["ok"]]
+    versions = [rec["version"] for rec in promoted]
+    if versions != list(range(1, len(versions) + 1)):
+        problems.append(f"versions not monotonic: {versions}")
+    for rec in promoted:
+        gate = rec.get("gate") or {}
+        if not gate.get("ok"):
+            problems.append(f"promoted v{rec['version']} without gate ok")
+    rollbacks = [rec for rec in ledger if not rec["ok"]]
+    for rec in rollbacks:
+        if rec.get("active_version") not in versions:
+            problems.append(
+                "rollback left no verified version serving "
+                f"(active was v{rec.get('active_version')})")
+        if "injected" in rec.get("error", ""):
+            # An injected swap crash must END in recovery: the harness replays
+            # the cycle, so a verified NEWER version must follow. A genuine
+            # health-gate refusal (e.g. a fine-tune that collapsed past the
+            # ceiling) is the gate doing its job — keeping the old verified
+            # version serving IS the correct terminal state.
+            newer = [v for v in versions if v > rec.get("active_version", 0)]
+            if not newer:
+                problems.append(
+                    "injected swap crash not followed by a verified newer "
+                    f"version (active was v{rec.get('active_version')})")
+    return versions, len(rollbacks), problems
+
+
+def _run_session(sup, data0, stream, *, supervised, deadline_at,
+                 max_restarts=8):
+    """Drive one supervisor session: bootstrap, ingest the stream, close
+    with a fine-tune-then-rebuild. With `supervised`, injected crashes are
+    caught and the interrupted op replayed (rollbacks count as replays too —
+    the consumed fault spec lets the retried cycle land)."""
+    restarts = 0
+    sup.bootstrap(data0)
+    ops = [("ingest", batch) for batch in stream] + [("finetune", None)]
+    for kind, arg in ops:
+        while True:
+            if time.monotonic() > deadline_at:
+                return restarts, "deadline exceeded"
+            try:
+                if kind == "ingest":
+                    report = sup.ingest(arg)
+                    if report["action"] != "rollback":
+                        break
+                    if not supervised:
+                        return restarts, "rollback in reference run"
+                else:
+                    sup.finetune(reason="scheduled")
+                    break
+            except InjectedFault:
+                if not supervised:
+                    raise
+            restarts += 1
+            if restarts > max_restarts:
+                return restarts, f"gave up after {max_restarts} restarts"
+    return restarts, "completed"
+
+
+def run_churn_plan(plan, root, *, n_cycles=4, n_rows=48, n_features=24,
+                   deadline_s=240.0, max_restarts=8, block=16):
+    """Execute one churn fault plan end-to-end; returns a ChurnPlanResult."""
+    import jax
+
+    from ..refresh import ChurnConfig, ChurnSupervisor
+    from ..serve.corpus import ServingCorpus
+
+    t0 = time.monotonic()
+    deadline_at = t0 + deadline_s
+    seed = plan.seed
+    make = make_churn_estimator_factory(root, seed)
+    data0 = soak_data(n_rows, n_features, seed=1234 + seed)
+    stream = churn_stream(seed, n_cycles, n_features=n_features)
+    total_epochs = BASE_EPOCHS + FT_EPOCHS
+
+    base = make("base", BASE_EPOCHS)
+    base.fit(data0)
+    config = base.config
+    plan_dir = os.path.join(root, f"plan{seed}")
+    for tag in ("ref", "chaos"):
+        dst = os.path.join(plan_dir, tag)
+        shutil.rmtree(dst, ignore_errors=True)
+        shutil.copytree(os.path.join(plan_dir, "base"), dst)
+
+    # Drift ceilings are wide open: the stream is drawn from the training
+    # distribution, so the soak exercises the crash machinery; the drift
+    # TRIP path has its own deterministic test (tests/test_refresh.py).
+    def make_supervisor(tag):
+        corpus = ServingCorpus(config, block=block)
+        return ChurnSupervisor(
+            base.params, config, corpus,
+            churn=ChurnConfig(microbatch=16, drift_centroid_max=1.0,
+                              drift_collapse_max=1.0),
+            finetune_fn=_make_finetune_fn(make, tag, total_epochs))
+
+    ref = make_supervisor("ref")
+    _run_session(ref, data0, stream, supervised=False, deadline_at=deadline_at)
+    ref_versions, _, ref_problems = _audit_ledger(ref.corpus.ledger)
+    ref_digest = params_digest(ref.params)
+
+    injector = FaultInjector(plan)
+    sup = make_supervisor("chaos")
+    with _faults.install(injector):
+        restarts, detail = _run_session(
+            sup, data0, stream, supervised=True, deadline_at=deadline_at,
+            max_restarts=max_restarts)
+    duration = time.monotonic() - t0
+    versions, rollbacks, problems = _audit_ledger(sup.corpus.ledger)
+    problems += [f"ref: {p}" for p in ref_problems]
+
+    if detail != "completed":
+        return ChurnPlanResult(
+            plan.to_dict(), False, False, False, restarts, rollbacks,
+            list(injector.fired), list(sup.retry.events), versions,
+            ref_versions, len(sup.finetunes), detail, duration)
+
+    chaos_digest = params_digest(sup.params)
+    bitwise = chaos_digest == ref_digest
+    close = bitwise or _params_allclose(ref.params, sup.params)
+    want_bitwise = jax.default_backend() == "cpu"
+    ok = bitwise if want_bitwise else close
+    if not ok:
+        problems.append(f"params mismatch: ref {ref_digest[:12]} vs "
+                        f"chaos {chaos_digest[:12]} (allclose={close})")
+    if not injector.fired:
+        problems.append("plan fired no faults (nothing was tested)")
+    if versions != ref_versions:
+        problems.append(f"promote count diverged: chaos {versions} "
+                        f"vs ref {ref_versions}")
+    ok = not problems
+    return ChurnPlanResult(
+        plan.to_dict(), ok, bitwise, close, restarts, rollbacks,
+        list(injector.fired), list(sup.retry.events), versions, ref_versions,
+        len(sup.finetunes), "; ".join(problems) or "completed", duration)
+
+
+def chaos_churn_soak(root, seeds=range(6), n_cycles=4, deadline_s=240.0,
+                     n_rows=48, n_features=24, log=None):
+    """Replay churn fault plans for each seed (6 consecutive seeds cover
+    every family). Returns {"results", "all_ok", "n_ok", "n_plans"}."""
+    results = []
+    for seed in seeds:
+        plan = churn_fault_plan(seed, n_cycles=n_cycles)
+        res = run_churn_plan(plan, root, n_cycles=n_cycles, n_rows=n_rows,
+                             n_features=n_features, deadline_s=deadline_s)
+        results.append(res)
+        if log is not None:
+            log(f"churn plan {seed}: ok={res.ok} bitwise={res.bitwise} "
+                f"restarts={res.restarts} rollbacks={res.rollbacks} "
+                f"faults={len(res.injected)} versions={res.versions} "
+                f"({res.duration_s:.1f}s) {res.detail}")
+    n_ok = sum(r.ok for r in results)
+    return {"results": results, "all_ok": n_ok == len(results), "n_ok": n_ok,
+            "n_plans": len(results)}
+
+
+# ----------------------------------------------------- trained-corpus recall
+
+def topic_articles(n, seed, *, n_features=256, n_topics=16, support=48,
+                   tokens=20, background=4, topic_seed=99):
+    """Clustered sparse count articles: a FIXED topic model (topic_seed) with
+    per-seed article draws — structure the DAE can learn, so a trained
+    corpus has anisotropic embeddings (unlike soak_data, whose structureless
+    uniform draws train straight into the collapse gate)."""
+    sup_rng = np.random.default_rng(topic_seed)
+    supports = [sup_rng.choice(n_features, size=support, replace=False)
+                for _ in range(n_topics)]
+    rng = np.random.default_rng(seed)
+    rows = np.zeros((n, n_features), np.float32)
+    for i in range(n):
+        t = rng.integers(n_topics)
+        np.add.at(rows[i], rng.choice(supports[t], size=tokens), 1.0)
+        np.add.at(rows[i], rng.choice(n_features, size=background), 1.0)
+    import scipy.sparse as sparse
+    return sparse.csr_matrix(rows)
+
+
+def churned_recall_probe(root, *, n_features=256, n_components=32,
+                         n_corpus=1024, n_cycles=4, rows_per_cycle=64,
+                         num_epochs=4, k=10, n_queries=64):
+    """The quantized-recall measurement on a TRAINED, churned corpus — the
+    evidence figure that replaced the init-params order-statistics worst
+    case (see docs/serving.md). Trains a base model on clustered articles,
+    runs a fault-free churn session over fresh draws from the same topic
+    model, then measures bf16/int8 recall@10 against the fp32 ranking on the
+    resident rows — and repeats the measurement with init params at the SAME
+    shape so the record carries the worst case it supersedes.
+
+    Drift ceilings are opened to 1.0/0.5: a 64-row batch of clustered
+    articles covers topics unevenly, so its centroid swings ~0.4 against the
+    1k-row corpus centroid even with zero model drift — the production
+    defaults assume production-sized batches."""
+    import jax as _jax
+
+    from ..models.dae_core import init_params
+    from ..refresh import ChurnConfig, ChurnSupervisor
+    from ..serve import ServingCorpus, make_serve_fn
+
+    make = make_churn_estimator_factory(root, 0, n_components=n_components,
+                                        num_epochs=num_epochs)
+    X0 = topic_articles(n_corpus, 1234, n_features=n_features)
+    est = make("recall_base", num_epochs)
+    est.fit(X0)
+    config = est.config
+
+    corpus = ServingCorpus(config, block=64)
+    sup = ChurnSupervisor(
+        est.params, config, corpus,
+        churn=ChurnConfig(microbatch=64, drift_centroid_max=1.0,
+                          drift_collapse_max=0.5))
+    sup.bootstrap(X0)
+    for i in range(n_cycles):
+        rep = sup.ingest(topic_articles(rows_per_cycle, 5 + i,
+                                        n_features=n_features))
+        assert rep["action"] == "incremental", rep
+    from ..refresh.churn import _stack
+    resident = _stack(sup._store)
+
+    def recall_vs_fp32(params):
+        queries = np.asarray(
+            topic_articles(n_queries, 7, n_features=n_features).todense(),
+            np.float32)
+        rank = make_serve_fn(config, k)
+        c32 = ServingCorpus(config, block=64)
+        c32.swap(params, resident, note="fp32")
+        s = c32.active
+        base = np.asarray(_jax.device_get(
+            rank(params, s.emb, s.valid, s.scales, queries)[1]))
+        out = {}
+        for dtype in ("bfloat16", "int8"):
+            cq = ServingCorpus(config, block=64, corpus_dtype=dtype)
+            cq.swap(params, resident, note=dtype)
+            q = cq.active
+            idx = np.asarray(_jax.device_get(
+                rank(params, q.emb, q.valid, q.scales, queries)[1]))
+            out[dtype] = round(float(np.mean(
+                [len(set(a) & set(b)) / k for a, b in zip(base, idx)])), 6)
+        return out
+
+    trained = recall_vs_fp32(est.params)
+    worst_case = recall_vs_fp32(
+        init_params(_jax.random.PRNGKey(0), config))
+    return {"trained": trained, "init_params": worst_case,
+            "corpus_rows": int(resident.shape[0]),
+            "corpus_version": corpus.version,
+            "gate_collapse": round(float(corpus.active.stats["collapse"]), 6),
+            "shape": (f"{n_corpus}+{n_cycles}x{rows_per_cycle} churned rows, "
+                      f"{n_features}->{n_components}, k={k}, "
+                      f"{n_queries} queries")}

@@ -56,6 +56,10 @@ SITES = (
     "serve.enqueue", # serve/service.py submit, at request admission
     "serve.batch",   # serve/service.py dispatch, before the device call
     "serve.swap",    # serve/corpus.py swap, before the standby build
+    "refresh.ingest",   # refresh/churn.py, before vectorizing a micro-batch
+    "refresh.encode",   # refresh/churn.py, before each encode dispatch
+    "refresh.swap",     # serve/corpus.py swap_incremental, before the append
+    "refresh.finetune", # refresh/churn.py, before a warm-start fine-tune
 )
 
 # Post-crash directives consumed by the chaos harness, not fired in-line.

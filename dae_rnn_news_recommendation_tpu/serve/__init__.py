@@ -17,8 +17,8 @@ back rather than serving a bad build. Full story in docs/serving.md.
 
 from .chaos_serve import (ServePlanResult, chaos_serve_soak, overload_trace,
                           run_serve_plan, serve_fault_plan)
-from .corpus import (CORPUS_DTYPES, CorpusSlot, ServingCorpus, SwapRejected,
-                     dequantize_rows, quantize_corpus)
+from .corpus import (CORPUS_DTYPES, CorpusSlot, ServingCorpus, SwapInProgress,
+                     SwapRejected, dequantize_rows, quantize_corpus)
 from .graph import (block_indices, make_corpus_encode_fn, make_serve_fn,
                     make_sharded_serve_fn)
 from .service import RecommendationService, Reply, ReplyFuture
@@ -31,6 +31,7 @@ __all__ = [
     "ReplyFuture",
     "ServePlanResult",
     "ServingCorpus",
+    "SwapInProgress",
     "SwapRejected",
     "block_indices",
     "chaos_serve_soak",

@@ -23,6 +23,18 @@ promote a bad build — so the swap protocol here is:
 `reliability/faults.py` fires `serve.swap` at the top of every build, so the
 chaos-serve soak can prove the rollback path: an injected swap fault must
 leave the OLD corpus serving, version unchanged.
+
+Corpus churn (refresh/) adds the INCREMENTAL variant of the same protocol:
+`swap_incremental` appends freshly-encoded articles to the active slot with
+age-based eviction instead of rebuilding the world, runs the identical health
+gate over the appended tail, and promotes through the same single-assignment
+path — `refresh.swap` is its fault site. Every promote AND every rollback
+(full or incremental) appends one record to `corpus.ledger`, the append-only
+version ledger the chaos_churn soak audits: versions must be strictly
+monotonic, and every promoted record must carry a passing gate. Swaps are
+serialized by a non-blocking guard: a second swap attempted while one is in
+flight raises `SwapInProgress` deterministically rather than interleaving
+slot state (the caller — the churn supervisor — owns retry policy).
 """
 
 import threading
@@ -82,13 +94,19 @@ class CorpusSlot:
     """One immutable buffer: unit-norm embeddings [N_pad, D] on device (at
     the corpus dtype, int8 alongside its per-row scales), a valid-row mask,
     and provenance. Never mutated after build — the service snapshots a
-    reference and scores against it lock-free."""
+    reference and scores against it lock-free.
+
+    `ages` is a host-side int32 [N_pad]: the corpus version at which each row
+    was ingested (-1 for padding), driving age-based eviction on incremental
+    swaps. `stats` carries the gate sample's collapse score and centroid —
+    the reference the drift gate (telemetry/health.drift_health) compares the
+    NEXT refresh batch against."""
 
     __slots__ = ("emb", "valid", "scales", "dtype", "n", "version", "note",
-                 "built_s")
+                 "built_s", "ages", "stats")
 
     def __init__(self, emb, valid, n, version, note, built_s,
-                 scales=None, dtype="float32"):
+                 scales=None, dtype="float32", ages=None, stats=None):
         self.emb = emb
         self.valid = valid
         self.scales = scales
@@ -97,6 +115,8 @@ class CorpusSlot:
         self.version = int(version)
         self.note = note
         self.built_s = built_s
+        self.ages = ages
+        self.stats = stats or {}
 
     def resident_bytes(self):
         """Device bytes held by the scoring matrix (embeddings + scales; the
@@ -107,6 +127,12 @@ class CorpusSlot:
 
 class SwapRejected(RuntimeError):
     """The standby build failed its health gate; the active slot still serves."""
+
+
+class SwapInProgress(RuntimeError):
+    """A swap was attempted while another is in flight. Swaps serialize: the
+    second caller gets this exception immediately (never a blocked thread,
+    never interleaved slot state) and owns the retry decision."""
 
 
 class ServingCorpus:
@@ -127,10 +153,14 @@ class ServingCorpus:
         self._device_put = device_put
         self._encode_corpus = make_corpus_encode_fn(config)
         self._lock = threading.Lock()
+        self._swap_busy = threading.Lock()  # serializes swap/swap_incremental
         self._active = None
         self._version = 0
         self._refreshing = threading.Event()
         self.events = []  # swap / swap_rollback records, in order
+        self.ledger = []  # append-only version ledger: one record per
+        # promote AND per rollback attempt; the chaos_churn soak audits it
+        # for version monotonicity + gate coverage
 
     # ------------------------------------------------------------ read side
     @property
@@ -158,7 +188,26 @@ class ServingCorpus:
         On ANY failure (injected serve.swap fault, build error, gate refusal)
         the active slot keeps serving: the failure is recorded as a
         `swap_rollback` event and re-raised only when there is no active slot
-        to fall back to (a failed FIRST build has nothing to serve)."""
+        to fall back to (a failed FIRST build has nothing to serve).
+
+        Raises `SwapInProgress` (without touching any state) if another swap
+        is already in flight on another thread."""
+        self._acquire_swap(note)
+        try:
+            return self._swap_full(params, articles, note)
+        finally:
+            self._swap_busy.release()
+
+    def _acquire_swap(self, note):
+        if not self._swap_busy.acquire(blocking=False):
+            with self._lock:
+                self.events.append({"event": "swap_rejected_busy",
+                                    "note": note,
+                                    "active_version": self._version})
+            raise SwapInProgress(
+                f"a swap is already in flight (rejected: {note!r})")
+
+    def _swap_full(self, params, articles, note):
         t0 = time.monotonic()
         self._refreshing.set()
         try:
@@ -170,27 +219,158 @@ class ServingCorpus:
                 raise SwapRejected(
                     f"standby corpus failed the health gate: {gate}")
         except Exception as exc:
-            with self._lock:
-                fallback = self._active
-                event = {"event": "swap_rollback", "note": note,
-                         "error": f"{type(exc).__name__}: {exc}",
-                         "active_version": self._version,
-                         "duration_s": round(time.monotonic() - t0, 4)}
-                self.events.append(event)
-            if fallback is None:
-                raise  # nothing to roll back TO: the caller must know
-            return fallback
+            return self._rollback("full", note, exc, t0)
         finally:
             self._refreshing.clear()
+        return self._promote(standby, gate, "full", note, t0,
+                             n_added=standby.n, n_evicted=0)
+
+    def _promote(self, standby, gate, kind, note, t0, *, n_added, n_evicted):
+        """The single atomic assignment both swap flavors funnel through:
+        version bump + slot reference + event + ledger record, one lock."""
         with self._lock:
             self._version += 1
             standby.version = self._version
+            if standby.ages is None:  # full rebuild: every row is this vintage
+                ages = np.full(standby.valid.shape[0], -1, np.int32)
+                ages[:standby.n] = self._version
+                standby.ages = ages
+            else:  # incremental: appended rows were staged with age -1
+                standby.ages = np.where(standby.ages == -2, self._version,
+                                        standby.ages).astype(np.int32)
             self._active = standby
             self.events.append({
-                "event": "swap", "note": note, "version": self._version,
-                "n_articles": standby.n, "collapse": gate["collapse"],
+                "event": "swap", "kind": kind, "note": note,
+                "version": self._version, "n_articles": standby.n,
+                "collapse": gate["collapse"],
+                "duration_s": round(time.monotonic() - t0, 4)})
+            self.ledger.append({
+                "version": self._version, "kind": kind, "ok": True,
+                "gate": gate, "n": standby.n, "n_added": int(n_added),
+                "n_evicted": int(n_evicted), "note": note,
                 "duration_s": round(time.monotonic() - t0, 4)})
         return standby
+
+    def _rollback(self, kind, note, exc, t0):
+        with self._lock:
+            fallback = self._active
+            detail = {"kind": kind, "note": note,
+                      "error": f"{type(exc).__name__}: {exc}",
+                      "active_version": self._version,
+                      "duration_s": round(time.monotonic() - t0, 4)}
+            self.events.append({"event": "swap_rollback", **detail})
+            self.ledger.append({"version": self._version, "ok": False,
+                                **detail})
+        if fallback is None:
+            raise exc  # nothing to roll back TO: the caller must know
+        return fallback
+
+    def swap_incremental(self, params, new_articles, *, max_rows=None,
+                         max_age_versions=None, note="", emb=None):
+        """Append `new_articles` (dense [n, F] or scipy CSR) to the ACTIVE
+        slot with age-based eviction, health-gate the appended tail, and
+        promote — the refresh-path swap. Returns the promoted CorpusSlot.
+
+        Eviction, applied before the append: rows older than
+        `max_age_versions` corpus versions are dropped (news articles expire),
+        then oldest-first until the combined corpus fits `max_rows`. The
+        standby is assembled from the active slot's DEQUANTIZED rows plus the
+        freshly-encoded batch, re-quantized at the corpus dtype — so the gate
+        judges exactly what scoring will see, same as a full rebuild.
+
+        `emb` short-circuits the encode with precomputed unit-norm [n, D]
+        f32 embeddings of `new_articles` — the churn supervisor already
+        encoded the batch for its drift check and must not pay (or fault)
+        the encode twice.
+
+        `refresh.swap` is the fault site (the full rebuild keeps
+        `serve.swap`); rollback semantics are identical to `swap`."""
+        self._acquire_swap(note)
+        try:
+            t0 = time.monotonic()
+            self._refreshing.set()
+            try:
+                with self._lock:
+                    base = self._active
+                    version = self._version
+                if base is None:
+                    raise SwapRejected(
+                        "swap_incremental needs an active slot to append to "
+                        "(seed the corpus with a full swap first)")
+                with telemetry.span("serve/corpus_swap_incremental",
+                                    fence=False, args={"note": note}):
+                    standby, n_added, n_evicted = self._build_incremental(
+                        params, new_articles, base, version, note,
+                        max_rows=max_rows, max_age_versions=max_age_versions,
+                        emb=emb)
+                gate = self._health_gate(standby, tail=True)
+                if not gate["ok"]:
+                    raise SwapRejected(
+                        f"incremental standby failed the health gate: {gate}")
+            except Exception as exc:
+                return self._rollback("incremental", note, exc, t0)
+            finally:
+                self._refreshing.clear()
+            return self._promote(standby, gate, "incremental", note, t0,
+                                 n_added=n_added, n_evicted=n_evicted)
+        finally:
+            self._swap_busy.release()
+
+    def _build_incremental(self, params, new_articles, base, version, note,
+                           *, max_rows, max_age_versions, emb=None):
+        _faults.fire("refresh.swap", note=note)
+        n_new = int(new_articles.shape[0])
+        if emb is not None:
+            new_emb = np.asarray(jax.device_get(emb), np.float32)[:n_new]
+            assert new_emb.shape[0] == n_new, (new_emb.shape, n_new)
+        else:
+            resident = build_resident(new_articles,
+                                      device_put=self._device_put)
+            blocks = block_indices(n_new, self.block)
+            new_emb = np.asarray(jax.device_get(
+                self._encode_corpus(params, resident, blocks)))[:n_new]
+
+        old = np.asarray(jax.device_get(
+            dequantize_rows(base.emb, base.scales, base.n)))
+        ages = (base.ages[:base.n] if base.ages is not None
+                else np.full(base.n, max(version, 1), np.int32))
+        next_version = version + 1  # promotion will assert this exact bump
+        keep = np.ones(base.n, bool)
+        if max_age_versions is not None:
+            keep &= (next_version - ages) <= int(max_age_versions)
+        if max_rows is not None:
+            budget = int(max_rows) - n_new
+            if budget < 0:
+                raise SwapRejected(
+                    f"refresh batch ({n_new}) exceeds max_rows ({max_rows})")
+            kept_idx = np.flatnonzero(keep)
+            if kept_idx.size > budget:  # oldest first, then lowest row index
+                order = np.lexsort((kept_idx, ages[kept_idx]))
+                keep[kept_idx[order[:kept_idx.size - budget]]] = False
+        n_evicted = int(base.n - keep.sum())
+
+        combined = np.concatenate([old[keep], new_emb], axis=0)
+        n = combined.shape[0]
+        n_pad = block_indices(n, self.block).size
+        emb_pad = np.zeros((n_pad, combined.shape[1]), np.float32)
+        emb_pad[:n] = combined
+        # staged age -2 marks the appended rows; _promote stamps them with
+        # the version it actually assigns under the lock
+        slot_ages = np.full(n_pad, -1, np.int32)
+        slot_ages[: base.n - n_evicted] = ages[keep]
+        slot_ages[base.n - n_evicted : n] = -2
+        valid = np.zeros(n_pad, np.float32)
+        valid[:n] = 1.0
+
+        q_emb, scales = quantize_corpus(jnp.asarray(emb_pad),
+                                        self.corpus_dtype)
+        put = self._device_put or jax.device_put
+        q_emb = put(q_emb)
+        scales = put(scales) if scales is not None else None
+        return CorpusSlot(
+            emb=q_emb, valid=put(valid), n=n, version=-1, note=note,
+            built_s=time.monotonic(), scales=scales, dtype=self.corpus_dtype,
+            ages=slot_ages), n_new, n_evicted
 
     def _build(self, params, articles, note):
         _faults.fire("serve.swap", note=note)
@@ -213,17 +393,33 @@ class ServingCorpus:
                           note=note, built_s=time.monotonic(),
                           scales=scales, dtype=self.corpus_dtype)
 
-    def _health_gate(self, slot):
+    def _health_gate(self, slot, tail=False):
         """Finiteness + collapse score on a sample of the standby embeddings
         (DEQUANTIZED — the gate judges what scoring will actually see, so a
         broken quantization fails here, not in production ranking).
-        One deliberate host sync — the swap path is off the request path."""
-        sample = dequantize_rows(slot.emb, slot.scales,
-                                 min(_GATE_SAMPLE, slot.n))
-        finite = bool(jax.device_get(jnp.all(jnp.isfinite(sample))))
+        One deliberate host sync — the swap path is off the request path.
+
+        `tail=True` (incremental swaps) samples the NEWEST rows: the old rows
+        already passed a gate when their version promoted; the appended tail
+        is what could be poisoned. The sample's collapse score and centroid
+        are stored on `slot.stats` as the drift reference the next refresh
+        batch is compared against (telemetry/health.drift_health)."""
+        rows = min(_GATE_SAMPLE, slot.n)
+        if tail:
+            sample = dequantize_rows(
+                slot.emb, slot.scales, slot.n)[slot.n - rows:]
+        else:
+            sample = dequantize_rows(slot.emb, slot.scales, rows)
+        host = np.asarray(jax.device_get(sample), np.float32)
+        finite = bool(np.all(np.isfinite(host)))
         stats = jax.device_get(embedding_health(sample))
         collapse = float(stats["health/embedding_collapse"])
         ok = finite and np.isfinite(collapse) and (
             collapse <= self.collapse_ceiling)
+        norms = np.maximum(np.linalg.norm(host, axis=1, keepdims=True), 1e-12)
+        slot.stats = {"collapse": collapse,
+                      "centroid": np.mean(host / norms, axis=0),
+                      "gate_rows": rows, "gate_tail": bool(tail)}
         return {"ok": ok, "finite": finite, "collapse": round(collapse, 6),
-                "ceiling": self.collapse_ceiling}
+                "ceiling": self.collapse_ceiling, "rows": rows,
+                "tail": bool(tail)}

@@ -131,12 +131,20 @@ class RecommendationService:
         mode.
     :param retry: RetryPolicy for transient device faults on the batch path
         (default: 3 attempts, full jitter, 0.25 s cumulative cap).
+    :param sharded: score against a ROW-SHARDED corpus: the serve graphs are
+        built with `make_sharded_serve_fn` over `mesh`, so corpus capacity
+        scales with device count. The corpus must be placed with
+        `ServingCorpus(device_put=lambda x: parallel.mesh.shard_rows(x, mesh))`
+        — same mesh, N_pad divisible by it, shard rows >= top_k.
+    :param mesh: the 1-D mesh for `sharded=True` (default: all devices via
+        `parallel.mesh.get_mesh()`).
     """
 
     def __init__(self, params, config, corpus, *, top_k=10,
                  degraded_top_k=None, max_batch=32, max_inflight=64,
                  flush_slack_s=0.02, linger_s=0.005, default_deadline_s=1.0,
-                 overload_watermark=0.75, retry=None, fused=True):
+                 overload_watermark=0.75, retry=None, fused=True,
+                 sharded=False, mesh=None):
         assert int(top_k) >= 1 and int(max_batch) >= 1
         self.params = params
         self.config = config
@@ -156,8 +164,18 @@ class RecommendationService:
         self.buckets = bucket_sizes(self.max_batch, n_buckets=3,
                                     floor=min(8, self.max_batch))
         self.fused = bool(fused)
-        self._serve_fns = {k: make_serve_fn(config, k, fused=self.fused)
-                           for k in {self.top_k, self.degraded_top_k}}
+        self.sharded = bool(sharded)
+        if self.sharded:
+            from ..parallel.mesh import get_mesh
+            from .graph import make_sharded_serve_fn
+            self.mesh = mesh if mesh is not None else get_mesh()
+            self._serve_fns = {
+                k: make_sharded_serve_fn(config, k, self.mesh)
+                for k in {self.top_k, self.degraded_top_k}}
+        else:
+            self.mesh = None
+            self._serve_fns = {k: make_serve_fn(config, k, fused=self.fused)
+                               for k in {self.top_k, self.degraded_top_k}}
         self._warmup_compiles = None   # set by warmup()
         self._post_warm_watcher = None  # counts compiles after warmup() —
         # the serving SLO assumes zero (every (bucket, k) variant is warm)
@@ -424,9 +442,11 @@ class RecommendationService:
         return {"counts": counts, "latency": self.latency_stats(),
                 "degraded_events": events,
                 "corpus_events": list(self.corpus.events),
+                "corpus_ledger": list(self.corpus.ledger),
                 "retries": list(self.retry.events),
                 "buckets": list(self.buckets), "top_k": self.top_k,
                 "degraded_top_k": self.degraded_top_k,
+                "sharded": self.sharded,
                 "floor_ms": round(self._floor_s * 1e3, 3),
                 "compiles": {
                     "warmup": self._warmup_compiles,

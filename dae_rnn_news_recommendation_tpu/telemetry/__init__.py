@@ -19,7 +19,8 @@ invariant, built in. `telemetry.span(..., fence=False)` marks host-only
 regions; jaxcheck R6 flags device work inside them.
 """
 
-from .health import embedding_health, mining_health, sentinel_metrics
+from .health import (drift_health, embedding_health, mining_health,
+                     sentinel_metrics)
 from .manifest import build_manifest, read_manifest, write_manifest
 from .recorder import FlightRecorder, summarize_batch
 from .tracer import (Tracer, counters, current_tracer, device_fence, disable,
@@ -35,6 +36,7 @@ __all__ = [
     "current_tracer",
     "device_fence",
     "disable",
+    "drift_health",
     "embedding_health",
     "enable",
     "enabled",

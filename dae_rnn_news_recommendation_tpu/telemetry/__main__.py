@@ -1,7 +1,7 @@
 """CLI: `python -m dae_rnn_news_recommendation_tpu.telemetry report ...`
 
     report <trace.json> [--metrics PATH] [--bench PATH] [--health PATH]
-                        [--json]
+                        [--churn PATH] [--json]
 
 Prints the per-span p50/p95/total table (with feed-stall and compile-count
 columns) from a trace exported by a traced fit; optionally joins metrics.jsonl
@@ -36,6 +36,9 @@ def main(argv=None):
     rep.add_argument("--health", default=None,
                      help="flight-recorder health_bundle.json (default: "
                           "auto-detect next to the trace)")
+    rep.add_argument("--churn", default=None,
+                     help="churn_history.json dumped by a ChurnSupervisor "
+                          "(default: auto-detect next to the trace)")
     rep.add_argument("--json", action="store_true",
                      help="emit the report as JSON instead of a table")
     args = parser.parse_args(argv)
@@ -43,7 +46,7 @@ def main(argv=None):
     try:
         text, code = report(args.trace, metrics_path=args.metrics,
                             bench_path=args.bench, health_path=args.health,
-                            as_json=args.json)
+                            churn_path=args.churn, as_json=args.json)
     except (OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2

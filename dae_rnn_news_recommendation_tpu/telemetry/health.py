@@ -107,6 +107,48 @@ def embedding_health(h, row_valid=None, prefix="health/embedding"):
     }
 
 
+def drift_health(h, ref_centroid, ref_collapse, row_valid=None,
+                 prefix="health/drift"):
+    """Embedding drift of a batch `h` [B, D] against a reference corpus
+    version, in-graph (pure jnp — the churn supervisor jits this alongside
+    the encode so a refresh cycle pays zero extra host syncs until the one
+    swap-time fetch).
+
+    Two signals, matching the two ways a refresh can go stale:
+
+      * `centroid_shift` — cosine distance (1 - cos) between the batch's mean
+        unit embedding and the reference version's centroid. Catches topic
+        drift / distribution shift: new articles living in a different part
+        of the embedding space than what the encoder was fine-tuned on.
+      * `collapse_delta` — |collapse(batch) - collapse(reference)|, reusing
+        `embedding_health`'s closed-form pairwise-cosine score. Catches the
+        encoder degrading ON the new data (collapsing or dispersing) even
+        when the centroid barely moves.
+
+    `ref_centroid` is the (possibly unnormalized) mean unit-embedding vector
+    recorded when the reference version passed its health gate;
+    `ref_collapse` the collapse score from the same gate sample."""
+    dtype = jnp.float32
+    v = (jnp.ones(h.shape[0], dtype) if row_valid is None
+         else row_valid.astype(dtype))
+    n = jnp.maximum(jnp.sum(v), 1.0)
+    norms = jnp.sqrt(jnp.sum(jnp.square(h.astype(dtype)), axis=1))
+    u = h.astype(dtype) / jnp.maximum(norms, _EPS)[:, None] * v[:, None]
+    c = jnp.sum(u, axis=0) / n
+    ref = jnp.asarray(ref_centroid, dtype)
+    cos = jnp.sum(c * ref) / jnp.maximum(
+        jnp.linalg.norm(c) * jnp.linalg.norm(ref), _EPS)
+    pair_sum = jnp.sum(jnp.square(jnp.sum(u, axis=0))) - n
+    collapse = pair_sum / jnp.maximum(n * (n - 1.0), 1.0)
+    return {
+        f"{prefix}_centroid_shift": 1.0 - cos,
+        f"{prefix}_collapse_delta":
+            jnp.abs(collapse - jnp.asarray(ref_collapse, dtype)),
+        f"{prefix}_collapse": collapse,
+        f"{prefix}_centroid": c,
+    }
+
+
 def mining_health(data_weight, fraction, row_valid=None):
     """Distribution stats of the paper's triplet-participation `data_weight`
     [B] plus the margin-violation rate.

@@ -14,7 +14,10 @@ the last `capacity` rows in a deque and watches for three anomaly classes:
 On the first anomaly the estimator dumps a diagnostics bundle
 (`health_bundle.json` in the run dir): the ring contents, the trace tail
 (when tracing is on), the run manifest, a batch signature, the first bad and
-last good step ids. `python -m ...telemetry report --health` renders it.
+last good step ids. Further dumps in the same run (a later divergence, an
+exception after a degrade) get `health_bundle_<n>.json` suffixes instead of
+clobbering the first bundle — the FIRST anomaly is usually the root cause.
+`python -m ...telemetry report --health` renders them.
 
 Detection granularity follows the metric fetch: all three feed paths fetch
 step metrics once per epoch (the async-dispatch design), so anomalies are
@@ -177,11 +180,26 @@ class FlightRecorder:
             "reason": self.first_bad_reason,
         }
 
+    def _next_path(self, path):
+        """First dump of this recorder takes `path` verbatim (a fresh run may
+        legitimately overwrite a stale bundle from a previous run); later
+        dumps — repeated anomalies in ONE run — must not clobber the first
+        bundle, so they take the next free `<stem>_<n><ext>` suffix."""
+        if self.bundle_path is None:
+            return path
+        stem, ext = os.path.splitext(path)
+        n = 2
+        while os.path.exists(f"{stem}_{n}{ext}"):
+            n += 1
+        return f"{stem}_{n}{ext}"
+
     def dump(self, path, reason=None, manifest_path=None, trace_tail=None,
              extra=None):
-        """Write the diagnostics bundle (atomic replace); returns `path`, or
+        """Write the diagnostics bundle (atomic replace); returns the path
+        written (suffixed `_<n>` after the first dump — see `_next_path`), or
         None when writing failed — the recorder must never take down the fit
         it is documenting."""
+        path = self._next_path(path)
         bundle = {
             "schema": self.BUNDLE_SCHEMA,
             "reason": reason or self.first_bad_reason or "manual dump",

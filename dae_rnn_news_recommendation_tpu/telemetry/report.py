@@ -20,7 +20,10 @@ answer to the README Performance stream-vs-probe discrepancy. `--health`
 renders a flight-recorder bundle (telemetry/recorder.py) — status, first bad
 step, the anomaly reason, and the last recorded ring rows; when the flag is
 omitted a `health_bundle.json` sitting next to the trace is picked up
-automatically.
+automatically. `--churn` renders a refresh-loop history (refresh/churn.py
+`ChurnSupervisor.dump_history`) — per-action cycle counts, drift extremes vs
+trips, promoted-version span, and the swap/encode latency rollup — with the
+same next-to-the-trace auto-detection (`churn_history.json`).
 
 Optional sections degrade gracefully: an unreadable metrics/bench/health
 input becomes a warning note in the report instead of an error, and a trace
@@ -111,6 +114,19 @@ def load_health(path):
     if not isinstance(bundle, dict):
         raise ValueError(f"{path}: not a health bundle object")
     return bundle
+
+
+def load_churn(path):
+    """A churn history dump (refresh/churn.py ChurnSupervisor.dump_history):
+    either the {"history": [...], "summary": {...}} object or a bare list of
+    cycle reports."""
+    with open(path, encoding="utf-8") as f:
+        obj = json.load(f)
+    if isinstance(obj, list):
+        obj = {"history": obj}
+    if not isinstance(obj, dict) or not isinstance(obj.get("history"), list):
+        raise ValueError(f"{path}: not a churn history dump")
+    return obj
 
 
 # -------------------------------------------------------------- aggregation
@@ -245,6 +261,53 @@ def health_summary(bundle):
     return out
 
 
+def churn_summary(dump):
+    """Aggregate a churn history (refresh/churn.py cycle reports) into the
+    drift/refresh story: per-action counts, promoted-version span, drift
+    extremes vs the trip count, and the encode/swap latency rollup the bench
+    records as `churn_encode_articles_per_sec` / `refresh_swap_p95_ms`."""
+    history = (dump or {}).get("history") or []
+    if not history:
+        return None
+    actions = {}
+    for rep in history:
+        a = rep.get("action", "?")
+        actions[a] = actions.get(a, 0) + 1
+    versions = [rep["version"] for rep in history if "version" in rep]
+    shifts = [rep["drift"]["centroid_shift"] for rep in history
+              if isinstance(rep.get("drift"), dict)]
+    deltas = [rep["drift"]["collapse_delta"] for rep in history
+              if isinstance(rep.get("drift"), dict)]
+    trips = sum(1 for rep in history
+                if isinstance(rep.get("drift"), dict)
+                and rep["drift"].get("tripped"))
+    swaps_ms = sorted(rep["swap_s"] * 1e3 for rep in history
+                      if "swap_s" in rep)
+    encode_s = sum(rep.get("encode_s", 0.0) for rep in history)
+    n_new = sum(rep.get("n_new", 0) for rep in history)
+    out = {"n_cycles": len(history), "actions": actions,
+           "drift_trips": trips}
+    if versions:
+        out["version_span"] = [min(versions), max(versions)]
+    if shifts:
+        out["drift_centroid_shift_max"] = round(max(shifts), 6)
+        out["drift_collapse_delta_max"] = round(max(deltas), 6)
+    if swaps_ms:
+        out["swap_p50_ms"] = round(_percentile(swaps_ms, 50), 2)
+        out["swap_p95_ms"] = round(_percentile(swaps_ms, 95), 2)
+    if encode_s > 0 and n_new:
+        out["encode_articles_per_sec"] = round(n_new / encode_s, 1)
+    oov = [rep["oov_fraction"] for rep in history if "oov_fraction" in rep]
+    if oov:
+        out["oov_fraction_last"] = oov[-1]
+    if isinstance((dump or {}).get("summary"), dict):
+        s = dump["summary"]
+        for k in ("resident_rows", "corpus_version", "finetunes", "retries"):
+            if k in s:
+                out[k] = s[k]
+    return out
+
+
 def faults_summary(manifest):
     """The manifest's `faults` section (models/estimator.py
     `_write_fault_manifest`): injected chaos faults, recorded I/O retries,
@@ -284,7 +347,7 @@ def _fmt_row(values, widths):
 
 
 def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
-                health=None, faults=None, notes=None):
+                health=None, faults=None, churn=None, notes=None):
     lines = []
     if manifest:
         lines.append("run: git %s  backend=%s  feed=%s  created %s" % (
@@ -373,11 +436,41 @@ def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
                          f"after {ev.get('error')}")
         if faults.get("cadence_fallback"):
             lines.append(f"  cadence fallback: {faults['cadence_fallback']}")
+    if churn:
+        lines.append("")
+        head = (f"corpus churn: {churn['n_cycles']} cycles, "
+                f"{churn['drift_trips']} drift trips")
+        if "version_span" in churn:
+            lo, hi = churn["version_span"]
+            head += f", versions v{lo}..v{hi}"
+        lines.append(head)
+        acts = ", ".join(f"{k} x{v}"
+                         for k, v in sorted(churn["actions"].items()))
+        lines.append(f"  actions: {acts}")
+        if "drift_centroid_shift_max" in churn:
+            lines.append(
+                f"  drift max: centroid shift "
+                f"{churn['drift_centroid_shift_max']}  collapse delta "
+                f"{churn['drift_collapse_delta_max']}")
+        if "swap_p95_ms" in churn:
+            lines.append(f"  swap latency: p50 {churn['swap_p50_ms']} ms  "
+                         f"p95 {churn['swap_p95_ms']} ms")
+        if "encode_articles_per_sec" in churn:
+            lines.append("  encode throughput: "
+                         f"{churn['encode_articles_per_sec']} articles/s")
+        if "oov_fraction_last" in churn:
+            lines.append("  vectorizer OOV fraction: "
+                         f"{churn['oov_fraction_last']}")
+        tail = [f"{k}={churn[k]}" for k in
+                ("resident_rows", "corpus_version", "finetunes", "retries")
+                if k in churn]
+        if tail:
+            lines.append("  supervisor: " + "  ".join(tail))
     return "\n".join(lines)
 
 
 def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
-           as_json=False):
+           churn_path=None, as_json=False):
     """Build the report. Returns (text, exit_code).
 
     The trace is the report's backbone — an unreadable trace still raises
@@ -422,15 +515,23 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
         health_path = cand if os.path.exists(cand) else None
     health = health_summary(optional(health_path, load_health,
                                      "health bundle"))
+    if churn_path is None:
+        # a churn supervisor drops churn_history.json next to the trace —
+        # same auto-detection contract as the health bundle
+        cand = os.path.join(os.path.dirname(os.path.abspath(trace_path)),
+                            "churn_history.json")
+        churn_path = cand if os.path.exists(cand) else None
+    churn = churn_summary(optional(churn_path, load_churn, "churn history"))
     faults = faults_summary(manifest)
     if as_json:
         return json.dumps({"spans": rows, "counters": counters,
                            "manifest": manifest, "metrics": metrics,
                            "bench": bench, "health": health,
-                           "faults": faults, "notes": notes or None},
+                           "faults": faults, "churn": churn,
+                           "notes": notes or None},
                           indent=2, default=str), 0
-    if not rows and not (metrics or bench or health):
+    if not rows and not (metrics or bench or health or churn):
         return "no span events in trace", 1
     return render_text(rows, counters=counters, manifest=manifest,
                        metrics=metrics, bench=bench, health=health,
-                       faults=faults, notes=notes), 0
+                       faults=faults, churn=churn, notes=notes), 0

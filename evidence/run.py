@@ -467,6 +467,48 @@ def main(argv=None):
 
         chaos_serve_out = staged("chaos-serve soak (6 seeded fault plans x "
                                  "overload traces)", _chaos_serve)
+
+        def _chaos_churn():
+            # ISSUE 10 acceptance: 6 seeded fault plans against the
+            # continuous-refresh loop (reliability/chaos_churn.py), one per
+            # refresh.* family plus a train.step preemption INSIDE the
+            # fine-tune. Each plan asserts in-harness: the served corpus
+            # version sequence is monotonic and matches the fault-free
+            # reference session, every promoted slot passed the health gate,
+            # every rollback left a verified version serving, and the final
+            # params are bitwise-identical on CPU (crash-exact fine-tune
+            # resume). The recall probe then measures bf16/int8 recall@10 on
+            # a TRAINED churned corpus — the figure the serve_int8 floor is
+            # calibrated against (docs/serving.md).
+            from dae_rnn_news_recommendation_tpu.reliability.chaos_churn \
+                import chaos_churn_soak, churned_recall_probe
+
+            out = chaos_churn_soak(os.path.join(scratch, "chaos_churn"),
+                                   seeds=range(6), log=print)
+            recall = churned_recall_probe(
+                os.path.join(scratch, "churn_recall"))
+            return {"n_ok": out["n_ok"], "n_plans": out["n_plans"],
+                    "all_ok": out["all_ok"],
+                    "plans": [{"seed": r.plan["seed"], "ok": r.ok,
+                               "bitwise": r.bitwise, "allclose": r.allclose,
+                               "restarts": r.restarts,
+                               "rollbacks": r.rollbacks,
+                               "n_injected": len(r.injected),
+                               "n_retries": len(r.retries),
+                               "versions": r.versions,
+                               "versions_monotonic": (
+                                   r.versions == list(
+                                       range(1, len(r.versions) + 1))
+                                   and r.versions == r.ref_versions),
+                               "n_finetunes": r.n_finetunes,
+                               "detail": r.detail,
+                               "duration_s": round(r.duration_s, 2)}
+                              for r in out["results"]],
+                    "recall": recall}
+
+        chaos_churn_out = staged("chaos-churn soak (6 seeded refresh fault "
+                                 "plans + trained-corpus recall probe)",
+                                 _chaos_churn)
     finally:
         os.chdir(cwd)
 
@@ -734,6 +776,36 @@ def main(argv=None):
           if sv_swap else
           "no plan exercised serve.swap — the 6-family round-robin should "
           "always include seed 4's swap-fatal plan")
+    cc_plans = chaos_churn_out["plans"]
+    n_cc_mono = sum(1 for pl in cc_plans if pl["versions_monotonic"])
+    n_cc_bitwise = sum(1 for pl in cc_plans if pl["bitwise"])
+    check("chaos_churn_version_monotonic",
+          chaos_churn_out["all_ok"] and n_cc_mono == chaos_churn_out["n_plans"],
+          f"{chaos_churn_out['n_ok']}/{chaos_churn_out['n_plans']} refresh "
+          f"fault plans passed; {n_cc_mono}/{chaos_churn_out['n_plans']} "
+          "promoted strictly monotonic version sequences matching the "
+          "fault-free reference session (every promoted slot health-gated, "
+          "every rollback left a verified version serving); "
+          f"{n_cc_bitwise} plans resumed the fine-tune bitwise-identical"
+          + (" (the CPU bar)" if platform == "cpu" else
+             "; allclose is the bar off-CPU"))
+    cc_recall = chaos_churn_out["recall"]
+    tr_int8 = cc_recall["trained"]["int8"]
+    # Floor raised from 0.98 to 0.99 (r10): 0.98 was calibrated on
+    # init-params embeddings, an order-statistics worst case where the
+    # rank-10/11 cosine gap sits inside the int8 noise bound. On a TRAINED
+    # churned corpus the gaps are set by topic structure instead: measured
+    # int8 0.9969 / bf16 0.9984 at the probe shape (1024+4x64 rows,
+    # 256->32), vs 0.9953 for init params at the SAME shape — docs/serving.md
+    # has the full rationale.
+    check("churn_trained_int8_recall",
+          tr_int8 is not None and float(tr_int8) >= 0.99,
+          f"trained churned corpus (v{cc_recall['corpus_version']}, "
+          f"{cc_recall['corpus_rows']} rows) int8 recall@10 {tr_int8} "
+          ">= 0.99 vs fp32 ranking "
+          f"(bf16 {cc_recall['trained']['bfloat16']}; init-params worst "
+          f"case at the same shape: {cc_recall['init_params']}; "
+          f"shape {cc_recall['shape']})")
     if platform == "tpu":
         serve_qps = bench_extra.get("serve_queries_per_sec")
         serve_p95 = bench_extra.get("serve_latency_p95_ms")
@@ -765,13 +837,14 @@ def main(argv=None):
         int8_ratio = bench_extra.get("serve_int8_bytes_ratio")
         recalls = bench_extra.get("serve_recall_at_10_vs_fp32") or {}
         int8_recall = recalls.get("int8") if isinstance(recalls, dict) else None
-        # Recall floor is 0.98, not the 0.999 one might expect: the bench
-        # corpus is init-params embeddings (near-isotropic), so the median
-        # rank-10/11 cosine gap (~1.2e-3) sits within ~2x of the int8
-        # score-noise bound (~6e-4) — an order-statistics worst case where
-        # even bf16 measures 0.997, and centering/asymmetric schemes were
-        # measured to buy nothing (docs/serving.md). Re-measure on a trained
-        # corpus before tightening.
+        # The bench-sidecar floor stays 0.98: the bench corpus is
+        # init-params embeddings (near-isotropic), so the median rank-10/11
+        # cosine gap (~1.2e-3) sits within ~2x of the int8 score-noise bound
+        # (~6e-4) — an order-statistics worst case where even bf16 measures
+        # 0.997. The AUTHORITATIVE recall floor is now the trained-corpus
+        # measurement above (churn_trained_int8_recall, floor 0.99, r10):
+        # production serves trained embeddings, and the churn probe measures
+        # those directly on every evidence run (docs/serving.md).
         check("serve_int8_corpus",
               int8_ratio is not None and float(int8_ratio) <= 0.35
               and int8_recall is not None and float(int8_recall) >= 0.98,
@@ -830,6 +903,7 @@ def main(argv=None):
         "user_model": dict(user),
         "chaos_soak": chaos_out,
         "chaos_serve_soak": chaos_serve_out,
+        "chaos_churn_soak": chaos_churn_out,
         "checks": checks,
     }
     # the 3-seed spread behind the calibrated thresholds rides along in the
@@ -1124,6 +1198,41 @@ def _write_md(p):
                 f"{pl['n_shed']} | {pl['n_errors']} | {pl['swap_faulted']} | "
                 f"{pl['swap_rolled_back']} | {pl['p95_ms']} | "
                 f"{pl['duration_s']} |")
+    cc = p.get("chaos_churn_soak")
+    if cc:
+        lines += [
+            "",
+            "## Chaos-churn soak (continuous refresh)",
+            "",
+            f"{cc['n_ok']}/{cc['n_plans']} seeded fault plans against the "
+            "refresh loop — supervisor death at ingest/encode/fine-tune, "
+            "swap crash inside the corpus, transient encode, preemption "
+            "INSIDE the warm-start fine-tune (docs/reliability.md). Each "
+            "plan must promote a strictly monotonic, health-gated version "
+            "sequence matching its fault-free reference session and resume "
+            "the fine-tune bitwise-exact on CPU:",
+            "",
+            "| plan | ok | bitwise | monotonic | restarts | rollbacks | "
+            "faults | versions | s |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for pl in cc["plans"]:
+            lines.append(
+                f"| {pl['seed']} | {pl['ok']} | {pl['bitwise']} | "
+                f"{pl['versions_monotonic']} | {pl['restarts']} | "
+                f"{pl['rollbacks']} | {pl['n_injected']} | "
+                f"{pl['versions']} | {pl['duration_s']} |")
+        rc = cc.get("recall")
+        if rc:
+            lines += [
+                "",
+                f"Trained-corpus recall probe ({rc['shape']}): int8 "
+                f"recall@10 **{rc['trained']['int8']}** / bf16 "
+                f"**{rc['trained']['bfloat16']}** vs fp32 ranking on the "
+                f"churned v{rc['corpus_version']} corpus; init-params worst "
+                f"case at the same shape {rc['init_params']} — the basis "
+                "for the 0.99 evidence floor (docs/serving.md).",
+            ]
     lines += ["", "## Checks", ""]
     for name, c in p["checks"].items():
         lines.append(f"- **{'PASS' if c['pass'] else 'FAIL'}** {name}: {c['detail']}")

@@ -1,0 +1,58 @@
+"""Planted R13: wall-clock time.time() in deadline/timeout arithmetic — the
+clock-jump failure shapes that make a serve deadline fire early/late/never.
+Clean twins: time.monotonic() for every interval, with time.time() kept only
+for log/manifest timestamps, and a reasoned disable on a genuine wall-clock
+contract (an absolute due time from an external scheduler)."""
+
+import time
+
+
+def compute_deadline(budget_s):
+    deadline = time.time() + budget_s  # planted: R13
+    return deadline
+
+
+def shed_expired(requests, deadline):
+    alive = []
+    for req in requests:
+        if time.time() > deadline:  # planted: R13
+            break
+        alive.append(req)
+    return alive
+
+
+def watchdog_loop(t0, timeout_s, poll):
+    while time.time() - t0 < timeout_s:  # planted: R13
+        poll()
+
+
+def park_until(fut, t_start, budget_s):
+    return fut.result(timeout=time.time() - t_start)  # planted: R13
+
+
+# ---------------------------------------------------------------- clean twins
+
+def compute_deadline_monotonic(budget_s):
+    deadline = time.monotonic() + budget_s  # interval math on the right clock
+    return deadline
+
+
+def shed_expired_monotonic(requests, deadline):
+    alive = []
+    for req in requests:
+        if time.monotonic() > deadline:
+            break
+        alive.append(req)
+    return alive
+
+
+def stamp_manifest(manifest):
+    manifest["ts"] = time.time()  # a wall-clock TIMESTAMP, not deadline state
+    started = time.time()
+    manifest["wall_s"] = time.time() - started  # duration stamp, no compare
+    return manifest
+
+
+def external_due_time(job):
+    # jaxcheck: disable=R13 (the scheduler hands us an absolute wall-clock due time; comparing against wall clock IS the contract here)
+    return time.time() >= job["due_at_unix"]

@@ -29,7 +29,8 @@ def bench():
 TINY = dict(batch=64, n_batches=2, warmup=1, prefetch=1,
             train_batch=32, train_steps=2, train_warmup=1,
             stream_rows=128, stream_batch=64, stream_epochs=1,
-            serve_corpus=64, serve_requests=8)
+            serve_corpus=64, serve_requests=8,
+            churn_corpus=64, churn_batch=16, churn_cycles=2)
 
 
 def test_bench_functions_produce_finite_rates(bench):
@@ -89,6 +90,24 @@ def test_bench_encode_scan_rejects_ragged_n_batches(bench):
     with pytest.raises(AssertionError, match="must divide n_batches"):
         bench._bench_encode(jax, params, config, sz, feeds=([], []),
                             scan_group=2)
+
+
+def test_bench_churn_produces_finite_figures(bench):
+    """The churn phase must land its metrics at tiny sizes — a bug here would
+    otherwise surface only inside a live bench round."""
+    from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+
+    config = DAEConfig(
+        n_features=bench.F, n_components=bench.D, enc_act_func="sigmoid",
+        dec_act_func="sigmoid", loss_func="cross_entropy", corr_type="none",
+        corr_frac=0.0, triplet_strategy="none", compute_dtype="bfloat16")
+    params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
+    out = bench._bench_churn(jax, params, config, TINY)
+    assert out["churn_encode_articles_per_sec"] > 0
+    assert out["refresh_swap_p95_ms"] >= out["refresh_swap_p50_ms"] > 0
+    assert out["churn_final_version"] == 2 + TINY["churn_cycles"]
+    assert out["churn_final_rows"] == (
+        TINY["churn_corpus"] + (1 + TINY["churn_cycles"]) * TINY["churn_batch"])
 
 
 def test_bench_size_tables_consistent(bench):

@@ -181,6 +181,32 @@ def test_recorder_dump_bundle_roundtrip(tmp_path):
     assert bundle["note"] == "seeded"
 
 
+def test_recorder_repeated_dumps_get_suffixes(tmp_path):
+    """Regression: repeated anomalies in ONE run must not clobber the first
+    bundle — later dumps take health_bundle_<n>.json suffixes. A FRESH
+    recorder still writes the bare path (a rerun may overwrite a stale
+    bundle from a previous run)."""
+    target = str(tmp_path / "health_bundle.json")
+    rec = FlightRecorder()
+    rec.record(1, {"cost": float("nan")})
+    first = rec.dump(target, reason="first anomaly")
+    assert first == target
+    second = rec.dump(target, reason="second anomaly")
+    third = rec.dump(target, reason="third anomaly")
+    assert second == str(tmp_path / "health_bundle_2.json")
+    assert third == str(tmp_path / "health_bundle_3.json")
+    for path, reason in [(first, "first anomaly"), (second, "second anomaly"),
+                         (third, "third anomaly")]:
+        with open(path, encoding="utf-8") as f:
+            assert json.load(f)["reason"] == reason
+    # a fresh recorder (fresh run) overwrites the stale first-path bundle
+    rec2 = FlightRecorder()
+    rec2.record(1, {"cost": float("inf")})
+    assert rec2.dump(target, reason="fresh run") == target
+    with open(target, encoding="utf-8") as f:
+        assert json.load(f)["reason"] == "fresh run"
+
+
 def test_recorder_exception_marks_failed():
     rec = FlightRecorder()
     rec.record(1, {"cost": 1.0})

@@ -1,0 +1,319 @@
+"""Continuous-churn contracts (ISSUE 10 tentpole): frozen-vocab incremental
+vectorization, in-graph drift metrics, versioned incremental swaps with
+age-based eviction, and the ChurnSupervisor's drift-gated refresh loop —
+a drift trip must BLOCK the incremental swap and trigger
+fine-tune-then-rebuild, never serve stale embeddings.
+
+End-to-end crash/recovery lives in tests/test_chaos_churn.py; this file is
+the component bar.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+from sklearn.feature_extraction.text import CountVectorizer
+
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu.data import IncrementalVectorizer
+from dae_rnn_news_recommendation_tpu.models.dae_core import (DAEConfig,
+                                                             init_params)
+from dae_rnn_news_recommendation_tpu.refresh import (ChurnConfig,
+                                                     ChurnSupervisor,
+                                                     DriftTripped)
+from dae_rnn_news_recommendation_tpu.reliability import faults
+from dae_rnn_news_recommendation_tpu.serve import ServingCorpus, SwapRejected
+from dae_rnn_news_recommendation_tpu.telemetry import drift_health
+
+N, F, D = 48, 24, 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = DAEConfig(n_features=F, n_components=D,
+                       triplet_strategy="none", corr_frac=0.0)
+    params = init_params(jax.random.PRNGKey(3), config)
+    articles = np.random.default_rng(3).random((N, F), dtype=np.float32)
+    return config, params, articles
+
+
+def make_supervisor(config, params, articles, *, block=16, **churn_kw):
+    corpus = ServingCorpus(config, block=block)
+    churn_kw.setdefault("microbatch", 16)
+    sup = ChurnSupervisor(params, config, corpus,
+                          churn=ChurnConfig(**churn_kw))
+    sup.bootstrap(articles)
+    return sup
+
+
+def batch(seed, rows=12):
+    return np.random.default_rng(seed).random((rows, F), dtype=np.float32)
+
+
+# ------------------------------------------------------- incremental vectorizer
+
+DOCS = ["the cat sat on the mat", "dog bites man near the market",
+        "market rally lifts tech stocks", "cat and dog adoption rates rise"]
+
+
+def test_frozen_vocab_matches_fitted_transform_exactly():
+    cv = CountVectorizer()
+    X_ref = cv.fit_transform(DOCS)
+    iv = IncrementalVectorizer.from_fitted(cv)
+    X = iv.transform(DOCS)
+    assert X.dtype == np.float32 and X.shape == X_ref.shape
+    np.testing.assert_array_equal(X.toarray(), X_ref.toarray())
+    assert iv.oov_fraction == 0.0
+
+
+def test_oov_terms_hash_stably_never_refit():
+    cv = CountVectorizer()
+    cv.fit(DOCS)
+    vocab_before = dict(cv.vocabulary_)
+    iv = IncrementalVectorizer.from_fitted(cv)
+    oov_doc = ["blockchain zeitgeist cat"]
+    a = iv.transform(oov_doc)
+    b = IncrementalVectorizer.from_fitted(cv).transform(oov_doc)
+    # replay determinism: a fresh instance (fresh process in the chaos story)
+    # produces the byte-identical matrix — crc32, not PYTHONHASHSEED
+    np.testing.assert_array_equal(a.toarray(), b.toarray())
+    assert iv.vocabulary == vocab_before  # frozen: OOV never grew the vocab
+    assert 0.0 < iv.oov_fraction < 1.0    # 2 of 3 tokens hashed
+    assert iv.stats()["n_oov"] == 2
+
+
+def test_oov_buckets_confine_hash_collisions_to_tail():
+    vocab = {f"t{i:02d}": i for i in range(20)}
+    iv = IncrementalVectorizer(vocab, n_features=F, oov_buckets=4)
+    X = iv.transform(["t01 t05 zebra quux flarp"])
+    oov_cols = X.nonzero()[1][X.nonzero()[1] >= 20]
+    assert len(oov_cols) > 0 and all(20 <= c < F for c in oov_cols)
+    in_vocab = set(X.nonzero()[1]) - set(oov_cols)
+    assert in_vocab == {1, 5}
+
+
+# ------------------------------------------------------------- drift metrics
+
+def test_drift_health_zero_for_identical_distribution():
+    rng = np.random.default_rng(0)
+    h = rng.normal(size=(32, D)).astype(np.float32)
+    u = h / np.linalg.norm(h, axis=1, keepdims=True)
+    ref_centroid = u.mean(axis=0)
+    rep = jax.device_get(drift_health(jnp.asarray(h),
+                                      jnp.asarray(ref_centroid),
+                                      jnp.float32(0.0)))
+    assert float(rep["health/drift_centroid_shift"]) < 1e-5
+    assert float(rep["health/drift_collapse_delta"]) == pytest.approx(
+        abs(float(rep["health/drift_collapse"])), abs=1e-6)
+
+
+def test_drift_health_flags_flipped_embeddings_and_padding_is_exact():
+    rng = np.random.default_rng(1)
+    h = rng.normal(size=(24, D)).astype(np.float32)
+    u = h / np.linalg.norm(h, axis=1, keepdims=True)
+    ref = u.mean(axis=0)
+    flipped = jax.device_get(drift_health(jnp.asarray(-h), jnp.asarray(ref),
+                                          jnp.float32(0.0)))
+    assert float(flipped["health/drift_centroid_shift"]) > 1.9  # cos = -1
+    # masked padding must not perturb the metrics
+    padded = np.zeros((32, D), np.float32)
+    padded[:24] = h
+    valid = np.zeros(32, np.float32)
+    valid[:24] = 1.0
+    a = jax.device_get(drift_health(jnp.asarray(h), jnp.asarray(ref),
+                                    jnp.float32(0.0)))
+    b = jax.device_get(drift_health(jnp.asarray(padded), jnp.asarray(ref),
+                                    jnp.float32(0.0),
+                                    row_valid=jnp.asarray(valid)))
+    assert float(a["health/drift_centroid_shift"]) == pytest.approx(
+        float(b["health/drift_centroid_shift"]), abs=1e-6)
+    assert float(a["health/drift_collapse"]) == pytest.approx(
+        float(b["health/drift_collapse"]), abs=1e-6)
+
+
+# --------------------------------------------------------- incremental swap
+
+def test_incremental_swap_appends_and_versions_monotonically(setup):
+    config, params, articles = setup
+    sup = make_supervisor(config, params, articles)
+    v0 = sup.corpus.version
+    for i in range(3):
+        rep = sup.ingest(batch(100 + i))
+        assert rep["action"] == "incremental"
+        assert rep["version"] == v0 + 1 + i
+        assert rep["gate"]["ok"] and rep["gate"]["tail"]
+    assert sup.corpus.active.n == N + 3 * 12
+    assert sup.resident_rows() == N + 3 * 12
+    led = sup.corpus.ledger
+    assert [r["version"] for r in led if r["ok"]] == [1, 2, 3, 4]
+    assert [r["kind"] for r in led] == ["full"] + ["incremental"] * 3
+
+
+def test_max_rows_evicts_oldest_first(setup):
+    config, params, articles = setup
+    sup = make_supervisor(config, params, articles, max_rows=45)
+    rep = sup.ingest(batch(200))
+    # 48 resident + 12 new > 45: keep budget 33 -> evict the 15 oldest
+    assert rep["n_evicted"] == 15
+    assert sup.corpus.active.n == 45
+    assert sup.resident_rows() == 45  # host mirror trimmed in lockstep
+
+
+def test_max_age_versions_expires_old_news(setup):
+    config, params, articles = setup
+    sup = make_supervisor(config, params, articles, max_age_versions=1)
+    r1 = sup.ingest(batch(300))   # v2: v1 rows age exactly 1, still kept
+    assert r1["n_evicted"] == 0
+    assert sup.corpus.active.n == N + 12
+    r2 = sup.ingest(batch(301))   # v3: v1 rows age 2 > 1, expired
+    assert r2["n_evicted"] == N
+    assert sup.corpus.active.n == 24
+    assert sup.resident_rows() == 24
+
+
+def test_incremental_swap_requires_a_bootstrapped_corpus(setup):
+    config, params, articles = setup
+    corpus = ServingCorpus(config, block=16)
+    with pytest.raises(SwapRejected):
+        corpus.swap_incremental(params, articles[:8], note="no base")
+
+
+def test_injected_swap_fault_rolls_back_and_replay_converges(setup):
+    config, params, articles = setup
+    sup = make_supervisor(config, params, articles)
+    v0 = sup.corpus.version
+    plan = faults.FaultPlan(seed=0, specs=(
+        faults.FaultSpec("refresh.swap", 1, "fatal"),))
+    with faults.install(faults.FaultInjector(plan)) as injector:
+        rep = sup.ingest(batch(400))
+        assert rep["action"] == "rollback"
+        assert sup.corpus.version == v0
+        assert sup.resident_rows() == N       # mirror untouched on rollback
+        assert injector.fired
+        # the replayed cycle reconverges (the spec is consumed)
+        rep2 = sup.ingest(batch(400))
+    assert rep2["action"] == "incremental" and rep2["version"] == v0 + 1
+    assert sup.corpus.active.n == N + 12
+    led = sup.corpus.ledger
+    assert [r["ok"] for r in led] == [True, False, True]
+
+
+def test_transient_encode_fault_is_absorbed_by_retry(setup):
+    config, params, articles = setup
+    sup = make_supervisor(config, params, articles)
+    plan = faults.FaultPlan(seed=0, specs=(
+        faults.FaultSpec("refresh.encode", 1, "transient"),))
+    with faults.install(faults.FaultInjector(plan)) as injector:
+        rep = sup.ingest(batch(500))
+    assert rep["action"] == "incremental"     # the blip never surfaced
+    assert injector.fired and injector.retries  # ...but was never silent
+
+
+# ----------------------------------------------------------------- drift gate
+
+def test_drift_trip_blocks_swap_and_triggers_finetune(setup):
+    config, params, articles = setup
+    corpus = ServingCorpus(config, block=16)
+    calls = []
+
+    def finetune_fn(train):
+        calls.append(int(train.shape[0]))
+        return params
+
+    sup = ChurnSupervisor(
+        params, config, corpus,
+        churn=ChurnConfig(microbatch=16, drift_centroid_max=-1.0),
+        finetune_fn=finetune_fn)  # ceiling below zero: every cycle trips
+    sup.bootstrap(articles)
+    v0 = corpus.version
+    rep = sup.ingest(batch(600))
+    assert rep["action"] == "finetune_rebuild"
+    assert sup.drift_trips and sup.drift_trips[0]["tripped"]
+    # the fine-tune saw resident rows + the triggering batch, and the corpus
+    # was FULL-rebuilt (never an incremental append of drifted embeddings)
+    assert calls == [N + 12]
+    assert corpus.version == v0 + 1
+    assert corpus.ledger[-1]["kind"] == "full"
+    assert all(r["kind"] != "incremental" for r in corpus.ledger)
+    assert len(sup.finetunes) == 1
+
+
+def test_drift_trip_without_finetune_path_raises(setup):
+    config, params, articles = setup
+    corpus = ServingCorpus(config, block=16)
+    sup = ChurnSupervisor(params, config, corpus,
+                          churn=ChurnConfig(microbatch=16,
+                                            drift_collapse_max=-1.0))
+    sup.bootstrap(articles)
+    v0 = corpus.version
+    with pytest.raises(DriftTripped):
+        sup.ingest(batch(700))
+    assert corpus.version == v0  # nothing swapped
+
+
+# -------------------------------------------------------- telemetry surface
+
+def test_dump_history_roundtrips_into_the_report(setup, tmp_path):
+    config, params, articles = setup
+    sup = make_supervisor(config, params, articles)
+    for i in range(3):
+        sup.ingest(batch(800 + i))
+    path = sup.dump_history(str(tmp_path / "churn_history.json"))
+    assert not (tmp_path / "churn_history.json.tmp").exists()  # atomic
+
+    from dae_rnn_news_recommendation_tpu.telemetry.report import (
+        churn_summary, load_churn, render_text)
+    dump = load_churn(path)
+    summary = churn_summary(dump)
+    assert summary["n_cycles"] == 3
+    assert summary["actions"] == {"incremental": 3}
+    assert summary["drift_trips"] == 0
+    assert summary["version_span"] == [2, 4]  # bootstrap is v1
+    assert summary["swap_p95_ms"] >= summary["swap_p50_ms"] > 0
+    assert summary["encode_articles_per_sec"] > 0
+    assert summary["resident_rows"] == N + 3 * 12
+    assert summary["corpus_version"] == 4
+    assert summary["finetunes"] == 0 and summary["retries"] == 0
+
+    text = render_text([], churn=summary)
+    assert "corpus churn: 3 cycles, 0 drift trips, versions v2..v4" in text
+    assert "incremental x3" in text and "swap latency:" in text
+
+
+def test_load_churn_accepts_bare_history_and_rejects_garbage(tmp_path):
+    import json as _json
+    from dae_rnn_news_recommendation_tpu.telemetry.report import (
+        churn_summary, load_churn)
+    bare = tmp_path / "bare.json"
+    bare.write_text(_json.dumps([{"cycle": 1, "action": "incremental",
+                                  "version": 2}]))
+    dump = load_churn(str(bare))
+    assert churn_summary(dump)["n_cycles"] == 1
+    bad = tmp_path / "bad.json"
+    bad.write_text(_json.dumps({"history": "nope"}))
+    with pytest.raises(ValueError):
+        load_churn(str(bad))
+
+
+# ------------------------------------------------------------- text end-to-end
+
+def test_supervisor_ingests_raw_text_through_frozen_vocab(setup):
+    config, params, articles = setup
+    vocab = {f"t{i:02d}": i for i in range(F)}
+    iv = IncrementalVectorizer(vocab, n_features=F)
+    corpus = ServingCorpus(config, block=16)
+    # text counts live on a different scale than the random bootstrap, so
+    # open the drift ceilings wide — this test is about the vectorizer path
+    sup = ChurnSupervisor(params, config, corpus,
+                          churn=ChurnConfig(microbatch=16,
+                                            drift_centroid_max=2.5,
+                                            drift_collapse_max=2.0),
+                          vectorizer=iv)
+    sup.bootstrap(sp.csr_matrix(articles))
+    texts = [f"t{i % F:02d} t{(i + 3) % F:02d} neologism{i}"
+             for i in range(12)]
+    rep = sup.ingest(texts)
+    assert rep["action"] == "incremental" and rep["n_new"] == 12
+    assert rep["oov_fraction"] == pytest.approx(1 / 3, abs=1e-6)
+    assert sup.corpus.active.n == N + 12

@@ -335,6 +335,91 @@ def test_failed_first_swap_raises_with_nothing_to_serve(setup):
     assert corpus.active is None
 
 
+class _HoldSwapOpen:
+    """Injector double whose fire() parks inside the swap's standby build —
+    a deterministic in-flight window for the re-entrancy tests (no sleeps)."""
+
+    def __init__(self, site):
+        self.site = site
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def fire(self, site, **info):
+        if site == self.site:
+            self.entered.set()
+            assert self.release.wait(timeout=SLA)
+
+    def note_retry(self, event):
+        pass
+
+
+def test_swap_reentrancy_raises_swap_in_progress_deterministically(setup):
+    """Satellite: concurrent refresh attempts while a swap is in flight must
+    fail fast with SwapInProgress — never interleave slot state. The window
+    is held open deterministically by parking the first swap inside its
+    build hook."""
+    from dae_rnn_news_recommendation_tpu.serve import SwapInProgress
+
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    v0 = corpus.version
+    hold = _HoldSwapOpen("serve.swap")
+    fresh = np.random.default_rng(11).random((N, F), dtype=np.float32)
+    with faults.install(hold):
+        t = threading.Thread(
+            target=corpus.swap, args=(params, fresh),
+            kwargs={"note": "in-flight"})
+        t.start()
+        assert hold.entered.wait(timeout=SLA)  # swap A is inside its build
+        with pytest.raises(SwapInProgress):
+            corpus.swap(params, articles, note="concurrent full")
+        with pytest.raises(SwapInProgress):
+            corpus.swap_incremental(params, articles[:8],
+                                    note="concurrent incremental")
+        hold.release.set()
+        t.join(timeout=SLA)
+        assert not t.is_alive()
+    # swap A landed exactly once; the rejected attempts left no slot state
+    assert corpus.version == v0 + 1
+    rejected = [e for e in corpus.events
+                if e["event"] == "swap_rejected_busy"]
+    assert len(rejected) == 2
+    # the guard is released: a follow-up swap succeeds normally
+    corpus.swap(params, articles, note="after")
+    assert corpus.version == v0 + 2
+
+
+def test_sharded_service_matches_single_device_ranking(setup):
+    """Satellite: RecommendationService(sharded=True) serves a row-sharded
+    corpus through make_sharded_serve_fn with the same replies as the
+    single-device path (conftest pins 8 virtual CPU devices)."""
+    from dae_rnn_news_recommendation_tpu.parallel.mesh import (get_mesh,
+                                                               shard_rows)
+
+    config, params, articles = setup
+    mesh = get_mesh()
+    corpus = make_corpus(config, params, articles,
+                         device_put=lambda x: shard_rows(x, mesh))
+    svc = make_service(config, params, corpus, sharded=True, mesh=mesh)
+    try:
+        assert svc.sharded and svc.summary()["sharded"]
+        replies = [svc.submit(articles[i], deadline_s=SLA).result(timeout=SLA)
+                   for i in (0, 11, 37)]
+        assert all(r.ok for r in replies)
+        assert [r.indices[0] for r in replies] == [0, 11, 37]
+    finally:
+        svc.stop()
+    ref_corpus = make_corpus(config, params, articles)
+    ref = make_service(config, params, ref_corpus)
+    try:
+        for i, r in zip((0, 11, 37), replies):
+            rr = ref.submit(articles[i], deadline_s=SLA).result(timeout=SLA)
+            np.testing.assert_array_equal(np.asarray(r.indices),
+                                          np.asarray(rr.indices))
+    finally:
+        ref.stop()
+
+
 # ---------------------------------------------------------------- telemetry
 
 def test_serving_emits_fenced_batch_spans_and_request_spans(setup):
