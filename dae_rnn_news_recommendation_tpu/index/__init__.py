@@ -1,0 +1,21 @@
+"""Clustered (IVF) retrieval index: on-device k-means + cell-major layout.
+
+`kmeans_fit` partitions the resident corpus into spherical cells (seeded
+from the serving slot's drift-gate centroid), `build_cells` permutes the
+quantized corpus into contiguous per-cell slabs, and `ops/ivf_topk.py`
+scores queries against only the probed slabs. `assign_cells` is the churn
+composition hook: appended rows route to existing cells without a refit.
+"""
+
+from .kmeans import KMeansResult, assign_cells, kmeans_fit
+from .layout import CAP_ROUND, IVFCells, build_cells, cell_stats
+
+__all__ = [
+    "CAP_ROUND",
+    "IVFCells",
+    "KMeansResult",
+    "assign_cells",
+    "build_cells",
+    "cell_stats",
+    "kmeans_fit",
+]

@@ -1,0 +1,144 @@
+"""On-device spherical k-means for the IVF retrieval index.
+
+The clustering runs entirely as one jitted graph (k-means++ seeding loop +
+fixed-iteration Lloyd refinement), so index builds ride the same device the
+corpus lives on and never round-trip rows through the host. Three properties
+matter for the serving integration:
+
+- **Seeded from the drift gate.** `ServingCorpus._health_gate` already
+  maintains a mean-direction centroid per slot (`slot.stats["centroid"]`,
+  the same statistic `telemetry/health.drift_health` compares against). That
+  vector is the first k-means++ seed, so a rebuilt index starts from the
+  corpus's actual center of mass instead of a random row — and successive
+  rebuilds of a drifting corpus stay comparable.
+- **Empty-cell reseeding.** Every Lloyd iteration relocates zero-count
+  centroids onto the rows farthest from their current cell (largest cosine
+  distance), one distinct row per empty cell, so pathological seeds cannot
+  permanently strand capacity.
+- **Deterministic.** All randomness flows from one `PRNGKey(seed)` with
+  per-step `fold_in`, so a (corpus, seed) pair always yields the same cells
+  — the parity suite depends on this.
+
+Rows are treated as directions (the serve graph l2-normalizes both sides),
+so "distance" is `1 - cosine` throughout. Invalid rows get a nearest-cell
+assignment like everyone else — the IVF scorer must keep them addressable
+for `lax.top_k`-exact -inf tie ordering — but carry zero weight in the
+centroid update and can never be chosen as seeds.
+"""
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1e-12
+
+
+class KMeansResult(NamedTuple):
+    centroids: np.ndarray  # [n_cells, D] f32, unit rows
+    assign: np.ndarray     # [N] int32 nearest-cell id (invalid rows included)
+    counts: np.ndarray     # [n_cells] f32 valid-row occupancy
+    inertia: float         # mean (1 - cosine) of valid rows to their cell
+
+
+def _unit(x, axis=-1):
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=axis, keepdims=True), _EPS)
+
+
+@functools.partial(jax.jit, static_argnames=("n_cells", "n_iters"))
+def _kmeans_device(emb, valid, key, init_centroid, n_cells, n_iters):
+    n, d = emb.shape
+    x = _unit(emb.astype(jnp.float32))
+    w = (valid > 0).astype(jnp.float32)
+
+    # ---- k-means++ seeding, first seed = the slot's drift-gate centroid ----
+    cents = jnp.zeros((n_cells, d), jnp.float32)
+    cents = cents.at[0].set(_unit(init_centroid.astype(jnp.float32)))
+
+    def seed_step(t, cents):
+        sims = jnp.dot(x, cents.T)                       # [N, n_cells] f32
+        filled = (jnp.arange(n_cells) < t)[None, :]
+        best = jnp.max(jnp.where(filled, sims, -jnp.inf), axis=1)
+        d2 = jnp.maximum(1.0 - best, 0.0) + 1e-9         # classic D^2 weights
+        logits = jnp.where(w > 0, jnp.log(d2), -jnp.inf)
+        pick = jax.random.categorical(jax.random.fold_in(key, t), logits)
+        return cents.at[t].set(x[pick])
+
+    cents = jax.lax.fori_loop(1, n_cells, seed_step, cents)
+
+    # ---- Lloyd iterations with empty-cell reseeding ----
+    def lloyd(_, cents):
+        sims = jnp.dot(x, cents.T)
+        assign = jnp.argmax(sims, axis=1)
+        oh = jax.nn.one_hot(assign, n_cells, dtype=jnp.float32) * w[:, None]
+        counts = jnp.sum(oh, axis=0)                     # [n_cells]
+        sums = jnp.dot(oh.T, x)                          # [n_cells, D]
+        # reseed empties onto the farthest valid rows, one distinct row each
+        far = jnp.where(w > 0, 1.0 - jnp.max(sims, axis=1), -jnp.inf)
+        order = jnp.argsort(-far)
+        empty = counts <= 0
+        rank = jnp.clip(jnp.cumsum(empty.astype(jnp.int32)) - 1, 0, n - 1)
+        reseed = x[order[rank]]
+        mean = sums / jnp.maximum(counts, 1.0)[:, None]
+        return _unit(jnp.where(empty[:, None], reseed, mean))
+
+    cents = jax.lax.fori_loop(0, n_iters, lloyd, cents)
+
+    sims = jnp.dot(x, cents.T)
+    assign = jnp.argmax(sims, axis=1).astype(jnp.int32)
+    oh = jax.nn.one_hot(assign, n_cells, dtype=jnp.float32) * w[:, None]
+    counts = jnp.sum(oh, axis=0)
+    inertia = (jnp.sum((1.0 - jnp.max(sims, axis=1)) * w)
+               / jnp.maximum(jnp.sum(w), 1.0))
+    return cents, assign, counts, inertia
+
+
+@jax.jit
+def _assign_device(emb, centroids):
+    sims = jnp.dot(_unit(emb.astype(jnp.float32)),
+                   centroids.astype(jnp.float32).T)
+    return jnp.argmax(sims, axis=1).astype(jnp.int32)
+
+
+def kmeans_fit(emb, valid, n_cells, *, seed=0, n_iters=8, init_centroid=None):
+    """Cluster corpus rows into `n_cells` spherical cells on device.
+
+    :param emb: [N, D] embeddings (any float dtype; dequantize int8 first)
+    :param valid: [N] mask; rows <= 0 are assigned but carry no weight
+    :param init_centroid: [D] first k-means++ seed — pass the serving slot's
+        `stats["centroid"]` so the index inherits the drift gate's view of
+        the corpus; None falls back to the valid-row mean direction.
+    :returns: KMeansResult on host (centroids stay small: n_cells x D)
+    """
+    n_cells = int(n_cells)
+    emb = jnp.asarray(emb)
+    n = emb.shape[0]
+    if not 1 <= n_cells <= max(n, 1):
+        raise ValueError(f"n_cells={n_cells} outside [1, N={n}]")
+    valid = jnp.asarray(valid)
+    if init_centroid is None:
+        w = (valid > 0).astype(jnp.float32)
+        init_centroid = jnp.sum(emb.astype(jnp.float32) * w[:, None], axis=0)
+    init_centroid = jnp.asarray(init_centroid, jnp.float32)
+    cents, assign, counts, inertia = _kmeans_device(
+        emb, valid, jax.random.PRNGKey(seed), init_centroid,
+        n_cells=n_cells, n_iters=int(n_iters))
+    return KMeansResult(
+        centroids=np.asarray(jax.device_get(cents)),
+        assign=np.asarray(jax.device_get(assign)),
+        counts=np.asarray(jax.device_get(counts)),
+        inertia=float(jax.device_get(inertia)),
+    )
+
+
+def assign_cells(emb, centroids):
+    """Nearest-centroid cell ids for `emb` rows — the append routing path.
+
+    This is the "no re-index" half of churn composition: appended rows are
+    routed to existing cells with one [N, n_cells] argmax, the centroids are
+    NOT refit (see `ServingCorpus.reindex` for the full rebuild).
+    """
+    return np.asarray(jax.device_get(
+        _assign_device(jnp.asarray(emb), jnp.asarray(centroids))))

@@ -1,0 +1,129 @@
+"""Cell-major corpus layout for clustered (IVF) retrieval.
+
+The flat serving slot keeps rows in ingest order; the IVF scorer instead
+wants each k-means cell's rows CONTIGUOUS so a probed cell is one aligned
+`[cell_cap, D]` panel copy HBM->VMEM (the repo's Mosaic notes in
+`ops/pallas_kernels.py` require dynamic-slice offsets aligned to the tile
+grid — uniform cell capacity gives that alignment for free). The layout is
+a *permutation view* of the slot's already-quantized arrays, never a
+re-quantization: a row's int8 payload and scale are bitwise the ones the
+exact scorer reads, which is what makes `probes = n_cells` parity exact.
+
+Shape contract (`C = n_cells`, `cap = cell_cap`, uniform):
+
+    cell_emb    [(C+1)*cap, D]  slot dtype; cell c occupies rows
+                                [c*cap, (c+1)*cap)
+    cell_valid  [(C+1)*cap]     slot valid gathered; padding slots 0
+    cell_scales [(C+1)*cap]     per-row dequant scales; padding slots 1
+    row_ids     [(C+1)*cap]     ORIGINAL slot row index, or INT32_MAX for
+                                padding — the scorer tie-breaks on these,
+                                so padding loses every -inf tie to real rows
+    assign      [N]             cell id per original row (jnp fallback mask
+                                + append routing)
+
+Cell `C` (one extra) is an all-padding dummy: shortlist dedup and query
+padding point at it, so every shortlist entry is always a readable panel.
+Rows within a cell keep ascending original order (stable sort), though the
+scorer does not rely on it.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.topk_fused import _IDX_SENTINEL
+
+# uniform cell capacity is rounded up to the int8 sublane tile (32), the
+# strictest of the f32/bf16/int8 minimums, so one layout serves every dtype
+CAP_ROUND = 32
+
+
+class IVFCells(NamedTuple):
+    """Device-resident IVF index: pytree-safe, jit-traceable as an argument."""
+
+    centroids: jnp.ndarray    # [C, D] f32 unit rows
+    cell_emb: jnp.ndarray     # [(C+1)*cap, D] slot dtype
+    cell_valid: jnp.ndarray   # [(C+1)*cap] f32
+    cell_scales: jnp.ndarray  # [(C+1)*cap] f32
+    row_ids: jnp.ndarray      # [(C+1)*cap] int32
+    assign: jnp.ndarray       # [N] int32
+
+    @property
+    def n_cells(self):
+        return self.centroids.shape[0]
+
+    @property
+    def cell_cap(self):
+        return self.row_ids.shape[0] // (self.centroids.shape[0] + 1)
+
+    @property
+    def n_rows(self):
+        return self.assign.shape[0]
+
+    def resident_bytes(self):
+        return int(sum(np.prod(a.shape) * a.dtype.itemsize for a in
+                       (self.centroids, self.cell_emb, self.cell_valid,
+                        self.cell_scales, self.row_ids, self.assign)))
+
+
+def build_cells(emb, valid, scales, centroids, assign):
+    """Permute a (quantized) corpus into cell-major slabs.
+
+    :param emb: [N, D] slot embeddings, any corpus dtype — gathered as-is
+    :param valid: [N] mask
+    :param scales: [N] f32 per-row dequant scales, or None for ones
+    :param centroids: [C, D] f32 (host or device)
+    :param assign: [N] int32 cell id per row (host)
+    :returns: IVFCells with all large arrays on device
+    """
+    emb = jnp.asarray(emb)
+    n = emb.shape[0]
+    assign_np = np.asarray(assign).astype(np.int64)
+    c = int(np.asarray(centroids).shape[0])
+    if assign_np.shape[0] != n:
+        raise ValueError(f"assign covers {assign_np.shape[0]} rows, corpus {n}")
+    counts = np.bincount(assign_np, minlength=c) if n else np.zeros(c, np.int64)
+    cap = int(max(CAP_ROUND, -(-int(counts.max(initial=0)) // CAP_ROUND) * CAP_ROUND))
+
+    # stable sort keeps ascending original order within each cell; the
+    # vectorized fill places sorted row r at (its cell, its rank in the cell)
+    pos = np.full((c + 1, cap), -1, np.int64)
+    order = np.argsort(assign_np, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    in_cell = np.arange(n, dtype=np.int64) - starts[assign_np[order]]
+    pos[assign_np[order], in_cell] = order
+
+    flat = pos.reshape(-1)
+    present = flat >= 0
+    gather = jnp.asarray(np.where(present, flat, 0).astype(np.int32))
+    mask = jnp.asarray(present)
+    scales_j = (jnp.ones((n,), jnp.float32) if scales is None
+                else jnp.asarray(scales, jnp.float32))
+    return IVFCells(
+        centroids=jnp.asarray(centroids, jnp.float32),
+        cell_emb=jnp.take(emb, gather, axis=0),
+        cell_valid=jnp.where(mask, jnp.take(
+            jnp.asarray(valid).astype(jnp.float32), gather), 0.0),
+        cell_scales=jnp.where(mask, jnp.take(scales_j, gather), 1.0),
+        row_ids=jnp.asarray(
+            np.where(present, flat, _IDX_SENTINEL).astype(np.int32)),
+        assign=jnp.asarray(assign_np.astype(np.int32)),
+    )
+
+
+def cell_stats(cells):
+    """Host-side occupancy stats driving the staleness/rebuild decision."""
+    c, cap = cells.n_cells, cells.cell_cap
+    ids = np.asarray(cells.row_ids).reshape(c + 1, cap)[:c]
+    counts = (ids != _IDX_SENTINEL).sum(axis=1).astype(np.int64)
+    total = int(counts.sum())
+    mean = total / c if c else 0.0
+    return {
+        "n_cells": c,
+        "cell_cap": cap,
+        "counts": counts,
+        "imbalance": float(counts.max(initial=0) / mean) if mean > 0 else 1.0,
+        "frac_empty": float((counts == 0).mean()) if c else 0.0,
+        "n_rows": total,
+    }

@@ -35,6 +35,9 @@ _PALLAS_EXPORTS = ("batch_all_triplet_loss_pallas", "masking_noise_pallas")
 # import pulls jax.experimental.pallas
 _TOPK_EXPORTS = ("topk_fused",)
 
+# clustered (IVF) two-stage retrieval; lazy for the same pallas reason
+_IVF_EXPORTS = ("ivf_topk",)
+
 # __all__ lists only the eager names: a star-import must not trigger __getattr__,
 # which would eagerly pull in jax.experimental.pallas. __dir__ still advertises
 # the Pallas names for completion.
@@ -60,8 +63,13 @@ def __getattr__(name):
         from . import topk_fused
 
         return getattr(topk_fused, name)
+    if name in _IVF_EXPORTS:
+        from . import ivf_topk
+
+        return getattr(ivf_topk, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def __dir__():
-    return sorted(set(globals()) | set(_PALLAS_EXPORTS) | set(_TOPK_EXPORTS))
+    return sorted(set(globals()) | set(_PALLAS_EXPORTS) | set(_TOPK_EXPORTS)
+                  | set(_IVF_EXPORTS))

@@ -1,0 +1,232 @@
+"""Fused IVF retrieval: centroid-scan -> probed-cell gather -> exact rescore.
+
+Two-stage clustered top-k over a cell-major corpus (`index/layout.py`),
+making per-query cost sub-linear in corpus size — the exact scorer
+(`ops/topk_fused.py`) touches all N rows per query; this path touches
+`n_cells` centroids plus `probes` cells' rows:
+
+  stage 1  `topk_fused(h, centroids, ...)` — the existing VMEM-panel
+           accumulator kernel reused verbatim with the centroid table as
+           its "corpus", so the [B, n_cells] centroid score matrix never
+           materializes in HBM; output is just [B, probes] cell ids.
+  stage 2  one Pallas kernel per query block: a `PrefetchScalarGridSpec`
+           carries the block's deduplicated probe-cell list as a scalar-
+           prefetch operand, and the cell-panel BlockSpec's index_map reads
+           it — `lambda i, s, cells: (cells[i, s], 0)` — so the gather IS
+           the pipelined HBM->VMEM panel fetch; no [B, shortlist] score or
+           [B, shortlist, D] gather buffer ever exists in HBM. Inside, the
+           [bq, 128] top-k accumulator from `_topk_kernel` is reused
+           unchanged except that panel indices come from the layout's
+           `row_ids` (original slot row numbers), so results are directly
+           comparable with the exact scorer.
+
+Queries in a block share the scanned cell list (the union of their probe
+sets, duplicates pointed at the all-padding dummy cell), but a per-query
+membership mask keeps the CANDIDATE set per query exactly its own probed
+cells — so the kernel and the jnp fallback agree wherever scores are
+finite, and at `probes = n_cells` both reproduce the exact scorer bitwise
+(scores and indices, -inf ties included; tests/test_ivf.py pins this).
+Entries past a query's last finite candidate score -inf; the kernel
+reports the INT32_MAX sentinel index there, while the jnp fallback (which
+scores all N rows with non-probed rows masked) reports `lax.top_k`'s
+real-index tail — callers must treat the -inf tail's indices as
+unspecified unless `probes = n_cells`.
+
+Degrades honestly rather than truncating: if `k` exceeds the shortlist
+(`probes * cell_cap`) or the accumulator lanes, the call routes to the
+exact `topk_fused` over the flat slot arrays the caller already holds.
+
+Off-TPU the default is the jnp fallback; `impl="pallas"` + interpret mode
+exercises the kernel's gather/masking/selection logic on CPU.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .topk_fused import _ACC_LANES, _IDX_SENTINEL, _on_tpu, topk_fused
+
+# queries per block: the f32 min sublane tile. Shortlists are per-block
+# unions, so a bigger bq widens every query's scanned set — keep it minimal.
+DEFAULT_BQ = 8
+
+
+def _ivf_kernel(cells_ref, q_ref, p_ref, e_ref, r_ref, v_ref, s_ref,
+                os_ref, oi_ref, *, k, bq, cap):
+    i, s = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(s == 0)
+    def _():
+        os_ref[:] = jnp.full((bq, _ACC_LANES), -jnp.inf, jnp.float32)
+        oi_ref[:] = jnp.full((bq, _ACC_LANES), _IDX_SENTINEL, jnp.int32)
+
+    cell_id = cells_ref[i, s]                       # which cell this step is
+    q = q_ref[:]                                    # [bq, D] f32 queries
+    panel = e_ref[:].astype(jnp.float32)            # [cap, D] dequant to f32
+    ps = jax.lax.dot_general(q, panel, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ps = ps * s_ref[:]                              # per-row int8 scale
+    # candidate set per QUERY is its own probe list, even though the block
+    # scans the union: non-members see the whole panel as -inf
+    member = jnp.any(p_ref[:] == cell_id, axis=1, keepdims=True)  # [bq, 1]
+    ps = jnp.where(member & (v_ref[:] > 0), ps, -jnp.inf)
+    # original slot row ids from the layout; padding slots carry the
+    # sentinel and lose every -inf tie to real rows
+    pidx = jnp.broadcast_to(r_ref[:], (bq, cap))
+
+    acc_s, acc_i = os_ref[:], oi_ref[:]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (bq, _ACC_LANES), 1)
+    new_s = jnp.full((bq, _ACC_LANES), -jnp.inf, jnp.float32)
+    new_i = jnp.full((bq, _ACC_LANES), _IDX_SENTINEL, jnp.int32)
+    for t in range(k):  # k static selection steps, unrolled
+        m = jnp.maximum(jnp.max(acc_s, axis=1, keepdims=True),
+                        jnp.max(ps, axis=1, keepdims=True))
+        sel = jnp.minimum(
+            jnp.min(jnp.where(acc_s == m, acc_i, _IDX_SENTINEL),
+                    axis=1, keepdims=True),
+            jnp.min(jnp.where(ps == m, pidx, _IDX_SENTINEL),
+                    axis=1, keepdims=True))
+        new_s = jnp.where(lane == t, m, new_s)
+        new_i = jnp.where(lane == t, sel, new_i)
+        # real row ids are unique across the deduped cell list; only the
+        # sentinel repeats, and retiring it is a no-op (-inf already)
+        acc_s = jnp.where(acc_i == sel, -jnp.inf, acc_s)
+        acc_i = jnp.where(acc_i == sel, _IDX_SENTINEL, acc_i)
+        ps = jnp.where(pidx == sel, -jnp.inf, ps)
+        pidx = jnp.where(pidx == sel, _IDX_SENTINEL, pidx)
+    os_ref[:] = new_s
+    oi_ref[:] = new_i
+
+
+@functools.partial(jax.jit, static_argnames=("k", "cap", "bq", "interpret"))
+def _ivf_pallas(queries, cell_ids, cell_emb, cell_valid, cell_scales,
+                row_ids, k, cap, bq, interpret):
+    b, d = queries.shape
+    probes = cell_ids.shape[1]
+    total = row_ids.shape[0]
+    c = total // cap - 1                             # real cells; dummy = c
+    dp = -(-d // 128) * 128
+    bp = -(-b // bq) * bq
+    nb = bp // bq
+
+    q = jnp.pad(queries.astype(jnp.float32), ((0, bp - b), (0, dp - d)))
+    e = jnp.pad(cell_emb, ((0, 0), (0, dp - d)))
+    v = cell_valid.astype(jnp.float32).reshape(1, total)
+    sc = cell_scales.astype(jnp.float32).reshape(1, total)
+    r = row_ids.reshape(1, total)
+
+    # pad queries probe the dummy cell only; then dedup each block's union
+    # (sorted, repeats -> dummy) so no real row id is scanned twice
+    ids = jnp.pad(cell_ids.astype(jnp.int32), ((0, bp - b), (0, 0)),
+                  constant_values=c)
+    s_list = jnp.sort(ids.reshape(nb, bq * probes), axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((nb, 1), bool), s_list[:, 1:] == s_list[:, :-1]], axis=1)
+    block_cells = jnp.where(dup, c, s_list).astype(jnp.int32)
+
+    # per-query membership lists, lane-padded with the dummy cell id
+    p_lanes = -(-probes // 128) * 128
+    probed = jnp.pad(ids, ((0, 0), (0, p_lanes - probes)), constant_values=c)
+
+    kernel = functools.partial(_ivf_kernel, k=k, bq=bq, cap=cap)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nb, bq * probes),            # cell-list axis innermost: the
+        in_specs=[                         # accumulator block is revisited
+            pl.BlockSpec((bq, dp), lambda i, s, cells: (i, 0)),
+            pl.BlockSpec((bq, p_lanes), lambda i, s, cells: (i, 0)),
+            # the gather: the probed cell's slab IS this step's input block
+            pl.BlockSpec((cap, dp), lambda i, s, cells: (cells[i, s], 0)),
+            pl.BlockSpec((1, cap), lambda i, s, cells: (0, cells[i, s])),
+            pl.BlockSpec((1, cap), lambda i, s, cells: (0, cells[i, s])),
+            pl.BlockSpec((1, cap), lambda i, s, cells: (0, cells[i, s])),
+        ],
+        out_specs=[
+            pl.BlockSpec((bq, _ACC_LANES), lambda i, s, cells: (i, 0)),
+            pl.BlockSpec((bq, _ACC_LANES), lambda i, s, cells: (i, 0)),
+        ],
+    )
+    out_s, out_i = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, _ACC_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bp, _ACC_LANES), jnp.int32),
+        ],
+        interpret=interpret,
+    )(block_cells, q, probed, e, r, v, sc)
+    return out_s[:b, :k], out_i[:b, :k]
+
+
+def _ivf_reference(queries, emb, valid, scales, assign, cell_ids, k,
+                   n_cells):
+    """jnp fallback: the exact scorer with non-probed cells masked out.
+
+    At `probes = n_cells` the mask is all-True and this IS
+    `_topk_reference` — bitwise the oracle by construction.
+    """
+    b, n = queries.shape[0], emb.shape[0]
+    probed = jnp.zeros((b, n_cells + 1), bool)
+    probed = probed.at[jnp.arange(b)[:, None], cell_ids].set(True)
+    row_probed = jnp.take_along_axis(
+        probed, jnp.broadcast_to(assign[None, :].astype(jnp.int32), (b, n)),
+        axis=1)
+    embf = emb.astype(jnp.float32)
+    scores = jax.lax.dot_general(queries.astype(jnp.float32), embf,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    if scales is not None:
+        scores = scores * scales[None, :].astype(jnp.float32)
+    scores = jnp.where((valid[None, :] > 0) & row_probed, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def ivf_topk(queries, emb, valid, k, *, cells, probes, scales=None,
+             impl=None, interpret=None, bq=None):
+    """Clustered top-k: probe `probes` cells per query, rescore exactly.
+
+    :param queries: [B, D] float32, unit-normalized upstream
+    :param emb: [N, D] flat slot corpus (fallback + degrade paths)
+    :param valid: [N] flat mask
+    :param k: static; output is ([B, k] f32 scores, [B, k] int32 ORIGINAL
+        slot row ids), descending score, finite entries tie-broken by
+        ascending index exactly like `lax.top_k`
+    :param cells: IVFCells layout built over the SAME slot arrays
+    :param probes: cells scanned per query; `probes = n_cells` is exact
+    :param scales: [N] f32 per-row dequant scales (int8 corpus), else None
+    :param impl: "pallas" | "jnp" | None (None: pallas on TPU, jnp elsewhere)
+    :param interpret: Pallas interpreter mode; None = not on TPU
+    :param bq: queries per kernel block (min f32 sublane tile by default)
+    """
+    k = int(k)
+    n = emb.shape[0]
+    if not 1 <= k <= n:
+        raise ValueError(f"k={k} outside [1, N={n}]")
+    n_cells, cap = cells.n_cells, cells.cell_cap
+    probes = int(min(max(int(probes), 1), n_cells))
+    if impl is None:
+        impl = "pallas" if _on_tpu() else "jnp"
+    if k > min(probes * cap, _ACC_LANES):
+        # the shortlist (or accumulator) cannot hold k candidates: degrade
+        # honestly to the exact scorer instead of returning a truncated list
+        return topk_fused(queries, emb, valid, k, scales=scales, impl=impl,
+                          interpret=interpret)
+    h = queries.astype(jnp.float32)
+    cent_valid = jnp.ones((n_cells,), jnp.float32)
+    _, cell_ids = topk_fused(h, cells.centroids, cent_valid, probes,
+                             impl=impl, interpret=interpret)
+    if impl == "jnp":
+        return _ivf_reference(h, emb, valid, scales, cells.assign, cell_ids,
+                              k, n_cells)
+    if interpret is None:
+        interpret = not _on_tpu()
+    if bq is None:
+        bq = DEFAULT_BQ
+    cell_scales = (cells.cell_scales if scales is not None else
+                   jnp.ones((cells.row_ids.shape[0],), jnp.float32))
+    return _ivf_pallas(h, cell_ids, cells.cell_emb, cells.cell_valid,
+                       cell_scales, cells.row_ids, k=k, cap=cap, bq=bq,
+                       interpret=interpret)

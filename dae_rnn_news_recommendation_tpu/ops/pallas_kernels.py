@@ -26,6 +26,22 @@
    Off-TPU it lowers to exactly that reference graph (serve keeps one code
    path; see docs/serving.md).
 
+4. `ivf_topk` (lives in ops/ivf_topk.py, registered here) — clustered
+   two-stage retrieval over the cell-major IVF layout (index/layout.py):
+   stage 1 reuses `topk_fused` with the k-means centroid table as its
+   "corpus" (the [B, n_cells] centroid scores never exist in HBM), stage 2
+   is a `PrefetchScalarGridSpec` kernel whose cell-panel BlockSpec index_map
+   reads the block's deduplicated probe list from a scalar-prefetch operand
+   — the gather IS the pipelined HBM->VMEM panel fetch, so neither a
+   [B, shortlist] score matrix nor a [B, shortlist, D] gather buffer ever
+   materializes. A per-query membership mask keeps candidate sets exact
+   despite the block-union scan; panel indices come from the layout's
+   row_ids, so results are directly comparable with the exact scorer.
+   Parity contract (tests/test_ivf.py): at probes = n_cells, bitwise scores
+   and tie-exact indices vs the exact scorer; k beyond the shortlist
+   degrades honestly to `topk_fused`. Off-TPU it lowers to the masked-matmul
+   fallback (non-probed cells scored -inf).
+
 STATUS: DISPATCHED AT LARGE BATCH / ON-TPU MASKING (promoted round 6 for the
 regimes the dense path cannot reach; small-batch mining stays on XLA). The
 round-3/5 measurements stand: on a real v5e-1 XLA wins dense-representable

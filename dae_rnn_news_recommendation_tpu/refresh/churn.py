@@ -20,7 +20,12 @@ One `ingest()` call is one refresh cycle:
      the corpus with the fresh params instead.
   4. Otherwise `ServingCorpus.swap_incremental` appends the rows (age-based
      eviction, tail health gate, version-monotonic promote, rollback on any
-     failure) — `refresh.swap` fires inside.
+     failure) — `refresh.swap` fires inside. On an IVF corpus the appended
+     rows route to their nearest EXISTING cells (no re-clustering on the
+     hot path); when the corpus's cell-imbalance staleness counter flips
+     `reindex_due`, the supervisor immediately runs `corpus.reindex()` — a
+     centroid refit over the resident rows riding the same health-gated
+     promote — and reports the cycle as `incremental+reindex`.
 
 Transient faults at ingest/encode are absorbed by a bounded RetryPolicy
 (recorded, never silent); fatal/preempt faults propagate to the caller — the
@@ -229,9 +234,19 @@ class ChurnSupervisor:
                     "error": led.get("error", "")}
         self._store.append(X)
         self._trim_store(led["n_evicted"])
-        return {"action": "incremental", "version": led["version"],
-                "n_added": led["n_added"], "n_evicted": led["n_evicted"],
-                "gate": led["gate"], "swap_s": led["duration_s"]}
+        out = {"action": "incremental", "version": led["version"],
+               "n_added": led["n_added"], "n_evicted": led["n_evicted"],
+               "gate": led["gate"], "swap_s": led["duration_s"]}
+        if getattr(self.corpus, "reindex_due", False):
+            # append-routing has skewed the cells past the imbalance ceiling
+            # for reindex_after consecutive swaps: refit the centroids now,
+            # through the same gate -> promote -> ledger path as any swap
+            self.corpus.reindex(note=f"churn-{cycle}-reindex")
+            led = self.corpus.ledger[-1]
+            out["action"] = ("incremental+reindex" if led["ok"]
+                             else "incremental+reindex_rollback")
+            out["reindex"] = {"ok": led["ok"], "version": led["version"]}
+        return out
 
     def _finetune_rebuild(self, X_new, reason):
         """The drift response: fine-tune the encoder from its newest

@@ -19,8 +19,8 @@ from .chaos_serve import (ServePlanResult, chaos_serve_soak, overload_trace,
                           run_serve_plan, serve_fault_plan)
 from .corpus import (CORPUS_DTYPES, CorpusSlot, ServingCorpus, SwapInProgress,
                      SwapRejected, dequantize_rows, quantize_corpus)
-from .graph import (block_indices, make_corpus_encode_fn, make_serve_fn,
-                    make_sharded_serve_fn)
+from .graph import (block_indices, make_corpus_encode_fn, make_ivf_serve_fn,
+                    make_serve_fn, make_sharded_serve_fn)
 from .service import RecommendationService, Reply, ReplyFuture
 
 __all__ = [
@@ -37,6 +37,7 @@ __all__ = [
     "chaos_serve_soak",
     "dequantize_rows",
     "make_corpus_encode_fn",
+    "make_ivf_serve_fn",
     "make_serve_fn",
     "make_sharded_serve_fn",
     "overload_trace",

@@ -24,6 +24,19 @@ promote a bad build — so the swap protocol here is:
 chaos-serve soak can prove the rollback path: an injected swap fault must
 leave the OLD corpus serving, version unchanged.
 
+With `retrieval="ivf"` every promoted slot additionally carries a cell-major
+clustered index (`slot.ivf`, an `index.IVFCells`): k-means centroids seeded
+from the slot's own drift-gate centroid partition the quantized rows into
+contiguous cells the fused IVF scorer (`ops/ivf_topk.py`) probes instead of
+scanning the whole corpus. The index composes with both swap flavors — a
+full swap REFITS the centroids; an incremental swap keeps them and routes
+every row (appended ones included) to its nearest existing cell, so churn
+never pays a re-clustering. Routing-only updates skew cell occupancy over
+time, so each incremental promote updates a staleness counter: `imbalance >
+imbalance_max` for `reindex_after` consecutive incremental swaps marks
+`reindex_due`, and `reindex()` refits the centroids on the active slot's
+rows, riding the same health-gate -> promote -> ledger path as any swap.
+
 Corpus churn (refresh/) adds the INCREMENTAL variant of the same protocol:
 `swap_incremental` appends freshly-encoded articles to the active slot with
 age-based eviction instead of rebuilding the world, runs the identical health
@@ -103,10 +116,11 @@ class CorpusSlot:
     NEXT refresh batch against."""
 
     __slots__ = ("emb", "valid", "scales", "dtype", "n", "version", "note",
-                 "built_s", "ages", "stats")
+                 "built_s", "ages", "stats", "ivf")
 
     def __init__(self, emb, valid, n, version, note, built_s,
-                 scales=None, dtype="float32", ages=None, stats=None):
+                 scales=None, dtype="float32", ages=None, stats=None,
+                 ivf=None):
         self.emb = emb
         self.valid = valid
         self.scales = scales
@@ -117,6 +131,7 @@ class CorpusSlot:
         self.built_s = built_s
         self.ages = ages
         self.stats = stats or {}
+        self.ivf = ivf  # index.IVFCells when the corpus runs retrieval="ivf"
 
     def resident_bytes(self):
         """Device bytes held by the scoring matrix (embeddings + scales; the
@@ -135,6 +150,19 @@ class SwapInProgress(RuntimeError):
     never interleaved slot state) and owns the retry decision."""
 
 
+def _slot_is_sharded(slot):
+    """True when the slot's embedding table spans more than one device.
+
+    `swap_incremental` pulls the active slot to the host row-by-row and
+    rebuilds it single-device — on a mesh-sharded slot that silently
+    un-shards the corpus (and used to die later with an opaque placement
+    error). Until sharded append lands (ROADMAP item 1) the incremental
+    path refuses sharded slots explicitly."""
+    sharding = getattr(slot.emb, "sharding", None)
+    device_set = getattr(sharding, "device_set", None)
+    return bool(device_set) and len(device_set) > 1
+
+
 class ServingCorpus:
     """Double-buffered corpus: `active` serves while `swap()` builds, gates,
     and promotes (or rolls back). Thread-safe; the swap runs on the caller's
@@ -142,14 +170,26 @@ class ServingCorpus:
 
     def __init__(self, config, *, block=DEFAULT_BLOCK,
                  collapse_ceiling=COLLAPSE_CEILING, device_put=None,
-                 corpus_dtype="float32"):
+                 corpus_dtype="float32", retrieval="exact", n_cells=None,
+                 index_seed=0, index_iters=8, imbalance_max=4.0,
+                 reindex_after=3):
         if corpus_dtype not in CORPUS_DTYPES:
             raise ValueError(
                 f"corpus_dtype must be one of {CORPUS_DTYPES}: {corpus_dtype!r}")
+        if retrieval not in ("exact", "ivf"):
+            raise ValueError(
+                f"retrieval must be 'exact' or 'ivf': {retrieval!r}")
         self.config = config
         self.block = int(block)
         self.collapse_ceiling = float(collapse_ceiling)
         self.corpus_dtype = corpus_dtype
+        self.retrieval = retrieval
+        self.n_cells = None if n_cells is None else int(n_cells)
+        self.index_seed = int(index_seed)
+        self.index_iters = int(index_iters)
+        self.imbalance_max = float(imbalance_max)
+        self.reindex_after = int(reindex_after)
+        self._ivf_stale = 0  # consecutive imbalanced incremental promotes
         self._device_put = device_put
         self._encode_corpus = make_corpus_encode_fn(config)
         self._lock = threading.Lock()
@@ -179,6 +219,21 @@ class ServingCorpus:
         """True while a standby build is in flight — the service tags replies
         `stale_corpus` for the duration."""
         return self._refreshing.is_set()
+
+    @property
+    def ivf_stale_cycles(self):
+        """Consecutive incremental promotes whose cell imbalance exceeded
+        `imbalance_max` (routing-only updates skew occupancy over time)."""
+        with self._lock:
+            return self._ivf_stale
+
+    @property
+    def reindex_due(self):
+        """True when the staleness counter says the centroids should be
+        refit — the churn supervisor calls `reindex()` when it sees this."""
+        with self._lock:
+            return (self.retrieval == "ivf"
+                    and self._ivf_stale >= self.reindex_after)
 
     # ----------------------------------------------------------- swap side
     def swap(self, params, articles, note=""):
@@ -218,6 +273,9 @@ class ServingCorpus:
             if not gate["ok"]:
                 raise SwapRejected(
                     f"standby corpus failed the health gate: {gate}")
+            # full rebuild REFITS the centroids, seeded from the gate
+            # centroid the line above just stored on the slot
+            self._attach_index(standby, refit=True, note=note)
         except Exception as exc:
             return self._rollback("full", note, exc, t0)
         finally:
@@ -287,6 +345,19 @@ class ServingCorpus:
         `serve.swap`); rollback semantics are identical to `swap`."""
         self._acquire_swap(note)
         try:
+            active = self.active
+            if active is not None and _slot_is_sharded(active):
+                # the rebuild below round-trips rows through the host and
+                # re-places single-device — on a sharded slot that is a
+                # silent topology change, not an append. Refuse loudly
+                # (no rollback record: nothing was attempted).
+                with self._lock:
+                    self.events.append({
+                        "event": "swap_rejected_sharded", "note": note,
+                        "active_version": self._version})
+                raise SwapRejected(
+                    "sharded slot: incremental append unsupported — use a "
+                    "full swap() until sharded append lands (ROADMAP item 1)")
             t0 = time.monotonic()
             self._refreshing.set()
             try:
@@ -307,6 +378,9 @@ class ServingCorpus:
                 if not gate["ok"]:
                     raise SwapRejected(
                         f"incremental standby failed the health gate: {gate}")
+                # keep the centroids: appended rows ROUTE to their nearest
+                # existing cell; no re-clustering on the churn path
+                self._attach_index(standby, refit=False, base=base, note=note)
             except Exception as exc:
                 return self._rollback("incremental", note, exc, t0)
             finally:
@@ -423,3 +497,95 @@ class ServingCorpus:
         return {"ok": ok, "finite": finite, "collapse": round(collapse, 6),
                 "ceiling": self.collapse_ceiling, "rows": rows,
                 "tail": bool(tail)}
+
+    # ------------------------------------------------------- clustered index
+    def _attach_index(self, slot, *, refit, note, base=None):
+        """Build the slot's cell-major IVF index (retrieval="ivf" only).
+
+        `refit=True` runs k-means from scratch, k-means++ seeded with the
+        drift-gate centroid `_health_gate` just stored on the slot.
+        `refit=False` keeps `base`'s centroids and only re-routes rows to
+        their nearest cell — the O(N * n_cells) append path — and advances
+        the imbalance staleness counter that eventually flips `reindex_due`.
+
+        Padding rows (valid=0) are assigned like real rows so the IVF
+        scorer sees the exact row population the flat scorer sees — the
+        bitwise-parity contract at probes = n_cells depends on it."""
+        if self.retrieval != "ivf":
+            return
+        from ..index import assign_cells, build_cells, cell_stats, kmeans_fit
+
+        n_cells = self.n_cells
+        if n_cells is None:  # sqrt(N): the classic IVF scan-balance point
+            n_cells = int(round(max(slot.n, 1) ** 0.5))
+        n_cells = max(1, min(int(n_cells), max(slot.n, 1)))
+        x = dequantize_rows(slot.emb, slot.scales, slot.emb.shape[0])
+        if refit or base is None or base.ivf is None:
+            refit = True
+            km = kmeans_fit(x, slot.valid, n_cells, seed=self.index_seed,
+                            n_iters=self.index_iters,
+                            init_centroid=slot.stats.get("centroid"))
+            centroids, assign = km.centroids, km.assign
+        else:
+            centroids = base.ivf.centroids
+            assign = assign_cells(x, centroids)
+        slot.ivf = build_cells(slot.emb, slot.valid, slot.scales,
+                               centroids, assign)
+        st = cell_stats(slot.ivf)
+        with self._lock:
+            if refit:
+                self._ivf_stale = 0
+            elif st["imbalance"] > self.imbalance_max:
+                self._ivf_stale += 1
+            else:
+                self._ivf_stale = 0
+            self.events.append({
+                "event": "ivf_index", "refit": bool(refit), "note": note,
+                "n_cells": st["n_cells"], "cell_cap": st["cell_cap"],
+                "imbalance": round(st["imbalance"], 4),
+                "frac_empty": round(st["frac_empty"], 4),
+                "stale_cycles": self._ivf_stale})
+
+    def reindex(self, note=""):
+        """Refit the IVF centroids on the ACTIVE slot's rows and promote the
+        re-indexed slot through the standard gate -> promote -> ledger path
+        (kind="reindex"). The embedding rows are SHARED with the active slot
+        — only the clustering is rebuilt — so the gate re-judges the exact
+        bytes already serving. Resets the staleness counter.
+
+        This is the background rebuild the churn supervisor schedules when
+        `reindex_due` flips: append-routing keeps serving fresh rows cheaply
+        while occupancy slowly skews, and this call re-balances the cells
+        without re-encoding or re-quantizing anything."""
+        if self.retrieval != "ivf":
+            raise SwapRejected("reindex() requires retrieval='ivf'")
+        self._acquire_swap(note)
+        try:
+            t0 = time.monotonic()
+            self._refreshing.set()
+            try:
+                with self._lock:
+                    base = self._active
+                if base is None:
+                    raise SwapRejected(
+                        "reindex needs an active slot (swap first)")
+                standby = CorpusSlot(
+                    emb=base.emb, valid=base.valid, n=base.n, version=-1,
+                    note=note, built_s=time.monotonic(), scales=base.scales,
+                    dtype=base.dtype,
+                    ages=None if base.ages is None else base.ages.copy())
+                with telemetry.span("serve/corpus_reindex", fence=False,
+                                    args={"note": note}):
+                    gate = self._health_gate(standby)
+                    if not gate["ok"]:
+                        raise SwapRejected(
+                            f"reindex standby failed the health gate: {gate}")
+                    self._attach_index(standby, refit=True, note=note)
+            except Exception as exc:
+                return self._rollback("reindex", note, exc, t0)
+            finally:
+                self._refreshing.clear()
+            return self._promote(standby, gate, "reindex", note, t0,
+                                 n_added=0, n_evicted=0)
+        finally:
+            self._swap_busy.release()

@@ -24,6 +24,13 @@ training side already engineered around (bench.py:_hard_sync measures
     the degraded top-k-truncation mode is just a dispatch to the smaller-k
     variant, not a recompile under overload.
 
+  * `make_ivf_serve_fn` — the clustered two-stage variant: encode the query
+    batch, then `ops.ivf_topk` probes the corpus's cell-major IVF index
+    (`slot.ivf`) instead of scanning every row — centroid scan, top-`probes`
+    cell shortlist, fused gather + exact rescore. `k` AND `probes` are baked
+    into the compiled program, so the service precompiles one variant per
+    (bucket, k, probes) and probing depth never recompiles at request time.
+
   * `make_sharded_serve_fn` — the same fused scorer over a row-sharded corpus:
     each device holds N/n_dev rows (place them with `parallel.mesh.shard_rows`,
     e.g. via `ServingCorpus(device_put=...)`), computes its local top-k with
@@ -116,6 +123,33 @@ def make_serve_fn(config, k, *, fused=True):
 
     name = f"serve/topk{k}" + ("" if fused else "_unfused")
     return telemetry.instrument(jax.jit(run), name)
+
+
+def make_ivf_serve_fn(config, k, probes):
+    """Jitted clustered microbatch answer: (params, emb [N_pad, D], valid,
+    scales, cells, queries [B, F]) -> (scores [B, k], indices [B, k]).
+
+    Same contract as `make_serve_fn` with one extra operand: `cells`, the
+    slot's `index.IVFCells` layout (a pytree — it traces like any array
+    argument, so a swapped slot with the same cell shapes dispatches the
+    already-compiled program). Scoring routes through `ops.ivf_topk`:
+    per-query cost is `n_cells` centroids plus `probes` cells' rows instead
+    of the whole corpus; `probes = n_cells` reproduces the exact scorer
+    bitwise. Indices are ORIGINAL slot row numbers, directly comparable
+    with `make_serve_fn` output."""
+    k = int(k)
+    probes = int(probes)
+    assert k >= 1 and probes >= 1
+
+    def run(params, emb, valid, scales, cells, queries):
+        h = l2_normalize(dae_core.encode(params, queries, config))
+        # trace-time import: pallas loads only when a fused graph is built
+        from ..ops.ivf_topk import ivf_topk
+
+        return ivf_topk(h, emb, valid, k, cells=cells, probes=probes,
+                        scales=scales)
+
+    return telemetry.instrument(jax.jit(run), f"serve/ivf_topk{k}_p{probes}")
 
 
 def make_sharded_serve_fn(config, k, mesh, axis_name="data"):

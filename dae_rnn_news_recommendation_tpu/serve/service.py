@@ -138,14 +138,27 @@ class RecommendationService:
         — same mesh, N_pad divisible by it, shard rows >= top_k.
     :param mesh: the 1-D mesh for `sharded=True` (default: all devices via
         `parallel.mesh.get_mesh()`).
+    :param retrieval: "exact" (scan every corpus row) or "ivf" (probe the
+        slot's clustered index via `make_ivf_serve_fn`; the corpus must be
+        built with `retrieval="ivf"` so every promoted slot carries one).
+        Mutually exclusive with `sharded` until sharded IVF lands.
+    :param probes: cells scanned per query under `retrieval="ivf"` — baked
+        into the compiled variants, so `warmup()` precompiles one program
+        per (bucket, k, probes) and probing depth never recompiles live.
     """
 
     def __init__(self, params, config, corpus, *, top_k=10,
                  degraded_top_k=None, max_batch=32, max_inflight=64,
                  flush_slack_s=0.02, linger_s=0.005, default_deadline_s=1.0,
                  overload_watermark=0.75, retry=None, fused=True,
-                 sharded=False, mesh=None):
+                 sharded=False, mesh=None, retrieval="exact", probes=8):
         assert int(top_k) >= 1 and int(max_batch) >= 1
+        if retrieval not in ("exact", "ivf"):
+            raise ValueError(
+                f"retrieval must be 'exact' or 'ivf': {retrieval!r}")
+        if retrieval == "ivf" and sharded:
+            raise ValueError("retrieval='ivf' does not compose with "
+                             "sharded=True yet (ROADMAP item 1)")
         self.params = params
         self.config = config
         self.corpus = corpus
@@ -165,12 +178,21 @@ class RecommendationService:
                                     floor=min(8, self.max_batch))
         self.fused = bool(fused)
         self.sharded = bool(sharded)
+        self.retrieval = retrieval
+        self.probes = int(probes)
+        assert self.probes >= 1
         if self.sharded:
             from ..parallel.mesh import get_mesh
             from .graph import make_sharded_serve_fn
             self.mesh = mesh if mesh is not None else get_mesh()
             self._serve_fns = {
                 k: make_sharded_serve_fn(config, k, self.mesh)
+                for k in {self.top_k, self.degraded_top_k}}
+        elif self.retrieval == "ivf":
+            from .graph import make_ivf_serve_fn
+            self.mesh = None
+            self._serve_fns = {
+                k: make_ivf_serve_fn(config, k, self.probes)
                 for k in {self.top_k, self.degraded_top_k}}
         else:
             self.mesh = None
@@ -277,6 +299,12 @@ class RecommendationService:
             for p in live:
                 self._error(p, "no_corpus")
             return
+        if self.retrieval == "ivf" and slot.ivf is None:
+            # explicit terminal, never a cryptic trace error: the corpus was
+            # not built with retrieval="ivf", so no slot carries an index
+            for p in live:
+                self._error(p, "no_ivf_index")
+            return
         tags = []
         if degraded:
             tags.append("coarse_batching")
@@ -300,8 +328,7 @@ class RecommendationService:
                                       "corpus_version": slot.version}) as sp:
                 def call():
                     _faults.fire("serve.batch", n=b)
-                    out = serve_fn(self.params, slot.emb, slot.valid,
-                                   slot.scales, batch)
+                    out = serve_fn(self.params, *self._slot_args(slot), batch)
                     jax.block_until_ready(out)
                     return out
 
@@ -380,28 +407,41 @@ class RecommendationService:
             status="error", reason=detail,
             latency_s=time.monotonic() - p.t_submit))
 
+    def _slot_args(self, slot):
+        """Positional slot operands for the compiled serve variants — the
+        IVF variants take the slot's cell index as one extra pytree operand."""
+        if self.retrieval == "ivf":
+            return (slot.emb, slot.valid, slot.scales, slot.ivf)
+        return (slot.emb, slot.valid, slot.scales)
+
     # ------------------------------------------------------------ lifecycle
     def warmup(self):
-        """Compile every (bucket, k) variant — primary AND degraded k — and
-        seed the device floor, so first requests measure dispatch, not
-        tracing. One-time, blocking. Compile counts are watched: the warmup
-        total lands in `summary()["compiles"]`, and a post-warmup watcher
-        stays live so the chaos soak can assert the degraded modes never
-        trigger a recompile (they dispatch to variants warmed here)."""
+        """Compile every (bucket, k) variant — primary AND degraded k, and
+        under `retrieval="ivf"` that means one program per (bucket, k,
+        probes) since probes is baked into each variant — and seed the
+        device floor, so first requests measure dispatch, not tracing.
+        One-time, blocking. Compile counts are watched: the warmup total
+        lands in `summary()["compiles"]`, and a post-warmup watcher stays
+        live so the chaos soak can assert the degraded modes never trigger
+        a recompile (they dispatch to variants warmed here)."""
         slot = self.corpus.active
         assert slot is not None, "swap a corpus in before warmup()"
+        if self.retrieval == "ivf":
+            assert slot.ivf is not None, (
+                "active slot carries no IVF index — build the ServingCorpus "
+                "with retrieval='ivf'")
         f = int(self.config.n_features)
         watcher = CompileWatcher().start()
         try:
             for k, fn in sorted(self._serve_fns.items()):
                 for b in self.buckets:
-                    out = fn(self.params, slot.emb, slot.valid, slot.scales,
+                    out = fn(self.params, *self._slot_args(slot),
                              np.zeros((b, f), np.float32))
                     jax.block_until_ready(out)
             # floor := fastest warm repeat of the smallest variant
             t0 = time.monotonic()
             out = self._serve_fns[self.top_k](
-                self.params, slot.emb, slot.valid, slot.scales,
+                self.params, *self._slot_args(slot),
                 np.zeros((self.buckets[0], f), np.float32))
             jax.block_until_ready(out)
             self._floor_s = time.monotonic() - t0
@@ -446,7 +486,8 @@ class RecommendationService:
                 "retries": list(self.retry.events),
                 "buckets": list(self.buckets), "top_k": self.top_k,
                 "degraded_top_k": self.degraded_top_k,
-                "sharded": self.sharded,
+                "sharded": self.sharded, "retrieval": self.retrieval,
+                "probes": (self.probes if self.retrieval == "ivf" else None),
                 "floor_ms": round(self._floor_s * 1e3, 3),
                 "compiles": {
                     "warmup": self._warmup_compiles,

@@ -299,6 +299,90 @@ def _check_figures(stage, names):
               " Delete evidence/.stage_cache.json and rerun to regenerate.")
 
 
+# ISSUE 11 satellite: the bench-trajectory regression gate. Named figures a
+# new round must not silently lose; all are higher-is-better (qps, articles/s,
+# speedup, recall). serve_ivf_* figures join dynamically once a record
+# carries them.
+BENCH_TRAJECTORY_METRICS = ("serve_queries_per_sec",
+                            "fit_pipelined_articles_per_sec",
+                            "train_articles_per_sec")
+BENCH_REGRESSION_TOLERANCE = 0.15  # >15% drop vs prior same-platform fails
+
+
+def _bench_history():
+    """Committed bench records, oldest first: every BENCH_r*.json `parsed`
+    record plus the TPU sidecar (evidence/bench_tpu.json) as the most recent
+    TPU entry. Records without a usable extra dict (e.g. r01 predates the
+    extra block) are skipped, never fatal — the gate reads history, it does
+    not demand one."""
+    import glob
+
+    hist = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except (OSError, ValueError):
+            continue
+        extra = parsed.get("extra") or {}
+        if extra:
+            hist.append((os.path.basename(path), extra))
+    try:
+        with open(os.path.join(HERE, "bench_tpu.json")) as f:
+            extra = json.load(f)["record"].get("extra") or {}
+        if extra:
+            hist.append(("evidence/bench_tpu.json", extra))
+    except (OSError, ValueError, KeyError):
+        pass
+    return hist
+
+
+def _bench_trajectory_gate():
+    """(ok, detail) for the regression check: the LATEST bench record must
+    hold every named metric within BENCH_REGRESSION_TOLERANCE of the most
+    recent PRIOR record from the SAME platform that carries it. CPU and TPU
+    rounds interleave in the history, so cross-platform ratios (~100x) are
+    never formed. Missing metrics or platforms pass with a note — the gate
+    fails only on a measured drop, never on absent history."""
+    hist = _bench_history()
+    if len(hist) < 2:
+        return True, (f"no comparable bench history ({len(hist)} usable "
+                      "record(s); need >= 2) — nothing to gate")
+    latest_name, latest = hist[-1]
+    platform = latest.get("platform")
+    metrics = list(BENCH_TRAJECTORY_METRICS) + sorted(
+        k for k in latest
+        if k.startswith("serve_ivf_") and isinstance(latest[k], (int, float)))
+    drops, compared, uncovered = [], [], []
+    for m in metrics:
+        now = latest.get(m)
+        if not isinstance(now, (int, float)):
+            uncovered.append(m)
+            continue
+        base = next((e[m] for _, e in reversed(hist[:-1])
+                     if e.get("platform") == platform
+                     and isinstance(e.get(m), (int, float)) and e[m] > 0),
+                    None)
+        if base is None:
+            uncovered.append(m)
+            continue
+        ratio = float(now) / float(base)
+        compared.append(f"{m} {ratio:.3f}x")
+        if ratio < 1.0 - BENCH_REGRESSION_TOLERANCE:
+            drops.append(f"{m} {now} vs prior {base} ({ratio:.3f}x)")
+    if drops:
+        return False, (f"{latest_name} ({platform}) regressed >"
+                       f"{BENCH_REGRESSION_TOLERANCE:.0%} vs prior "
+                       f"same-platform records: " + "; ".join(drops))
+    detail = (f"{latest_name} ({platform}) vs prior same-platform records: "
+              + (", ".join(compared) if compared
+                 else "no overlapping metrics"))
+    if uncovered:
+        detail += (" [no comparable history for: " + ", ".join(uncovered)
+                   + " — pass by absence, not by measurement]")
+    return True, detail
+
+
 def main(argv=None):
     t0 = time.time()
     argv = sys.argv[1:] if argv is None else argv
@@ -856,6 +940,13 @@ def main(argv=None):
               ("evidence/bench_tpu.json has no serve_int8_bytes_ratio — the "
                "sidecar predates the quantized-corpus corner; rerun bench.py "
                "on TPU to capture it"))
+    # ISSUE 11 satellite: bench-trajectory regression gate over the committed
+    # bench history. Gate only — it recomputes nothing; it reads the
+    # BENCH_r*.json trajectory (+ the TPU sidecar) and fails the evidence run
+    # if the latest record dropped a named figure >15% vs its own platform's
+    # prior records. Runs on every platform: the history is committed JSON.
+    traj_ok, traj_detail = _bench_trajectory_gate()
+    check("bench_trajectory_no_regression", traj_ok, traj_detail)
     check("user_category_top1", user["category_top1_accuracy"] > 0.6,
           f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.6 "
           "(chance ~1/8; scored against 5-candidate category means — one "

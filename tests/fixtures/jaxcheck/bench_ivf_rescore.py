@@ -1,0 +1,58 @@
+"""Planted R12 + R2 in the shapes the IVF retrieval path tempts you into.
+
+The clustered rescore (ops/ivf_topk.py) dots fp32 queries against int8 cell
+panels — drop `preferred_element_type` and the MXU accumulates the partial
+sums in the narrow dtype, which silently breaks the probes=n_cells bitwise
+parity the index is gated on (R12). And the bench corner's qps race is a
+timed region over enqueued dispatches — read the clock without fencing on
+the replies and the "speedup" measures dispatch exit, not compute (R2).
+Named bench_* so it falls inside R2's bench/evidence scope. The fenced /
+widened twins below must NOT be flagged.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def int8_cell_rescore_narrow(q, cell_panel):
+    panel8 = cell_panel.astype(jnp.int8)
+    dims = (((1,), (1,)), ((), ()))
+    return jax.lax.dot_general(q, panel8, dims)  # planted: R12
+
+
+def centroid_scan_bf16_narrow(h, centroids):
+    c16 = centroids.astype(jnp.bfloat16)
+    return h @ c16.T  # planted: R12
+
+
+def ivf_bench_phase_unfenced(ivf_fn, params, slot, queries):
+    t0 = time.perf_counter()
+    scores, idx = ivf_fn(params, slot.emb, slot.valid, slot.scales,
+                         slot.ivf, queries)
+    dt = time.perf_counter() - t0  # planted: R2
+    return scores, idx, dt
+
+
+# ---------------------------------------------------------------- clean twins
+
+def int8_cell_rescore_widened(q, cell_panel):
+    # the ops/ivf_topk.py idiom: fp32 accumulation over the int8 panel
+    panel8 = cell_panel.astype(jnp.int8)
+    return jax.lax.dot_general(q, panel8, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def cell_panel_fp32_cast_is_not_low(q, cell_panel):
+    # widening cast: accumulation dtype == operand dtype == fp32, no hazard
+    return q @ cell_panel.astype(jnp.float32).T
+
+
+def ivf_bench_phase_fenced(ivf_fn, params, slot, queries):
+    t0 = time.perf_counter()
+    scores, idx = ivf_fn(params, slot.emb, slot.valid, slot.scales,
+                         slot.ivf, queries)
+    jax.device_get(idx)
+    dt = time.perf_counter() - t0
+    return scores, idx, dt

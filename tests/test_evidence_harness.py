@@ -114,3 +114,54 @@ def test_sweep_script_arg_lists_parse(evrun):
         flags = parse_flags(args[stage])
         assert flags.seed == 5
     assert parse_flags(args["triplet"], triplet_mode=True).seed == 5
+
+
+def test_bench_trajectory_gate_fails_on_same_platform_drop(evrun, monkeypatch):
+    """ISSUE 11 satellite: a >15% drop on a named metric vs the latest PRIOR
+    record of the SAME platform fails the gate; cross-platform ratios are
+    never formed (CPU and TPU rounds interleave in the committed history)."""
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [
+        ("r1", {"platform": "cpu", "train_articles_per_sec": 100.0}),
+        ("r2", {"platform": "tpu", "train_articles_per_sec": 9000.0}),
+        ("r3", {"platform": "cpu", "train_articles_per_sec": 80.0,
+                "serve_ivf_speedup": 2.0}),
+    ])
+    ok, detail = evrun._bench_trajectory_gate()
+    assert not ok and "train_articles_per_sec" in detail
+    # the drop is vs r1 (same platform), not the TPU r2
+    assert "100.0" in detail and "9000" not in detail
+
+
+def test_bench_trajectory_gate_tolerates_absent_history(evrun, monkeypatch):
+    """Missing metrics, a never-before-seen platform, or a thin history pass
+    with a note — the gate fails only on a MEASURED drop."""
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [("only", {})])
+    ok, detail = evrun._bench_trajectory_gate()
+    assert ok and "nothing to gate" in detail
+
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [
+        ("r1", {"platform": "cpu"}),
+        ("r2", {"platform": "tpu", "serve_queries_per_sec": 5.0}),
+    ])
+    ok, detail = evrun._bench_trajectory_gate()
+    assert ok and "pass by absence" in detail
+
+
+def test_bench_trajectory_gate_passes_within_tolerance(evrun, monkeypatch):
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [
+        ("r1", {"platform": "cpu", "serve_queries_per_sec": 100.0,
+                "serve_ivf_queries_per_sec": 50.0}),
+        ("r2", {"platform": "cpu", "serve_queries_per_sec": 90.0,
+                "serve_ivf_queries_per_sec": 55.0}),
+    ])
+    ok, detail = evrun._bench_trajectory_gate()
+    assert ok and "serve_ivf_queries_per_sec" in detail
+
+
+def test_bench_trajectory_gate_reads_committed_history(evrun):
+    """The real committed BENCH_r*.json trajectory must parse and pass —
+    if this fails, either a record is corrupt or a real regression landed."""
+    hist = evrun._bench_history()
+    assert len(hist) >= 2           # r02..r05 carry parsed extras
+    ok, detail = evrun._bench_trajectory_gate()
+    assert ok, detail

@@ -1,0 +1,450 @@
+"""Clustered two-stage retrieval contracts (ISSUE 11 tentpole).
+
+The IVF path's promise mirrors the fused scorer's: at `probes = n_cells`
+the clustered scorer IS the exact scorer — scores bitwise, indices
+tie-exact — for BOTH implementations (`impl="jnp"` off-TPU fallback and
+`impl="pallas", interpret=True` exercising the gather/mask/selection
+kernel on CPU). Below full probing the two implementations must still
+agree with each other wherever scores are finite. The adversarial corners:
+duplicate rows (3x score ties), hand-built empty cells, k exceeding the
+shortlist (pinned to the honest exact-degrade), an all-invalid corpus, and
+int8 quantized cells.
+
+On top: k-means fit/reseed/determinism, the cell-major layout permutation
+invariants, corpus/service wiring (`retrieval="ivf"`), churn composition
+(appends route into existing cells WITHOUT refitting; sustained imbalance
+trips a background reindex), and the ISSUE 11 satellite regression — a
+mesh-sharded slot must REFUSE `swap_incremental` with a clear
+`SwapRejected` instead of corrupting the shard layout.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu.index import (CAP_ROUND, assign_cells,
+                                                   build_cells, cell_stats,
+                                                   kmeans_fit)
+from dae_rnn_news_recommendation_tpu.models.dae_core import (DAEConfig,
+                                                             init_params)
+from dae_rnn_news_recommendation_tpu.ops.ivf_topk import ivf_topk
+from dae_rnn_news_recommendation_tpu.ops.topk_fused import _IDX_SENTINEL
+from dae_rnn_news_recommendation_tpu.parallel import get_mesh, shard_rows
+from dae_rnn_news_recommendation_tpu.refresh import (ChurnConfig,
+                                                     ChurnSupervisor)
+from dae_rnn_news_recommendation_tpu.serve import (RecommendationService,
+                                                   ServingCorpus,
+                                                   SwapRejected,
+                                                   dequantize_rows,
+                                                   make_serve_fn,
+                                                   quantize_corpus)
+
+# pallas-interpret runs the real kernel logic (scalar-prefetch gather,
+# membership mask, selection network) on CPU; jnp is the off-TPU path
+PALLAS = dict(impl="pallas", interpret=True)
+JNP = dict(impl="jnp")
+
+
+def _oracle(queries, emb, valid, k, scales=None):
+    """Exact masked-matmul + lax.top_k — no code shared with ops/."""
+    scores = jnp.asarray(queries, jnp.float32) @ jnp.asarray(
+        emb).astype(jnp.float32).T
+    if scales is not None:
+        scores = scores * jnp.asarray(scales, jnp.float32)[None, :]
+    scores = jnp.where(jnp.asarray(valid)[None, :] > 0, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+def _case(b=6, n=200, d=16, n_valid=None, seed=0):
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((b, d), dtype=np.float32)
+    e = rng.standard_normal((n, d), dtype=np.float32)
+    e /= np.linalg.norm(e, axis=1, keepdims=True)
+    valid = np.zeros(n, np.float32)
+    valid[:n if n_valid is None else n_valid] = 1.0
+    return q, e, valid
+
+
+def _fit_cells(e, valid, n_cells, scales=None, seed=0):
+    fit = kmeans_fit(jnp.asarray(e, jnp.float32) if scales is None
+                     else dequantize_rows(jnp.asarray(e),
+                                          jnp.asarray(scales), e.shape[0]),
+                     jnp.asarray(valid), n_cells, seed=seed)
+    return build_cells(jnp.asarray(e), jnp.asarray(valid), scales,
+                       fit.centroids, fit.assign)
+
+
+def _ivf(q, e, valid, k, cells, probes, scales=None, **kw):
+    return jax.device_get(ivf_topk(
+        jnp.asarray(q), jnp.asarray(e), jnp.asarray(valid), k, cells=cells,
+        probes=probes, scales=None if scales is None else jnp.asarray(scales),
+        **kw))
+
+
+# --------------------------------------------------------------- kmeans
+
+def test_kmeans_partitions_all_valid_rows():
+    _, e, valid = _case(n=120, d=12, n_valid=100, seed=1)
+    fit = kmeans_fit(jnp.asarray(e), jnp.asarray(valid), 7, seed=1)
+    assert fit.centroids.shape == (7, 12)
+    assert int(fit.counts.sum()) == 100          # every valid row owned once
+    np.testing.assert_allclose(np.linalg.norm(fit.centroids, axis=1), 1.0,
+                               rtol=1e-5)
+    assert np.isfinite(fit.inertia)
+
+
+def test_kmeans_is_deterministic_per_seed():
+    _, e, valid = _case(n=90, d=10, seed=2)
+    a = kmeans_fit(jnp.asarray(e), jnp.asarray(valid), 5, seed=4)
+    b = kmeans_fit(jnp.asarray(e), jnp.asarray(valid), 5, seed=4)
+    np.testing.assert_array_equal(a.centroids, b.centroids)
+    np.testing.assert_array_equal(a.assign, b.assign)
+    c = kmeans_fit(jnp.asarray(e), jnp.asarray(valid), 5, seed=5)
+    assert not np.array_equal(a.assign, c.assign)  # seed actually matters
+
+
+def test_kmeans_reseeds_rather_than_nan_on_degenerate_data():
+    # 3 distinct rows, 8 requested cells: most Lloyd cells go empty every
+    # iteration — the reseed step must keep every centroid finite/unit
+    base = np.random.default_rng(3).standard_normal((3, 8)).astype(np.float32)
+    e = np.tile(base, (10, 1))
+    e /= np.linalg.norm(e, axis=1, keepdims=True)
+    fit = kmeans_fit(jnp.asarray(e), jnp.ones(30, np.float32), 8, seed=0)
+    assert np.all(np.isfinite(fit.centroids))
+    np.testing.assert_allclose(np.linalg.norm(fit.centroids, axis=1), 1.0,
+                               rtol=1e-5)
+    assert int(fit.counts.sum()) == 30
+
+
+def test_kmeans_accepts_drift_gate_centroid_seed():
+    _, e, valid = _case(n=80, d=12, seed=6)
+    seed_vec = np.asarray(e[:40].mean(axis=0), np.float32)
+    a = kmeans_fit(jnp.asarray(e), jnp.asarray(valid), 4, seed=2,
+                   init_centroid=seed_vec)
+    b = kmeans_fit(jnp.asarray(e), jnp.asarray(valid), 4, seed=2,
+                   init_centroid=seed_vec)
+    np.testing.assert_array_equal(a.centroids, b.centroids)
+
+
+def test_assign_cells_is_nearest_centroid_by_cosine():
+    _, e, valid = _case(n=60, d=12, seed=7)
+    fit = kmeans_fit(jnp.asarray(e), jnp.asarray(valid), 5, seed=7)
+    got = assign_cells(jnp.asarray(e), fit.centroids)
+    want = np.argmax(np.asarray(e) @ np.asarray(fit.centroids).T, axis=1)
+    np.testing.assert_array_equal(got, want.astype(np.int32))
+
+
+# --------------------------------------------------------------- layout
+
+def test_build_cells_is_a_permutation_of_the_slot():
+    _, e, valid = _case(n=150, d=12, n_valid=140, seed=8)
+    fit = kmeans_fit(jnp.asarray(e), jnp.asarray(valid), 6, seed=8)
+    cells = build_cells(jnp.asarray(e), jnp.asarray(valid), None,
+                        fit.centroids, fit.assign)
+    assert cells.cell_cap % CAP_ROUND == 0
+    ids = np.asarray(cells.row_ids)
+    real = ids[ids != _IDX_SENTINEL]
+    # every original row (valid AND padding) placed exactly once
+    np.testing.assert_array_equal(np.sort(real), np.arange(150))
+    # a placed row's payload is the slot row, moved not recomputed
+    emb = np.asarray(cells.cell_emb)
+    np.testing.assert_array_equal(emb[ids != _IDX_SENTINEL],
+                                  np.asarray(e)[real])
+    # dummy cell (last slab) is all padding, and padding slots are invalid
+    cap = cells.cell_cap
+    assert np.all(ids[-cap:] == _IDX_SENTINEL)
+    np.testing.assert_array_equal(
+        np.asarray(cells.cell_valid)[ids == _IDX_SENTINEL], 0.0)
+
+
+def test_cell_stats_reports_occupancy():
+    _, e, valid = _case(n=100, d=10, seed=9)
+    fit = kmeans_fit(jnp.asarray(e), jnp.asarray(valid), 4, seed=9)
+    cells = build_cells(jnp.asarray(e), jnp.asarray(valid), None,
+                        fit.centroids, fit.assign)
+    st = cell_stats(cells)
+    assert st["n_cells"] == 4 and st["n_rows"] == 100
+    assert st["imbalance"] >= 1.0 and 0.0 <= st["frac_empty"] <= 1.0
+    assert int(st["counts"].sum()) == 100
+
+
+# ------------------------------------------------- kernel parity (tentpole)
+
+@pytest.mark.parametrize("impl_kw", [PALLAS, JNP],
+                         ids=["pallas-interpret", "jnp"])
+class TestFullProbeParity:
+    """probes = n_cells: the clustered scorer must BE the exact scorer."""
+
+    def test_bitwise_vs_oracle(self, impl_kw):
+        q, e, valid = _case(b=9, n=300, d=24, n_valid=290, seed=10)
+        cells = _fit_cells(e, valid, 7, seed=10)
+        s, i = _ivf(q, e, valid, 10, cells, probes=7, **impl_kw)
+        es, ei = jax.device_get(_oracle(q, e, valid, 10))
+        np.testing.assert_array_equal(s, np.asarray(es))  # bitwise
+        np.testing.assert_array_equal(i, np.asarray(ei))
+
+    def test_duplicate_rows_tie_break_by_ascending_index(self, impl_kw):
+        rng = np.random.default_rng(11)
+        q = rng.standard_normal((5, 12)).astype(np.float32)
+        base = rng.standard_normal((30, 12)).astype(np.float32)
+        e = np.concatenate([base, base, base])      # every score appears 3x
+        e /= np.linalg.norm(e, axis=1, keepdims=True)
+        valid = np.ones(90, np.float32)
+        cells = _fit_cells(e, valid, 5, seed=11)
+        s, i = _ivf(q, e, valid, 9, cells, probes=5, **impl_kw)
+        es, ei = jax.device_get(_oracle(q, e, valid, 9))
+        np.testing.assert_array_equal(s, np.asarray(es))
+        np.testing.assert_array_equal(i, np.asarray(ei))
+
+    def test_hand_built_empty_cells(self, impl_kw):
+        # an assign that never touches cells 2 and 5: probing them must be
+        # an inert panel scan, not garbage candidates
+        q, e, valid = _case(b=4, n=80, d=12, seed=12)
+        fit = kmeans_fit(jnp.asarray(e), jnp.asarray(valid), 6, seed=12)
+        assign = np.asarray(fit.assign).copy()
+        assign[assign == 2] = 1
+        assign[assign == 5] = 0
+        cells = build_cells(jnp.asarray(e), jnp.asarray(valid), None,
+                            fit.centroids, assign)
+        assert cell_stats(cells)["frac_empty"] >= 2 / 6
+        s, i = _ivf(q, e, valid, 8, cells, probes=6, **impl_kw)
+        es, ei = jax.device_get(_oracle(q, e, valid, 8))
+        np.testing.assert_array_equal(s, np.asarray(es))
+        np.testing.assert_array_equal(i, np.asarray(ei))
+
+    def test_all_rows_invalid(self, impl_kw):
+        q, e, valid = _case(b=4, n=96, d=12, seed=13)
+        valid[:] = 0.0
+        # fit on the geometry, but the LAYOUT carries the slot's real (all
+        # zero) valid mask — the kernel reads validity from cell_valid
+        fit = kmeans_fit(jnp.asarray(e), jnp.ones(96, np.float32), 4,
+                         seed=13)
+        cells = build_cells(jnp.asarray(e), jnp.asarray(valid), None,
+                            fit.centroids, fit.assign)
+        s, i = _ivf(q, e, valid, 6, cells, probes=4, **impl_kw)
+        assert np.all(np.isneginf(s))
+        # -inf ties break by ascending ORIGINAL row id, like lax.top_k
+        np.testing.assert_array_equal(i, np.tile(np.arange(6), (4, 1)))
+
+    def test_int8_cells(self, impl_kw):
+        q, e, valid = _case(b=6, n=200, d=16, seed=14)
+        eq, scales = quantize_corpus(jnp.asarray(e), "int8")
+        cells = _fit_cells(np.asarray(eq), valid, 5,
+                           scales=np.asarray(scales), seed=14)
+        assert np.asarray(cells.cell_emb).dtype == np.int8  # moved, not cast
+        s, i = _ivf(q, np.asarray(eq), valid, 7, cells, probes=5,
+                    scales=np.asarray(scales), **impl_kw)
+        es, ei = jax.device_get(_oracle(q, np.asarray(eq), valid, 7,
+                                        scales=np.asarray(scales)))
+        np.testing.assert_array_equal(s, np.asarray(es))
+        np.testing.assert_array_equal(i, np.asarray(ei))
+
+
+def test_partial_probe_impls_agree_and_recall_is_sane():
+    q, e, valid = _case(b=16, n=400, d=24, seed=15)
+    cells = _fit_cells(e, valid, 8, seed=15)
+    sp, ip = _ivf(q, e, valid, 10, cells, probes=3, **PALLAS)
+    sj, ij = _ivf(q, e, valid, 10, cells, probes=3, **JNP)
+    # identical candidate sets -> identical finite results; the -inf tail's
+    # indices are the one documented divergence (sentinel vs top_k filler)
+    finite = np.isfinite(sj)
+    np.testing.assert_array_equal(sp, sj)
+    np.testing.assert_array_equal(ip[finite], ij[finite])
+    _, ei = jax.device_get(_oracle(q, e, valid, 10))
+    recall = np.mean([len(set(a) & set(b)) / 10.0
+                      for a, b in zip(ij, np.asarray(ei))])
+    assert recall >= 0.5, f"recall@10 {recall:.2f} at 3/8 probes"
+
+
+def test_k_beyond_shortlist_degrades_to_exact():
+    # probes=1 -> shortlist of cell_cap rows < k: the call must return the
+    # EXACT answer over the flat slot, not a truncated shortlist
+    q, e, valid = _case(b=3, n=120, d=12, seed=16)
+    cells = _fit_cells(e, valid, 4, seed=16)
+    k = cells.cell_cap + 8
+    assert k <= 120                        # still a valid k for the corpus
+    s, i = _ivf(q, e, valid, k, cells, probes=1, **JNP)
+    es, ei = jax.device_get(_oracle(q, e, valid, k))
+    np.testing.assert_array_equal(s, np.asarray(es))
+    np.testing.assert_array_equal(i, np.asarray(ei))
+
+
+def test_k_bounds_are_validated():
+    q, e, valid = _case(b=2, n=64, d=8, seed=17)
+    cells = _fit_cells(e, valid, 2, seed=17)
+    for bad in (0, 65):
+        with pytest.raises(ValueError, match="outside"):
+            ivf_topk(jnp.asarray(q), jnp.asarray(e), jnp.asarray(valid),
+                     bad, cells=cells, probes=2)
+
+
+# ------------------------------------------------ corpus + service wiring
+
+N, F, D = 64, 24, 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = DAEConfig(n_features=F, n_components=D,
+                       triplet_strategy="none", corr_frac=0.0)
+    params = init_params(jax.random.PRNGKey(3), config)
+    articles = np.random.default_rng(3).random((N, F), dtype=np.float32)
+    return config, params, articles
+
+
+def _ivf_corpus(config, params, articles, **kw):
+    kw.setdefault("retrieval", "ivf")
+    kw.setdefault("n_cells", 6)
+    corpus = ServingCorpus(config, block=16, **kw)
+    corpus.swap(params, articles, note="initial")
+    return corpus
+
+
+def test_full_swap_attaches_a_refit_index(setup):
+    config, params, articles = setup
+    corpus = _ivf_corpus(config, params, articles, corpus_dtype="int8")
+    slot = corpus.active
+    assert slot.ivf is not None and slot.ivf.n_cells == 6
+    ev = [e for e in corpus.events if e["event"] == "ivf_index"]
+    assert ev and ev[-1]["refit"] is True
+    assert corpus.ivf_stale_cycles == 0 and not corpus.reindex_due
+
+
+def test_retrieval_knob_is_validated(setup):
+    config, _, _ = setup
+    with pytest.raises(ValueError, match="retrieval"):
+        ServingCorpus(config, retrieval="annoy")
+
+
+def test_service_full_probes_matches_exact_scorer(setup):
+    config, params, articles = setup
+    corpus = _ivf_corpus(config, params, articles, corpus_dtype="int8")
+    slot = corpus.active
+    svc = RecommendationService(params, config, corpus, top_k=5, max_batch=8,
+                                retrieval="ivf", probes=6)
+    svc.warmup()
+    try:
+        assert svc.summary()["retrieval"] == "ivf"
+        assert svc.summary()["probes"] == 6
+        exact = make_serve_fn(config, 5)
+        for row in (0, 11, 40):
+            reply = svc.submit(articles[row],
+                               deadline_s=10.0).result(timeout=10.0)
+            assert reply.ok
+            _, ei = jax.device_get(exact(params, slot.emb, slot.valid,
+                                         slot.scales, articles[row][None]))
+            np.testing.assert_array_equal(reply.indices, np.asarray(ei)[0])
+    finally:
+        svc.stop()
+
+
+def test_service_without_index_errors_cleanly(setup):
+    config, params, articles = setup
+    corpus = ServingCorpus(config, block=16)       # exact corpus: no slot.ivf
+    corpus.swap(params, articles, note="initial")
+    svc = RecommendationService(params, config, corpus, top_k=5, max_batch=8,
+                                retrieval="ivf", probes=4)
+    try:
+        reply = svc.submit(articles[0], deadline_s=10.0).result(timeout=10.0)
+        assert reply.status == "error" and "no_ivf_index" in reply.reason
+    finally:
+        svc.stop()
+
+
+def test_ivf_does_not_compose_with_sharded_yet(setup):
+    config, params, articles = setup
+    corpus = _ivf_corpus(config, params, articles)
+    with pytest.raises(ValueError, match="sharded"):
+        RecommendationService(params, config, corpus, retrieval="ivf",
+                              sharded=True)
+
+
+def test_reindex_requires_ivf_retrieval(setup):
+    config, params, articles = setup
+    corpus = ServingCorpus(config, block=16)
+    corpus.swap(params, articles, note="initial")
+    with pytest.raises(SwapRejected, match="ivf"):
+        corpus.reindex()
+
+
+# ----------------------------------------------------- churn composition
+
+def test_incremental_append_routes_without_refitting(setup):
+    config, params, articles = setup
+    corpus = _ivf_corpus(config, params, articles)
+    c0 = np.asarray(corpus.active.ivf.centroids).copy()
+    extra = np.random.default_rng(21).random((12, F), dtype=np.float32)
+    corpus.swap_incremental(params, extra, note="n1")
+    slot = corpus.active
+    assert slot.n == N + 12
+    # centroids untouched: routing-only update
+    np.testing.assert_array_equal(c0, np.asarray(slot.ivf.centroids))
+    # and every row (old AND appended) sits at its nearest centroid
+    x = dequantize_rows(slot.emb, slot.scales, slot.emb.shape[0])
+    np.testing.assert_array_equal(np.asarray(slot.ivf.assign),
+                                  assign_cells(x, slot.ivf.centroids))
+
+
+def test_sustained_imbalance_trips_a_supervised_reindex(setup):
+    config, params, articles = setup
+    # imbalance = max/mean >= 1 whenever rows exist, so imbalance_max=0.5
+    # makes every incremental promote "imbalanced" — a deterministic trip
+    corpus = ServingCorpus(config, block=16, retrieval="ivf", n_cells=4,
+                           imbalance_max=0.5, reindex_after=2)
+    sup = ChurnSupervisor(params, config, corpus,
+                          churn=ChurnConfig(microbatch=16))
+    sup.bootstrap(articles)
+    rng = np.random.default_rng(22)
+    r1 = sup.ingest(rng.random((8, F), dtype=np.float32), note="n1")
+    assert r1["action"] == "incremental" and corpus.ivf_stale_cycles == 1
+    c_before = np.asarray(corpus.active.ivf.centroids).copy()
+    r2 = sup.ingest(rng.random((8, F), dtype=np.float32), note="n2")
+    assert r2["action"] == "incremental+reindex" and r2["reindex"]["ok"]
+    led = corpus.ledger[-1]
+    assert led["kind"] == "reindex" and led["ok"]
+    # the rebuild REFIT the centroids and reset the staleness counter
+    assert corpus.ivf_stale_cycles == 0 and not corpus.reindex_due
+    assert not np.array_equal(c_before,
+                              np.asarray(corpus.active.ivf.centroids))
+    # reindex is a routing rebuild, not an ingest: corpus contents unchanged
+    assert corpus.active.n == N + 16
+
+
+def test_reindex_bumps_version_and_keeps_serving_exactly(setup):
+    config, params, articles = setup
+    corpus = _ivf_corpus(config, params, articles)
+    v0 = corpus.version
+    corpus.reindex(note="manual")
+    assert corpus.version == v0 + 1
+    slot = corpus.active
+    assert slot.ivf is not None
+    q = jnp.asarray(articles[:4])
+    fn = make_serve_fn(config, 5)
+    h_s, h_i = jax.device_get(fn(params, slot.emb, slot.valid, slot.scales,
+                                 q))
+    from dae_rnn_news_recommendation_tpu.serve import make_ivf_serve_fn
+    ivf_fn = make_ivf_serve_fn(config, 5, probes=slot.ivf.n_cells)
+    s, i = jax.device_get(ivf_fn(params, slot.emb, slot.valid, slot.scales,
+                                 slot.ivf, q))
+    np.testing.assert_array_equal(s, h_s)
+    np.testing.assert_array_equal(i, h_i)
+
+
+# --------------------------------- satellite: sharded slots refuse appends
+
+def test_sharded_slot_rejects_incremental_swap(setup):
+    config, params, articles = setup
+    mesh = get_mesh(4)
+    corpus = ServingCorpus(config, block=16,
+                           device_put=lambda x: shard_rows(x, mesh))
+    corpus.swap(params, articles, note="sharded")     # full swap is fine
+    assert corpus.version == 1
+    with pytest.raises(SwapRejected, match="sharded slot"):
+        corpus.swap_incremental(
+            params, np.random.default_rng(23).random((4, F),
+                                                     dtype=np.float32))
+    assert corpus.events[-1]["event"] == "swap_rejected_sharded"
+    # the active slot is untouched — still version 1, still serving
+    assert corpus.version == 1 and corpus.active.n == N
