@@ -193,12 +193,14 @@ SIZES = {
                 # batch_all mining dominates and hides the feed design
                 stream_rows=16000, stream_batch=800, stream_epochs=2,
                 serve_corpus=8192, serve_requests=512,
-                churn_corpus=8192, churn_batch=512, churn_cycles=8),
+                churn_corpus=8192, churn_batch=512, churn_cycles=8,
+                fleet_corpus=4096, fleet_requests=384, fleet_replicas=3),
     "cpu": dict(batch=2048, n_batches=6, warmup=1, prefetch=2,
                 train_batch=256, train_steps=6, train_warmup=1,
                 stream_rows=2048, stream_batch=512, stream_epochs=1,
                 serve_corpus=1024, serve_requests=128,
-                churn_corpus=1024, churn_batch=256, churn_cycles=4),
+                churn_corpus=1024, churn_batch=256, churn_cycles=4,
+                fleet_corpus=512, fleet_requests=96, fleet_replicas=3),
 }
 
 # Where the stream feed's H2D transfer is issued, per backend — a RECORDED
@@ -1224,6 +1226,141 @@ def _bench_churn(jax, params, config, sz):
     return out
 
 
+def _bench_fleet(jax, params, config, sz):
+    """Fleet figures (fleet/): Zipf session-replay through the p2c router
+    over data-parallel replicas, one of them a deterministic straggler —
+    which is what makes the hedged-vs-unhedged p99 delta a measured property
+    of the hedging discipline instead of scheduler noise. Records the hedged
+    headline (fleet_qps, fleet_p50/p95/p99_ms, fleet_shed_rate), the
+    no-hedge p99 on the SAME trace for the delta, and the p95 latency of
+    requests resolved while a staged canary->fleet rollout is actually in
+    flight (rollout_inflight_p95_ms — the cost of refreshing under fire)."""
+    import threading
+
+    import scipy.sparse as sp
+
+    from dae_rnn_news_recommendation_tpu.fleet import (FleetSupervisor,
+                                                       Router, ServiceReplica,
+                                                       make_session_trace,
+                                                       replay_trace)
+    from dae_rnn_news_recommendation_tpu.refresh import ChurnConfig
+
+    n_corpus = sz.get("fleet_corpus", 512)
+    n_requests = sz.get("fleet_requests", 96)
+    n_replicas = sz.get("fleet_replicas", 3)
+    # the straggler's fixed tail must DOMINATE the service's own latency
+    # (hundreds of ms on the CPU fallback at the 10k-feature shape), and the
+    # hedge delay must sit between the two — above normal replies, so only
+    # genuinely slow requests are duplicated; below the lag, so the hedge
+    # beats the straggler. 0.3-0.4s vs a 0.75s tail keeps that ordering on
+    # every platform this bench runs on.
+    lag_s = 0.75
+    hedge_floor_s, hedge_cap_s = 0.3, 0.4
+    sla_s = 5.0
+    articles = sp.random(n_corpus, F, density=0.005, format="csr",
+                         random_state=17, dtype=np.float32)
+    dense = np.asarray(articles.todense(), np.float32)
+    replicas = [
+        ServiceReplica(
+            f"r{i}", params, config,
+            lag_s=lag_s if i == n_replicas - 1 else 0.0,
+            top_k=10, max_batch=32, max_inflight=max(256, n_requests),
+            flush_slack_s=0.05, linger_s=0.001, default_deadline_s=sla_s)
+        for i in range(n_replicas)]
+    out = {}
+    try:
+        probe_router = Router(replicas, hedge=False, seed=17)
+        sup = FleetSupervisor(
+            params, config, replicas, probe_router,
+            churn=ChurnConfig(microbatch=64, drift_centroid_max=4.0,
+                              drift_collapse_max=4.0))
+        _phase(f"fleet: bootstrap {n_replicas} replica corpora + warmups")
+        sup.bootstrap(articles, note="bench")
+        for r in replicas:
+            r.warmup()
+        trace = make_session_trace(17, n_requests, n_corpus,
+                                   mean_gap_s=0.002, deadline_s=sla_s,
+                                   deadline_spread=0.0)
+
+        def replay(router, entries):
+            t0 = time.perf_counter()
+            pairs = replay_trace(router, dense, entries)
+            replies = [f.result(timeout=60.0) for _, f in pairs]
+            # jaxcheck: disable=R2 (each f.result() is a host-materialized reply — the replica's batch dispatch fences before resolving, so the wall includes compute)
+            wall = time.perf_counter() - t0
+            return replies, wall
+
+        _phase("fleet: unhedged Zipf replay (baseline p99)")
+        router = Router(replicas, hedge=False, default_deadline_s=sla_s,
+                        seed=17)
+        replies, _ = replay(router, trace)
+        lat = sorted(r.latency_s * 1e3 for r in replies if r.ok)
+        out["fleet_p99_ms_no_hedge"] = round(
+            float(np.percentile(lat, 99)), 3)
+        router.stop()
+
+        _phase("fleet: hedged Zipf replay (headline qps + percentiles)")
+        router = Router(replicas, hedge=True, default_deadline_s=sla_s,
+                        hedge_delay_floor_s=hedge_floor_s,
+                        hedge_delay_cap_s=hedge_cap_s, seed=17)
+        replies, wall = replay(router, trace)
+        counts = dict(router.counts)
+        stats = router.latency_stats()
+        out["fleet_qps"] = round(counts["replied"] / max(wall, 1e-9), 1)
+        out["fleet_p50_ms"] = stats["p50_ms"]
+        out["fleet_p95_ms"] = stats["p95_ms"]
+        out["fleet_p99_ms"] = stats["p99_ms"]
+        out["fleet_shed_rate"] = round(
+            counts["shed"] / max(counts["submitted"], 1), 6)
+        out["fleet_hedges"] = counts["hedges"]
+        out["fleet_hedge_wins"] = counts["hedge_wins"]
+        out["fleet_hedge_p99_improvement_ms"] = round(
+            out["fleet_p99_ms_no_hedge"] - (stats["p99_ms"] or 0.0), 3)
+        out["fleet_shape"] = (
+            f"{n_requests} Zipf reqs over {n_replicas} replicas "
+            f"(1 straggler +{lag_s * 1e3:.0f}ms), corpus {n_corpus}, {F}->{D}")
+
+        _phase("fleet: staged rollout under replay (inflight percentiles)")
+        fresh = sp.random(64, F, density=0.005, format="csr",
+                          random_state=18, dtype=np.float32)
+        window = {}
+
+        def do_rollout():
+            window["t0"] = time.monotonic()
+            window["report"] = sup.rollout(fresh, note="bench",
+                                           probe_query=dense[0])
+            window["t1"] = time.monotonic()
+
+        roll = threading.Thread(target=do_rollout)
+        half = len(trace) // 2
+        pairs = replay_trace(router, dense, trace[:half])
+        roll.start()
+        pairs += replay_trace(router, dense, trace[half:])
+        roll.join(timeout=120)
+        for _, f in pairs:
+            f.result(timeout=60.0)
+        assert window["report"]["ok"], window["report"]
+        inflight = [r["latency_s"] * 1e3 for r in router.records
+                    if r["status"] == "ok"
+                    and window["t0"] <= r["t_resolved"] <= window["t1"]]
+        # a rollout faster than the trace may overlap few requests; the
+        # overall replay p95 is the honest fallback, recorded as such
+        out["rollout_overlapped_requests"] = len(inflight)
+        out["rollout_inflight_p95_ms"] = round(float(np.percentile(
+            inflight if inflight
+            else [r["latency_s"] * 1e3 for r in router.records
+                  if r["status"] == "ok"], 95)), 3)
+        out["rollout_duration_ms"] = round(
+            (window["t1"] - window["t0"]) * 1e3, 1)
+        out["fleet_versions"] = {r.name: r.corpus.version for r in replicas}
+        router.stop()
+        probe_router.stop()
+    finally:
+        for r in replicas:
+            r.stop()
+    return out
+
+
 def child_main():
     _phase("child started; initializing backend")
     import jax
@@ -1442,6 +1579,11 @@ def child_main():
         extra.update(_bench_churn(jax, params, config, sz))
     except Exception as e:
         extra["churn_error"] = repr(e)[-300:]
+    try:
+        _phase("fleet: routed replicas qps + hedged tail + rollout window")
+        extra.update(_bench_fleet(jax, params, config, sz))
+    except Exception as e:
+        extra["fleet_error"] = repr(e)[-300:]
 
     unit_kind = "sparse-ingest stream"
     if platform == "tpu":

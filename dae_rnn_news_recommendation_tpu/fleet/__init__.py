@@ -1,0 +1,47 @@
+"""Replicated serving fleet: routing, hedging, and staged corpus rollout.
+
+One `RecommendationService` (serve/) answers queries with bounded admission,
+deadline-aware microbatching, and exactly-one-outcome futures. This package
+scales that contract OUT — N in-process replicas, each with a full
+data-parallel corpus copy — without weakening it:
+
+    replicas = [ServiceReplica(f"r{i}", params, config) for i in range(3)]
+    router = Router(replicas, ledger=OutcomeLedger())
+    sup = FleetSupervisor(params, config, replicas, router)
+    sup.bootstrap(articles)                  # every corpus at version 1
+    fut = router.submit(query, deadline_s=0.25)   # p2c route + hedge
+    sup.rollout(fresh_batch)                 # canary -> probe -> fleet
+
+  * `replica.ServiceReplica` — one service + corpus + DERIVED health
+    (warm/degraded/draining/dead from the microbatcher's own degraded-mode
+    records), honest kill(), and a deterministic-lag straggler knob.
+  * `router.Router` — least-outstanding power-of-two-choices dispatch,
+    ABSOLUTE-deadline propagation into every attempt, p95-derived hedged
+    requests with a bounded hedge budget, cross-replica retries; exactly one
+    outcome per request whatever the replicas do.
+  * `rollout.FleetSupervisor` — ONE ChurnSupervisor on the canary drives the
+    fleet-wide refresh: canary swap -> pinned serving probe -> staged
+    per-replica swap (live versions always within {v, v+1}), with whole-fleet
+    revert to the pre-canary version on any failure.
+  * `loadgen` — Zipf session-replay traces shared by the bench and the soak.
+  * `chaos_fleet` — seeded fault plans (fleet.route / fleet.hedge /
+    fleet.replica / refresh.swap / harness fleet.kill) replayed over a
+    mid-trace rollout, audited with reliability/ledger.py.
+
+Design notes and diagrams: docs/serving.md ("Serving fleet");
+fault-site table: docs/reliability.md.
+"""
+
+from .chaos_fleet import (FleetPlanResult, chaos_fleet_soak, fleet_fault_plan,
+                          run_fleet_plan)
+from .loadgen import make_session_trace, replay_trace
+from .replica import HEALTH_STATES, ServiceReplica
+from .rollout import FleetSupervisor
+from .router import Router
+
+__all__ = [
+    "HEALTH_STATES", "ServiceReplica", "Router", "FleetSupervisor",
+    "make_session_trace", "replay_trace",
+    "FleetPlanResult", "fleet_fault_plan", "run_fleet_plan",
+    "chaos_fleet_soak",
+]

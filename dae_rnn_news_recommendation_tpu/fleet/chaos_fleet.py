@@ -1,0 +1,291 @@
+"""Chaos soak for the serving fleet: Zipf replay x faults x a mid-rollout.
+
+Each seeded plan drives a 3-replica fleet (canary + peer + a deterministic
+straggler) through a Zipf session-replay trace with a staged corpus rollout
+fired MID-TRACE, one fault family injected per plan:
+
+    seed % 6   family
+    --------   -------------------------------------------------------------
+       0       fleet.kill     harness kills a non-canary replica exactly at
+                              its own fleet-rollout stage: its in-flight
+                              requests shed, the router re-enqueues them
+                              elsewhere, the rollout records it as skipped
+       1       refresh.swap   fatal at call 1 — the CANARY's swap dies, its
+                              corpus rolls itself back, the rollout aborts
+                              with the fleet untouched at the pre-canary
+                              version
+       2       refresh.swap   fatal at call 2 — canary promotes, the FIRST
+                              fleet-stage swap dies, and the supervisor
+                              reverts the whole fleet (canary included) to
+                              the pre-canary version
+       3       fleet.route    transient at admission — the router's own
+                              RetryPolicy absorbs it, no outcome impact
+       4       fleet.hedge    fatal at hedge issuance — the hedge is skipped
+                              and counted, the primary attempt untouched
+       5       fleet.replica  transient at replica admission — absorbed
+
+Fleet-wide invariants audited after every plan, whatever was injected:
+
+  * EXACTLY-ONE: every submitted request resolves exactly once, fleet-wide —
+    across hedges, retries, replica death, and rollback. The router records
+    into a shared OutcomeLedger; `ledger.audit()` catches lost requests and
+    double outcomes, `audit_outcome_counts` catches aggregate leaks.
+  * VERSION SKEW <= 2: the distinct corpus versions observed across all ok
+    replies stay within {v, v+1} — the staged one-replica-at-a-time rollout
+    keeps the fleet within one version of itself at all times.
+  * ROLLOUT HONESTY per family: family 1 leaves every corpus at the
+    pre-canary version with a rollback recorded; family 2 leaves every LIVE
+    corpus at the pre-canary version via explicit reverts; fault-free
+    families advance every live replica exactly one version.
+  * per-replica version ledgers replay clean under the shared
+    `audit_version_ledger` (reverts allowed — that is the rollback story).
+"""
+
+import dataclasses
+import time
+
+import numpy as np
+
+from ..models.dae_core import DAEConfig, init_params
+from ..refresh import ChurnConfig
+from ..reliability import faults as _faults
+from ..reliability.faults import FaultInjector, FaultPlan, FaultSpec
+from ..reliability.ledger import (OutcomeLedger, audit_outcome_counts,
+                                  audit_version_ledger)
+from ..reliability.retry import RetryPolicy
+from ..serve.corpus import ServingCorpus
+from .loadgen import make_session_trace, replay_trace
+from .replica import ServiceReplica
+from .rollout import FleetSupervisor
+from .router import Router
+
+_N_FEATURES = 24
+_N_COMPONENTS = 8
+_N_ARTICLES = 96
+_N_REPLICAS = 3
+_SLA_S = 5.0
+_STRAGGLER_LAG_S = 0.03
+_HARNESS_DEADLINE_S = 60.0
+
+
+@dataclasses.dataclass
+class FleetPlanResult:
+    seed: int
+    ok: bool
+    detail: str
+    family: int
+    n_submitted: int
+    n_replied: int
+    n_shed: int
+    n_errors: int
+    n_unresolved: int
+    n_hedges: int
+    n_hedge_wins: int
+    n_retries: int
+    p99_ms: float
+    versions_seen: list
+    rollout_ok: bool
+    rollout_stage: str
+    reverted: list
+    skipped: list
+    injected: list
+    duration_s: float
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def fleet_fault_plan(seed, n_requests):
+    """Seeded plan over the fleet fire-points, round-robin on the seed.
+    Family 0 is a HARNESS directive (fleet.kill has no in-code fire point:
+    the harness kills the replica and records it via injector.note)."""
+    rng = np.random.default_rng(seed)
+    families = (
+        lambda: (),   # fleet.kill: applied by run_fleet_plan's stage hook
+        lambda: (FaultSpec("refresh.swap", 1, "fatal",
+                           note="canary swap dies -> fleet untouched"),),
+        lambda: (FaultSpec("refresh.swap", 2, "fatal",
+                           note="fleet-stage swap dies -> fleet revert"),),
+        lambda: (FaultSpec("fleet.route",
+                           int(rng.integers(1, max(2, n_requests // 2))),
+                           "transient", note="route-selection blip"),),
+        lambda: (FaultSpec("fleet.hedge", 1, "fatal",
+                           note="hedge issuance dies -> hedge skipped"),),
+        lambda: (FaultSpec("fleet.replica",
+                           int(rng.integers(1, max(2, n_requests // 2))),
+                           "transient", note="replica admission blip"),),
+    )
+    return FaultPlan(seed=int(seed),
+                     specs=tuple(families[seed % len(families)]()))
+
+
+def _make_fleet(seed):
+    """3 tiny replicas sharing params/articles, each with its OWN corpus
+    (data-parallel full copies); the last is a deterministic straggler so
+    hedging has a tail to cut."""
+    config = DAEConfig(n_features=_N_FEATURES, n_components=_N_COMPONENTS,
+                       enc_act_func="tanh", triplet_strategy="none",
+                       corr_type="masking", corr_frac=0.0)
+    import jax
+
+    params = init_params(jax.random.PRNGKey(7 + seed), config)
+    rng = np.random.default_rng(2000 + seed)
+    articles = rng.random((_N_ARTICLES, _N_FEATURES), dtype=np.float32)
+    replicas = []
+    for i in range(_N_REPLICAS):
+        corpus = ServingCorpus(config, block=32)
+        replicas.append(ServiceReplica(
+            f"r{i}", params, config, corpus=corpus,
+            lag_s=_STRAGGLER_LAG_S if i == _N_REPLICAS - 1 else 0.0,
+            top_k=5, max_batch=8, max_inflight=16, flush_slack_s=0.02,
+            linger_s=0.002, default_deadline_s=_SLA_S,
+            retry=RetryPolicy(max_attempts=3, backoff_s=0.001,
+                              max_elapsed_s=0.5)))
+    return replicas, params, config, articles
+
+
+def run_fleet_plan(seed, n_requests=48, log=None):
+    """Execute one fault-plan x Zipf-trace x mid-trace-rollout run."""
+    t0 = time.monotonic()
+    family = seed % 6
+    replicas, params, config, articles = _make_fleet(seed)
+    ledger = OutcomeLedger()
+    router = Router(replicas, default_deadline_s=_SLA_S, seed=seed,
+                    hedge_delay_floor_s=0.002, hedge_delay_cap_s=0.05,
+                    ledger=ledger)
+    sup = FleetSupervisor(
+        params, config, replicas, router,
+        churn=ChurnConfig(microbatch=32, drift_centroid_max=1.0,
+                          drift_collapse_max=1.0))
+    plan = fleet_fault_plan(seed, n_requests)
+    injector = FaultInjector(plan)
+    rng = np.random.default_rng(3000 + seed)
+    victim = replicas[-1] if family == 0 else None
+
+    def stage_hook(stage):
+        # the mid-rollout crash: the victim dies exactly when the rollout
+        # reaches it, so the supervisor must skip it and the router must
+        # re-home its in-flight requests
+        if victim is not None and stage == f"fleet:{victim.name}":
+            injector.note("fleet.kill", "preempt", replica=victim.name)
+            victim.kill()
+
+    trace = make_session_trace(seed, n_requests, _N_ARTICLES,
+                               mean_gap_s=0.002, deadline_s=_SLA_S,
+                               deadline_spread=0.2)
+    half = len(trace) // 2
+    pre_versions = None
+    try:
+        sup.bootstrap(articles)
+        for r in replicas:
+            r.warmup()
+        with _faults.install(injector):
+            pre_versions = {r.name: r.corpus.version for r in replicas}
+            pairs = replay_trace(router, articles, trace[:half])
+            fresh = rng.random((32, _N_FEATURES), dtype=np.float32)
+            report = sup.rollout(fresh, note=f"plan-{seed}",
+                                 stage_hook=stage_hook,
+                                 probe_query=articles[0])
+            pairs += replay_trace(router, articles, trace[half:])
+            replies, unresolved = [], 0
+            harness_deadline = time.monotonic() + _HARNESS_DEADLINE_S
+            for _, f in pairs:
+                try:
+                    replies.append(f.result(
+                        timeout=max(0.0, harness_deadline - time.monotonic())))
+                except TimeoutError:
+                    unresolved += 1  # a lost request — fails the plan
+    finally:
+        router.stop()
+        for r in replicas:
+            r.stop()
+    summary = router.summary()
+    counts = summary["counts"]
+    problems = list(ledger.audit())
+    problems += audit_outcome_counts(
+        counts["submitted"], counts["replied"], counts["shed"],
+        counts["errors"], n_unresolved=unresolved)
+    if unresolved:
+        problems.append(f"{unresolved} futures never resolved")
+    # version-skew bound: ok replies may span at most TWO corpus versions —
+    # the staged rollout never lets the fleet diverge further
+    versions_seen = sorted({r["corpus_version"] for r in router.records
+                            if r["status"] == "ok"})
+    if len(versions_seen) > 2:
+        problems.append(f"version skew: ok replies spanned {versions_seen}")
+    problems += _audit_rollout(family, report, pre_versions, replicas, victim)
+    if not injector.fired:
+        problems.append("plan fired no faults (plan/trace mismatch)")
+    for r in replicas:
+        _, _, led_problems = audit_version_ledger(r.corpus.ledger,
+                                                  allow_revert=True)
+        problems += [f"{r.name}: {p}" for p in led_problems]
+    result = FleetPlanResult(
+        seed=int(seed), ok=not problems, detail="; ".join(problems) or "ok",
+        family=family, n_submitted=counts["submitted"],
+        n_replied=counts["replied"], n_shed=counts["shed"],
+        n_errors=counts["errors"], n_unresolved=unresolved,
+        n_hedges=counts["hedges"], n_hedge_wins=counts["hedge_wins"],
+        n_retries=counts["retries"],
+        p99_ms=summary["latency"]["p99_ms"] or 0.0,
+        versions_seen=[int(v) for v in versions_seen],
+        rollout_ok=bool(report["ok"]), rollout_stage=report["stage"],
+        reverted=list(report["reverted"]), skipped=list(report["skipped"]),
+        injected=list(injector.fired),
+        duration_s=round(time.monotonic() - t0, 2))
+    if log:
+        log(f"fleet plan {seed} (family {family}): "
+            f"{'OK' if result.ok else 'FAIL'} ({result.n_replied} ok / "
+            f"{result.n_shed} shed / {result.n_errors} err, "
+            f"{result.n_hedges} hedges, p99 {result.p99_ms} ms) "
+            f"{result.detail}")
+    return result
+
+
+def _audit_rollout(family, report, pre_versions, replicas, victim):
+    """Family-specific honesty checks on the rollout report and the fleet's
+    final corpus versions."""
+    problems = []
+    now = {r.name: r.corpus.version for r in replicas}
+    if family == 1:
+        if report["ok"]:
+            problems.append("canary swap fault did not abort the rollout")
+        if report.get("canary", {}).get("action") != "rollback":
+            problems.append("canary corpus did not record a rollback")
+        if now != pre_versions:
+            problems.append(f"fleet moved despite canary abort: "
+                            f"{pre_versions} -> {now}")
+    elif family == 2:
+        if report["ok"]:
+            problems.append("fleet-stage swap fault did not abort the rollout")
+        if not report["reverted"]:
+            problems.append("fleet-stage abort reverted nothing")
+        if now != pre_versions:
+            problems.append(f"fleet not restored to pre-canary versions: "
+                            f"{pre_versions} -> {now}")
+    else:
+        if not report["ok"]:
+            problems.append(f"fault-free rollout failed: {report['detail']}")
+        for r in replicas:
+            if victim is not None and r.name == victim.name:
+                if r.name not in report["skipped"]:
+                    problems.append(f"killed replica {r.name} not recorded "
+                                    "as skipped")
+                if now[r.name] != pre_versions[r.name]:
+                    problems.append(f"killed replica {r.name} advanced "
+                                    "anyway")
+            elif now[r.name] != pre_versions[r.name] + 1:
+                problems.append(
+                    f"{r.name} at v{now[r.name]}, expected "
+                    f"v{pre_versions[r.name] + 1} after a clean rollout")
+    return problems
+
+
+def chaos_fleet_soak(seeds=(0, 1, 2, 3, 4, 5), n_requests=48, log=None):
+    """Replay the seeded plans (any 6 consecutive seeds cover every fleet
+    fault family). Returns {"results", "all_ok", ...}."""
+    results = [run_fleet_plan(seed, n_requests=n_requests, log=log)
+               for seed in seeds]
+    n_ok = sum(1 for r in results if r.ok)
+    return {"results": results, "n_ok": n_ok, "n_plans": len(results),
+            "all_ok": n_ok == len(results)}

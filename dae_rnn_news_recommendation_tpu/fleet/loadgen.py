@@ -1,0 +1,94 @@
+"""Zipf session-replay load generation for the serving fleet.
+
+Real news traffic is not uniform: a handful of breaking stories soak up most
+of the reads, with a long tail of archival lookups. A load test that draws
+queries uniformly misses exactly the regime that stresses a fleet — hot-key
+concentration (every replica answering the same few articles) punctuated by
+cold-tail queries whose embeddings share nothing with the cache-warm ones.
+
+`make_session_trace` builds a deterministic trace of SESSIONS: each session
+is one simulated reader issuing a short burst of requests (a user skimming a
+story cluster), with
+
+  * article popularity ~ Zipf(a): request i reads article rank r with
+    P(r) ∝ r^-a over a seeded random rank permutation, so the hot set is
+    seeded, not positional;
+  * per-session bursts: session length geometric-ish (1..max_burst), gaps
+    WITHIN a session short, gaps BETWEEN sessions longer — arrivals are
+    bursty the way real readers are;
+  * per-request deadlines: a base SLA with a seeded spread, so some requests
+    are tight and shed-eligible under load.
+
+The trace is a plain list of dicts — `replay_trace` feeds it through a
+Router at (optionally time-compressed) recorded offsets and returns the
+futures in submit order; bench and the chaos soak share both halves so the
+traffic shape under measurement is the traffic shape under fault injection.
+"""
+
+import time
+
+import numpy as np
+
+
+def make_session_trace(seed, n_requests, n_articles, *, zipf_a=1.3,
+                       max_burst=6, mean_gap_s=0.004, deadline_s=5.0,
+                       deadline_spread=0.5):
+    """Deterministic Zipf session-replay trace.
+
+    :param seed: trace seed — same seed, same trace, bit for bit.
+    :param n_requests: total requests across all sessions.
+    :param n_articles: corpus size; article ids drawn in [0, n_articles).
+    :param zipf_a: Zipf exponent (>1); larger = more head-heavy.
+    :param max_burst: max requests per session.
+    :param mean_gap_s: mean inter-SESSION gap; intra-session gaps are ~10x
+        shorter.
+    :param deadline_s: base per-request deadline.
+    :param deadline_spread: fractional spread of deadlines around the base
+        (0.5 -> uniform in [0.5, 1.5] * deadline_s).
+    :returns: list of {"t": offset_s, "article": id, "session": s,
+        "deadline_s": d}, sorted by t.
+    """
+    rng = np.random.default_rng(seed)
+    # seeded rank->article permutation: the hot head is a random subset of
+    # the corpus, not "the first few rows"
+    perm = rng.permutation(n_articles)
+    ranks = rng.zipf(float(zipf_a), size=n_requests)
+    articles = perm[np.minimum(ranks - 1, n_articles - 1)]
+    lo = 1.0 - float(deadline_spread) / 2.0
+    deadlines = float(deadline_s) * rng.uniform(lo, lo + deadline_spread,
+                                                size=n_requests)
+    trace, t, i, session = [], 0.0, 0, 0
+    while i < n_requests:
+        burst = int(rng.integers(1, max_burst + 1))
+        for _ in range(min(burst, n_requests - i)):
+            trace.append({"t": round(t, 6), "article": int(articles[i]),
+                          "session": session,
+                          "deadline_s": float(deadlines[i])})
+            t += float(rng.exponential(mean_gap_s / 10.0))
+            i += 1
+        t += float(rng.exponential(mean_gap_s))
+        session += 1
+    return trace
+
+
+def replay_trace(router, articles, trace, *, speedup=1.0):
+    """Feed a trace through a Router at its recorded offsets.
+
+    :param router: fleet.Router (anything with submit(query, deadline_s=)).
+    :param articles: (N, F) article matrix the trace's ids index into.
+    :param trace: output of make_session_trace.
+    :param speedup: >1 compresses time (offsets divided by it); inf-like
+        values degenerate to as-fast-as-possible.
+    :returns: list of (entry, ReplyFuture) in submit order.
+    """
+    out = []
+    t0 = time.monotonic()
+    for entry in trace:
+        due = t0 + entry["t"] / float(speedup)
+        wait = due - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        fut = router.submit(articles[entry["article"]],
+                            deadline_s=entry["deadline_s"])
+        out.append((entry, fut))
+    return out

@@ -1,0 +1,193 @@
+"""One fleet member: a RecommendationService plus health, kill, and lag.
+
+A `ServiceReplica` owns one in-process `RecommendationService` and its own
+`ServingCorpus` — the fleet is data-parallel, every replica holds a full
+corpus copy, so any replica can answer any query and the router is free to
+hedge. The wrapper adds the three things a router needs that a bare service
+does not expose:
+
+  * HEALTH, derived rather than declared: `health()` folds the service's
+    recorded degraded-mode transitions (the microbatcher's degraded_enter/
+    degraded_exit events), batcher-thread liveness, and the replica's own
+    draining/dead flags into one of "warm" | "degraded" | "draining" |
+    "dead". The router routes to warm and degraded replicas (degraded is an
+    explicit, bounded service mode — see serve/service.py), drains around
+    draining ones, and skips dead ones.
+
+  * KILL that tells the truth: `kill()` marks the replica dead and stops the
+    service, which resolves every in-flight future as shed("shutdown") —
+    the honest crash simulation. The router sees those sheds and re-enqueues
+    the requests on a live replica with the ORIGINAL absolute deadline, so a
+    replica death mid-rollout costs latency, never an outcome.
+
+  * DETERMINISTIC LAG for benches and tests: `lag_s` delays every reply's
+    resolution by a fixed amount through a bounded delayer queue — a
+    reproducible straggler, which is what makes "hedging reduces p99" an
+    assertable fact instead of a scheduling accident.
+
+`fleet.replica` fires at admission: transient faults are absorbed by the
+service's own retry discipline downstream; a fatal is an explicit error
+reply; a preempt KILLS the replica (the whole point of a preemption) and
+sheds the request for the router to retry elsewhere.
+"""
+
+import queue
+import threading
+import time
+
+from ..reliability import faults as _faults
+from ..serve.corpus import ServingCorpus
+from ..serve.service import RecommendationService, Reply, ReplyFuture
+
+HEALTH_STATES = ("warm", "degraded", "draining", "dead")
+
+
+class ServiceReplica:
+    """One named replica: service + corpus + health + (optional) lag.
+
+    :param name: stable replica id (router ledger + rollout reports use it).
+    :param params: encoder params shared across the fleet.
+    :param config: the model's DAEConfig.
+    :param corpus: this replica's OWN ServingCorpus (data-parallel full
+        copy). Built here when None.
+    :param lag_s: fixed extra delay added to every reply's resolution — the
+        deterministic straggler knob (0 = none).
+    :param service_kw: forwarded to RecommendationService.
+    """
+
+    def __init__(self, name, params, config, *, corpus=None, lag_s=0.0,
+                 **service_kw):
+        self.name = str(name)
+        self.corpus = corpus if corpus is not None else ServingCorpus(config)
+        self.service = RecommendationService(params, config, self.corpus,
+                                             **service_kw)
+        self.lag_s = float(lag_s)
+        self._dead = threading.Event()
+        self._draining = threading.Event()
+        self._delayer = None
+        if self.lag_s > 0.0:
+            # bounded mailbox + timeout-polled gets: the delayer can never
+            # deadlock, and stop() drains whatever is still parked
+            self._delay_q = queue.Queue(maxsize=1024)
+            self._delayer = threading.Thread(
+                target=self._delay_loop, daemon=True,
+                name=f"replica-{self.name}-delayer")
+            self._delayer.start()
+
+    # ------------------------------------------------------------ admission
+    def submit(self, query, deadline_s=None, deadline_at=None):
+        """Admit one query; returns a ReplyFuture that always resolves.
+        The router passes `deadline_at` (absolute) so hedges and retries
+        spend the ORIGINAL budget, never a fresh one."""
+        if self._dead.is_set() or self._draining.is_set():
+            fut = ReplyFuture()
+            fut._set(Reply(status="shed",
+                           reason=("replica_dead" if self._dead.is_set()
+                                   else "replica_draining")))
+            return fut
+        try:
+            _faults.fire("fleet.replica", replica=self.name)
+        except _faults.SimulatedPreemption:
+            # a preemption takes the whole replica down; the request is shed
+            # and the router re-enqueues it on a live replica
+            self.kill()
+            fut = ReplyFuture()
+            fut._set(Reply(status="shed", reason="replica_preempted"))
+            return fut
+        except _faults.TransientFault:
+            pass  # admission blip: the replica still takes the request —
+            # the service's own enqueue/batch retry discipline is downstream
+        except _faults.InjectedFault as exc:
+            fut = ReplyFuture()
+            fut._set(Reply(status="error",
+                           reason=f"{type(exc).__name__}: {exc}"))
+            return fut
+        inner = self.service.submit(query, deadline_s=deadline_s,
+                                    deadline_at=deadline_at)
+        if self._delayer is None:
+            return inner
+        outer = ReplyFuture()
+        release_at = time.monotonic() + self.lag_s
+
+        def park(reply):
+            try:
+                self._delay_q.put_nowait((release_at, reply, outer))
+            except queue.Full:
+                outer._set(reply)  # mailbox full: lag is a simulation knob,
+                # never a reason to lose an outcome
+        inner.add_done_callback(park)
+        return outer
+
+    def _delay_loop(self):
+        while True:
+            try:
+                release_at, reply, outer = self._delay_q.get(timeout=0.05)
+            except queue.Empty:
+                if self._dead.is_set():
+                    return
+                continue
+            if not self._dead.is_set():
+                wait = release_at - time.monotonic()
+                if wait > 0:
+                    time.sleep(wait)
+            outer._set(reply)
+
+    # -------------------------------------------------------------- health
+    def health(self):
+        """Derived health, never a declared one: dead/draining flags first,
+        then batcher-thread liveness (a dead batcher means every queued
+        request would hang — that replica is dead no matter what it claims),
+        then the service's recorded degraded-mode state (the LAST
+        degraded_enter/exit transition — the same ledger the manifest
+        ships)."""
+        if self._dead.is_set():
+            return "dead"
+        if self._draining.is_set():
+            return "draining"
+        if not self.service._thread.is_alive():
+            return "dead"
+        with self.service._lock:
+            last = next((e["event"] for e in reversed(self.service.events)
+                         if e["event"] in ("degraded_enter", "degraded_exit")),
+                        None)
+        return "degraded" if last == "degraded_enter" else "warm"
+
+    @property
+    def routable(self):
+        return self.health() in ("warm", "degraded")
+
+    # ----------------------------------------------------------- lifecycle
+    def drain(self):
+        """Stop taking new requests; in-flight ones finish normally."""
+        self._draining.set()
+
+    def kill(self, timeout=5.0):
+        """The crash simulation: mark dead, stop the service (in-flight
+        futures resolve as shed("shutdown") — the service's drain-and-join
+        contract), and flush the lag mailbox so no outcome is parked
+        forever."""
+        if self._dead.is_set():
+            return
+        self._dead.set()
+        self.service.stop(timeout=timeout)
+        if self._delayer is not None:
+            self._delayer.join(timeout=timeout)
+            while True:
+                try:
+                    _, reply, outer = self._delay_q.get_nowait()
+                except queue.Empty:
+                    break
+                outer._set(reply)
+
+    def stop(self, timeout=5.0):
+        """Clean shutdown — same mechanics as kill(), different intent."""
+        self.kill(timeout=timeout)
+
+    # ----------------------------------------------------------- reporting
+    def warmup(self):
+        self.service.warmup()
+
+    def summary(self):
+        return {"name": self.name, "health": self.health(),
+                "lag_s": self.lag_s, "corpus_version": self.corpus.version,
+                "service": self.service.summary()}

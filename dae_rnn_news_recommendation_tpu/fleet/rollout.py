@@ -1,0 +1,213 @@
+"""Fleet-wide corpus rollout: canary -> health gate -> staged fleet swap.
+
+One `ChurnSupervisor` drives the whole fleet's refresh: it is bound to the
+CANARY replica's corpus, so every batch rides the full churn discipline
+(frozen-vocab vectorize, micro-batch encode, drift gate, incremental swap
+with tail health gate) on ONE replica before the fleet ever sees it. A batch
+the canary's gates refuse never leaves the canary — the corpus has already
+rolled itself back and the rollout aborts with the fleet untouched.
+
+The staged protocol, per `rollout(batch)`:
+
+  1. CANARY: `churn.ingest(batch)` on the canary corpus. Gate refusal or an
+     injected swap crash -> corpus-internal rollback -> rollout aborted,
+     fleet at the pre-canary version.
+  2. PROBE: one pinned request through the router must come back ok from
+     the canary's NEW version — the swap gate judges embeddings, the probe
+     judges the serving path end to end. A failed probe reverts the canary.
+  3. FLEET: the accepted rows are applied to every other live replica, ONE
+     AT A TIME — so at any instant the live corpus versions are a subset of
+     {v, v+1}: the version-skew bound the router's per-reply version records
+     let the chaos soak audit. Dead replicas are skipped (recorded, never
+     silently), draining ones too.
+  4. ROLLBACK: a failed fleet-stage swap calls `corpus.revert()` on every
+     replica already promoted — canary included — restoring the WHOLE fleet
+     to the pre-canary version. Reverts re-install a slot that already
+     passed its gate; the shared ledger audit accepts the version number
+     repeating only after such a revert record.
+
+`stage_hook(stage)` fires at each stage boundary ("canary", "probe",
+"fleet:<name>", "done"/"aborted") — the chaos harness uses it to kill a
+replica mid-rollout at a deterministic point.
+"""
+
+import time
+
+from ..refresh import ChurnConfig, ChurnSupervisor
+
+
+class FleetSupervisor:
+    """Owns the fleet's refresh story: one churn supervisor on the canary,
+    staged propagation to everyone else.
+
+    :param params: encoder params shared by the fleet.
+    :param config: the model's DAEConfig.
+    :param replicas: list of fleet.ServiceReplica; the FIRST is the canary.
+    :param router: the fleet.Router (for the canary probe).
+    :param churn: ChurnConfig for the canary's supervisor.
+    :param probe_deadline_s: budget for the canary probe request.
+    """
+
+    def __init__(self, params, config, replicas, router, *, churn=None,
+                 probe_deadline_s=5.0, **churn_kw):
+        assert replicas, "a rollout needs at least one replica"
+        self.params = params
+        self.config = config
+        self.replicas = list(replicas)
+        self.router = router
+        self.canary = replicas[0]
+        self.probe_deadline_s = float(probe_deadline_s)
+        self.churn = ChurnSupervisor(params, config, self.canary.corpus,
+                                     churn=churn or ChurnConfig(),
+                                     **churn_kw)
+        self.history = []   # one report per bootstrap/rollout
+
+    # ----------------------------------------------------------- bootstrap
+    def bootstrap(self, articles, note="bootstrap"):
+        """Seed EVERY replica's corpus with the same full build (all at
+        version 1); the canary's goes through the churn supervisor so its
+        host-side row mirror starts correct."""
+        self.churn.bootstrap(articles, note=note)
+        for r in self.replicas[1:]:
+            r.corpus.swap(self.params, articles, note=note)
+        report = {"action": "bootstrap",
+                  "versions": {r.name: r.corpus.version
+                               for r in self.replicas}}
+        self.history.append(report)
+        return report
+
+    # ------------------------------------------------------------- rollout
+    def rollout(self, batch, note="", stage_hook=None, probe_query=None):
+        """One staged fleet refresh of `batch`. Returns a report dict with
+        `ok`, `stage` reached, per-replica versions, and what (if anything)
+        was rolled back. Never raises on gate refusals or injected swap
+        faults — those are recorded aborts; programming errors still
+        surface."""
+        t0 = time.monotonic()
+        hook = stage_hook or (lambda stage: None)
+        pre = {r.name: r.corpus.version for r in self.replicas}
+        report = {"action": "rollout", "note": note, "pre_versions": dict(pre),
+                  "skipped": [], "reverted": [], "ok": False,
+                  "stage": "canary"}
+
+        def close(ok, detail):
+            report["ok"] = ok
+            report["detail"] = detail
+            report["versions"] = {r.name: r.corpus.version
+                                  for r in self.replicas}
+            report["duration_s"] = round(time.monotonic() - t0, 4)
+            hook("done" if ok else "aborted")
+            self.history.append(report)
+            return report
+
+        # 1. canary: full churn discipline on one replica
+        hook("canary")
+        try:
+            canary_rep = self.churn.ingest(batch, note=f"canary:{note}")
+        except Exception as exc:
+            # a fatal injected churn fault (ingest/encode) dies BEFORE any
+            # swap: nothing promoted, nothing to revert
+            return close(False, "canary ingest died: "
+                                f"{type(exc).__name__}: {exc}")
+        report["canary"] = {k: canary_rep.get(k)
+                            for k in ("action", "version", "drift")}
+        if canary_rep["action"] == "rollback":
+            # the corpus already rolled itself back; fleet untouched
+            return close(False, "canary swap rolled back: "
+                                + str(canary_rep.get("error", "")))
+        promoted = [self.canary]
+
+        # 2. probe: the serving path must answer from the new version
+        hook("probe")
+        probed = self._probe(probe_query)
+        report["probe"] = probed
+        if not probed["ok"]:
+            self._revert(promoted, report, note)
+            return close(False, "canary probe failed: " + probed["detail"])
+
+        # 3. fleet, one replica at a time: live versions stay in {v, v+1}
+        for r in self.replicas[1:]:
+            hook(f"fleet:{r.name}")
+            if r.health() == "dead":
+                report["skipped"].append(r.name)
+                continue
+            ok, detail = self._apply(r, batch, canary_rep, note)
+            if not ok:
+                self._revert(promoted, report, note)
+                report["stage"] = f"fleet:{r.name}"
+                return close(False, f"fleet swap failed on {r.name}: "
+                                    f"{detail} — fleet reverted to "
+                                    "pre-canary")
+            promoted.append(r)
+        report["stage"] = "fleet"
+        return close(True, "rolled out to "
+                           f"{len(promoted)}/{len(self.replicas)} replicas"
+                           + (f" (skipped dead: {report['skipped']})"
+                              if report["skipped"] else ""))
+
+    def _probe(self, probe_query):
+        if probe_query is None:
+            return {"ok": True, "detail": "no probe query configured",
+                    "version": self.canary.corpus.version}
+        fut = self.router.submit(probe_query,
+                                 deadline_s=self.probe_deadline_s,
+                                 pin=self.canary.name)
+        try:
+            reply = fut.result(timeout=self.probe_deadline_s * 2)
+        except TimeoutError:
+            return {"ok": False, "detail": "probe future never resolved",
+                    "version": self.canary.corpus.version}
+        return {"ok": reply.ok,
+                "detail": reply.reason or "ok",
+                "version": reply.corpus_version}
+
+    def _apply(self, replica, batch, canary_rep, note):
+        """Propagate the canary-accepted refresh to one replica. The canary
+        path may have been an incremental append OR a fine-tune-then-rebuild
+        (drift trip) — the fleet replica mirrors whichever the canary did,
+        with the canary's (possibly fine-tuned) params."""
+        params = self.churn.params
+        corpus = replica.corpus
+        before = corpus.version
+        try:
+            if "finetune" in canary_rep["action"]:
+                # the canary fine-tuned and FULL-rebuilt: mirror that with
+                # the fine-tuned params over the canary's resident rows
+                from ..refresh.churn import _stack
+                corpus.swap(params, _stack(self.churn._store),
+                            note=f"fleet:{note}")
+            else:
+                corpus.swap_incremental(
+                    params, batch, max_rows=self.churn.churn.max_rows,
+                    max_age_versions=self.churn.churn.max_age_versions,
+                    note=f"fleet:{note}")
+        except Exception as exc:
+            return False, f"{type(exc).__name__}: {exc}"
+        led = corpus.ledger[-1]
+        if not led["ok"] or corpus.version == before:
+            return False, led.get("error", "swap did not promote")
+        return True, f"v{corpus.version}"
+
+    def _revert(self, promoted, report, note):
+        """Restore every already-promoted replica (canary included) to its
+        pre-canary slot. Dead replicas can still revert — the corpus is
+        independent of the service — so a killed-then-promoted replica does
+        not strand a version."""
+        for r in reversed(promoted):
+            r.corpus.revert(note=f"rollout-abort:{note}")
+            report["reverted"].append(r.name)
+        if promoted and promoted[0] is self.canary:
+            # the canary's host row mirror advanced with the ingest; a
+            # revert means those rows are NOT resident — drop the last block
+            # so a later fine-tune-rebuild trains on what actually serves
+            if len(self.churn._store) > 1:
+                self.churn._store.pop()
+
+    # ----------------------------------------------------------- reporting
+    def summary(self):
+        return {"n_rollouts": sum(1 for h in self.history
+                                  if h.get("action") == "rollout"),
+                "versions": {r.name: r.corpus.version
+                             for r in self.replicas},
+                "canary": self.canary.name,
+                "churn": self.churn.summary()}

@@ -1,0 +1,398 @@
+"""Load-aware, deadline-propagating, hedging router over a replica fleet.
+
+The single-service story (serve/service.py) promised exactly-one-outcome per
+request; the router keeps that promise while ADDING the two things that can
+break it — hedged duplicates and cross-replica retries:
+
+  DISPATCH   least-outstanding-requests with power-of-two-choices: sample
+             two routable replicas, send to the one with fewer requests in
+             flight. P2C gets within a constant of ideal load balance with
+             O(1) state and, unlike pure least-loaded, never herds a
+             thundering burst onto one briefly-idle replica.
+
+  DEADLINES  the router propagates the request's ABSOLUTE deadline
+             (`deadline_at`) into every attempt, so a hedge or retry spends
+             the REMAINING budget, never a fresh full timeout — a
+             nearly-expired request is shed as provably unmeetable by the
+             replica's own floor check, and the hedge scheduler refuses to
+             hedge it at all (ISSUE 12 deadline-propagation fix).
+
+  HEDGING    after a hedge delay derived from the p95 of recently observed
+             reply latencies (clamped to [floor, cap]; "The Tail at Scale"
+             discipline: duplicate only the slowest few percent), the
+             request is re-issued to a second replica and the FIRST
+             completion wins. Exactly-one-outcome is enforced at the
+             request record: the first terminal decision resolves the
+             caller's future and writes the one ledger record; the loser's
+             completion is counted as `hedge_discarded`, never surfaced.
+             The hedge budget is bounded (`hedge_burst + hedge_budget_frac
+             * submitted`) so overload cannot amplify itself — past the
+             budget, hedges are suppressed and counted, never silent.
+
+  RETRIES    a shed that names a replica-local cause (shutdown, kill,
+             drain, full queue) or an error reply is re-enqueued on a
+             DIFFERENT replica, bounded by `max_retries` and the remaining
+             deadline budget. Terminal sheds (deadline_unmeetable,
+             deadline_expired_in_queue) are never retried — the deadline
+             math already proved them pointless.
+
+`fleet.route` fires at route selection (transients absorbed by the router's
+RetryPolicy, fatals are explicit error outcomes); `fleet.hedge` fires at
+hedge issuance — ANY injected fault there skips the hedge and records it,
+leaving the primary attempt untouched.
+"""
+
+import dataclasses
+import heapq
+import threading
+import time
+
+import numpy as np
+
+from ..reliability import faults as _faults
+from ..reliability.retry import RetryPolicy
+from ..serve.service import Reply, ReplyFuture
+
+_LATENCY_WINDOW = 512   # recent reply latencies kept for the hedge delay
+
+# shed reasons that name a replica-local cause — worth one try elsewhere.
+# Deadline sheds are terminal: the budget is spent no matter who serves.
+_RETRYABLE_SHEDS = frozenset((
+    "shutdown", "queue_full", "replica_dead", "replica_draining",
+    "replica_preempted"))
+
+
+class _FleetRequest:
+    """Router-side record of one caller request across all its attempts."""
+
+    __slots__ = ("id", "query", "deadline_at", "t_submit", "future", "lock",
+                 "inflight", "resolved", "retries", "hedged", "parked",
+                 "tried")
+
+    def __init__(self, req_id, query, deadline_at, t_submit):
+        self.id = req_id
+        self.query = query
+        self.deadline_at = deadline_at
+        self.t_submit = t_submit
+        self.future = ReplyFuture()
+        self.lock = threading.Lock()
+        self.inflight = 0
+        self.resolved = False
+        self.retries = 0
+        self.hedged = False
+        self.parked = None    # first not-ok (reply, replica) while another
+        #                       attempt is still in flight
+        self.tried = []       # replica names, attempt order
+
+
+class Router:
+    """Front door of the fleet: submit() returns a ReplyFuture that always
+    resolves with exactly one outcome, whatever the replicas do.
+
+    :param replicas: list of fleet.ServiceReplica (data-parallel copies).
+    :param default_deadline_s: applied when submit() gets no deadline.
+    :param hedge: enable hedged requests (off = pure p2c routing — the
+        bench's no-hedge baseline).
+    :param hedge_delay_floor_s / hedge_delay_cap_s: clamp on the p95-derived
+        hedge delay (floor also serves as the cold-start delay before any
+        latency history exists).
+    :param hedge_budget_frac: hedges allowed as a fraction of submitted
+        requests (plus `hedge_burst` flat) — the overload-amplification
+        bound.
+    :param max_retries: cross-replica re-enqueues per request.
+    :param retry: RetryPolicy absorbing transient fleet.route faults.
+    :param seed: p2c sampling seed (deterministic routing for replay).
+    :param ledger: optional reliability.ledger.OutcomeLedger the chaos soak
+        audits; the router records one submit and exactly one resolve per
+        request into it.
+    """
+
+    def __init__(self, replicas, *, default_deadline_s=1.0, hedge=True,
+                 hedge_delay_floor_s=0.005, hedge_delay_cap_s=0.25,
+                 hedge_budget_frac=0.1, hedge_burst=4, max_retries=2,
+                 retry=None, seed=0, ledger=None):
+        assert replicas, "a fleet needs at least one replica"
+        names = [r.name for r in replicas]
+        assert len(set(names)) == len(names), f"duplicate replica names: {names}"
+        self.replicas = list(replicas)
+        self.by_name = {r.name: r for r in replicas}
+        self.default_deadline_s = float(default_deadline_s)
+        self.hedge_enabled = bool(hedge)
+        self.hedge_delay_floor_s = float(hedge_delay_floor_s)
+        self.hedge_delay_cap_s = float(hedge_delay_cap_s)
+        self.hedge_budget_frac = float(hedge_budget_frac)
+        self.hedge_burst = int(hedge_burst)
+        self.max_retries = int(max_retries)
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, backoff_s=0.001, max_elapsed_s=0.25)
+        self.ledger = ledger
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()          # counts/latencies/records/rng
+        self._out_lock = threading.Lock()      # outstanding counters only —
+        # never held while acquiring another lock (ordering: req -> _lock/_out)
+        self._outstanding = {r.name: 0 for r in replicas}
+        self._latencies = []
+        self.records = []   # one terminal record per request, resolve order
+        self.counts = {"submitted": 0, "replied": 0, "shed": 0, "errors": 0,
+                       "routed": 0, "retries": 0, "hedges": 0,
+                       "hedge_wins": 0, "hedge_discarded": 0,
+                       "hedge_suppressed_budget": 0,
+                       "hedge_suppressed_unmeetable": 0,
+                       "hedge_suppressed_no_replica": 0, "hedge_faults": 0}
+        self._next_id = 0
+        self._stop_flag = False
+        self._cv = threading.Condition()
+        self._heap = []     # (fire_at, req_id, req) hedge schedule
+        self._hedge_thread = threading.Thread(
+            target=self._hedge_loop, daemon=True, name="fleet-hedger")
+        self._hedge_thread.start()
+
+    # ------------------------------------------------------------ admission
+    def submit(self, query, deadline_s=None, deadline_at=None, pin=None):
+        """Route one query. `deadline_at` (absolute monotonic) wins over
+        `deadline_s`; `pin` forces a specific replica by name (the rollout's
+        canary probe) and disables hedging/retry for that request."""
+        now = time.monotonic()
+        if deadline_at is None:
+            deadline_at = now + (self.default_deadline_s if deadline_s is None
+                                 else float(deadline_s))
+        with self._lock:
+            self._next_id += 1
+            req = _FleetRequest(self._next_id, query, float(deadline_at), now)
+            self.counts["submitted"] += 1
+        if self.ledger is not None:
+            self.ledger.submit(req.id, t_submit=now)
+        try:
+            self.retry.run(_faults.fire, "fleet.route", site="fleet.route")
+        except Exception as exc:
+            return self._resolve_direct(
+                req, Reply(status="error",
+                           reason=f"{type(exc).__name__}: {exc}"))
+        if pin is not None:
+            replica = self.by_name[pin]
+            if not replica.routable:
+                return self._resolve_direct(
+                    req, Reply(status="shed", reason="pinned_replica_down"))
+            self._dispatch(req, replica)
+            return req.future
+        replica = self._pick()
+        if replica is None:
+            return self._resolve_direct(
+                req, Reply(status="shed", reason="no_replica"))
+        self._dispatch(req, replica)
+        if self.hedge_enabled:
+            fire_at = now + self._hedge_delay()
+            with self._cv:
+                heapq.heappush(self._heap, (fire_at, req.id, req))
+                self._cv.notify()
+        return req.future
+
+    # -------------------------------------------------------------- routing
+    def _pick(self, exclude=()):
+        """P2C over routable replicas: sample two, take the one with fewer
+        outstanding requests. One candidate routes directly; none -> None."""
+        cands = [r for r in self.replicas
+                 if r.name not in exclude and r.routable]
+        if not cands:
+            return None
+        if len(cands) == 1:
+            return cands[0]
+        with self._lock:
+            i, j = self._rng.choice(len(cands), size=2, replace=False)
+        with self._out_lock:
+            oi = self._outstanding[cands[int(i)].name]
+            oj = self._outstanding[cands[int(j)].name]
+        return cands[int(i)] if oi <= oj else cands[int(j)]
+
+    def _dispatch(self, req, replica):
+        with req.lock:
+            if req.resolved:
+                return
+            req.inflight += 1
+            req.tried.append(replica.name)
+        with self._out_lock:
+            self._outstanding[replica.name] += 1
+        with self._lock:
+            self.counts["routed"] += 1
+        fut = replica.submit(req.query, deadline_at=req.deadline_at)
+        fut.add_done_callback(
+            lambda reply: self._on_attempt(req, replica, reply))
+
+    def _on_attempt(self, req, replica, reply):
+        """One attempt completed (batcher thread, or inline for synchronous
+        sheds). First terminal decision wins; late completions are counted
+        as discarded, never double-surfaced."""
+        with self._out_lock:
+            self._outstanding[replica.name] -= 1
+        redispatch = None
+        with req.lock:
+            req.inflight -= 1
+            if req.resolved:
+                with self._lock:
+                    self.counts["hedge_discarded"] += 1
+                return
+            if reply.ok:
+                self._resolve_locked(req, reply, replica.name)
+                return
+            retryable = (reply.status == "error"
+                         or reply.reason in _RETRYABLE_SHEDS)
+            if retryable and req.retries < self.max_retries:
+                remaining = req.deadline_at - time.monotonic()
+                cand = (self._pick(exclude=set(req.tried))
+                        if remaining > 0 else None)
+                if cand is not None:
+                    req.retries += 1
+                    redispatch = cand
+            if redispatch is None:
+                if req.inflight > 0:
+                    # another attempt is still out: park this outcome, the
+                    # race is still winnable
+                    if req.parked is None:
+                        req.parked = (reply, replica.name)
+                else:
+                    parked, name = req.parked or (reply, replica.name)
+                    self._resolve_locked(req, parked, name)
+        if redispatch is not None:
+            with self._lock:
+                self.counts["retries"] += 1
+            self._dispatch(req, redispatch)
+
+    # ------------------------------------------------------------- hedging
+    def _hedge_delay(self):
+        """p95 of recent observed reply latencies, clamped to [floor, cap];
+        floor alone before any history exists (cold start)."""
+        with self._lock:
+            lat = list(self._latencies)
+        if not lat:
+            return self.hedge_delay_floor_s
+        p95 = float(np.percentile(np.asarray(lat, np.float64), 95))
+        return min(max(p95, self.hedge_delay_floor_s), self.hedge_delay_cap_s)
+
+    def _hedge_loop(self):
+        """One scheduler thread for ALL hedges (never a timer per request):
+        pops due entries off the schedule heap under the condition variable,
+        issues hedges outside it. Every wait is bounded — a wedged replica
+        can stall its own batch, never this loop."""
+        while True:
+            with self._cv:
+                if self._stop_flag:
+                    return
+                now = time.monotonic()
+                due = []
+                while self._heap and self._heap[0][0] <= now:
+                    due.append(heapq.heappop(self._heap)[2])
+                if not due:
+                    wait = (0.05 if not self._heap
+                            else min(0.05, self._heap[0][0] - now))
+                    self._cv.wait(timeout=max(wait, 0.001))
+                    continue
+            for req in due:
+                self._maybe_hedge(req)
+
+    def _maybe_hedge(self, req):
+        if req.future.done():
+            return
+        remaining = req.deadline_at - time.monotonic()
+        floor = max((r.service._floor_s for r in self.replicas if r.routable),
+                    default=0.0)
+        if remaining <= 0.0 or (floor > 0.0 and remaining < floor):
+            # provably unmeetable on ANY replica: the primary attempt's own
+            # deadline math will shed it — duplicating it would burn a
+            # second slot on a lost cause
+            with self._lock:
+                self.counts["hedge_suppressed_unmeetable"] += 1
+            return
+        with self._lock:
+            budget = (self.hedge_burst
+                      + self.hedge_budget_frac * self.counts["submitted"])
+            if self.counts["hedges"] >= budget:
+                self.counts["hedge_suppressed_budget"] += 1
+                return
+        try:
+            _faults.fire("fleet.hedge", req=req.id)
+        except _faults.InjectedFault:
+            # any injected hedge fault skips the hedge and records it; the
+            # primary attempt is untouched and still owns the outcome
+            with self._lock:
+                self.counts["hedge_faults"] += 1
+            return
+        cand = self._pick(exclude=set(req.tried))
+        if cand is None:
+            with self._lock:
+                self.counts["hedge_suppressed_no_replica"] += 1
+            return
+        with req.lock:
+            if req.resolved:
+                return
+            req.hedged = True
+        with self._lock:
+            self.counts["hedges"] += 1
+        self._dispatch(req, cand)
+
+    # ------------------------------------------------------------ terminals
+    def _resolve_direct(self, req, reply):
+        with req.lock:
+            self._resolve_locked(req, reply, replica=None)
+        return req.future
+
+    def _resolve_locked(self, req, reply, replica):
+        """The one place a request becomes terminal. Caller holds req.lock."""
+        assert not req.resolved
+        req.resolved = True
+        now = time.monotonic()
+        final = dataclasses.replace(reply, latency_s=now - req.t_submit,
+                                    deadline_met=now <= req.deadline_at)
+        req.future._set(final)
+        rec = {"id": req.id, "status": final.status, "reason": final.reason,
+               "replica": replica, "corpus_version": final.corpus_version,
+               "hedged": req.hedged, "retries": req.retries,
+               "latency_s": round(final.latency_s, 6), "t_resolved": now}
+        with self._lock:
+            key = {"ok": "replied", "shed": "shed", "error": "errors"}
+            self.counts[key[final.status]] += 1
+            if (final.ok and req.hedged and req.tried
+                    and replica != req.tried[0]):
+                self.counts["hedge_wins"] += 1
+            if final.ok:
+                self._latencies.append(final.latency_s)
+                del self._latencies[:-_LATENCY_WINDOW]
+            self.records.append(rec)
+        if self.ledger is not None:
+            self.ledger.resolve(req.id, final.status, **{
+                k: v for k, v in rec.items() if k not in ("id", "status")})
+
+    # ----------------------------------------------------------- lifecycle
+    def stop(self, timeout=5.0):
+        """Stop the hedge scheduler (pending hedges are dropped — their
+        primary attempts still resolve through the replicas). Replica
+        shutdown belongs to the fleet owner, not the router."""
+        with self._cv:
+            self._stop_flag = True
+            self._heap.clear()
+            self._cv.notify()
+        self._hedge_thread.join(timeout=timeout)
+
+    # ----------------------------------------------------------- reporting
+    def latency_stats(self):
+        with self._lock:
+            lat = [r["latency_s"] for r in self.records
+                   if r["status"] == "ok"]
+        if not lat:
+            return {"n": 0, "p50_ms": None, "p95_ms": None, "p99_ms": None}
+        a = np.asarray(lat, np.float64) * 1e3
+        return {"n": int(a.size),
+                "p50_ms": round(float(np.percentile(a, 50)), 3),
+                "p95_ms": round(float(np.percentile(a, 95)), 3),
+                "p99_ms": round(float(np.percentile(a, 99)), 3),
+                "mean_ms": round(float(a.mean()), 3)}
+
+    def summary(self):
+        with self._lock:
+            counts = dict(self.counts)
+        with self._out_lock:
+            outstanding = dict(self._outstanding)
+        return {"counts": counts, "latency": self.latency_stats(),
+                "hedge_delay_s": round(self._hedge_delay(), 6),
+                "outstanding": outstanding,
+                "replicas": {r.name: r.health() for r in self.replicas},
+                "retries": list(self.retry.events)}

@@ -16,6 +16,8 @@ and train/pipeline.py (which the estimator itself imports) without a cycle.
 from .faults import (FaultInjector, FaultPlan, FaultSpec, InjectedFault,
                      SimulatedPreemption, TransientFault, active_injector,
                      fire, install)
+from .ledger import (OutcomeLedger, audit_outcome_counts,
+                     audit_version_ledger)
 from .retry import RetryPolicy, is_transient
 
 __all__ = [
@@ -23,10 +25,13 @@ __all__ = [
     "FaultPlan",
     "FaultSpec",
     "InjectedFault",
+    "OutcomeLedger",
     "RetryPolicy",
     "SimulatedPreemption",
     "TransientFault",
     "active_injector",
+    "audit_outcome_counts",
+    "audit_version_ledger",
     "fire",
     "install",
     "is_transient",

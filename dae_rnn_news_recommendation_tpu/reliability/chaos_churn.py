@@ -44,6 +44,7 @@ from . import faults as _faults
 from .chaos import (_completed_epochs, _drain_async, _params_allclose,
                     params_digest, soak_data)
 from .faults import FaultInjector, FaultPlan, FaultSpec, InjectedFault
+from .ledger import audit_version_ledger
 
 BASE_EPOCHS = 2    # fault-free base fit shared by ref/ and chaos/
 FT_EPOCHS = 1      # the closing fine-tune adds this many epochs
@@ -156,38 +157,6 @@ def _make_finetune_fn(make, tag, total_epochs):
     return finetune
 
 
-def _audit_ledger(ledger):
-    """Monotonicity + gate audit of a supervisor's corpus ledger. Returns
-    (ok_versions, rollbacks, problems)."""
-    problems = []
-    promoted = [rec for rec in ledger if rec["ok"]]
-    versions = [rec["version"] for rec in promoted]
-    if versions != list(range(1, len(versions) + 1)):
-        problems.append(f"versions not monotonic: {versions}")
-    for rec in promoted:
-        gate = rec.get("gate") or {}
-        if not gate.get("ok"):
-            problems.append(f"promoted v{rec['version']} without gate ok")
-    rollbacks = [rec for rec in ledger if not rec["ok"]]
-    for rec in rollbacks:
-        if rec.get("active_version") not in versions:
-            problems.append(
-                "rollback left no verified version serving "
-                f"(active was v{rec.get('active_version')})")
-        if "injected" in rec.get("error", ""):
-            # An injected swap crash must END in recovery: the harness replays
-            # the cycle, so a verified NEWER version must follow. A genuine
-            # health-gate refusal (e.g. a fine-tune that collapsed past the
-            # ceiling) is the gate doing its job — keeping the old verified
-            # version serving IS the correct terminal state.
-            newer = [v for v in versions if v > rec.get("active_version", 0)]
-            if not newer:
-                problems.append(
-                    "injected swap crash not followed by a verified newer "
-                    f"version (active was v{rec.get('active_version')})")
-    return versions, len(rollbacks), problems
-
-
 def _run_session(sup, data0, stream, *, supervised, deadline_at,
                  max_restarts=8):
     """Drive one supervisor session: bootstrap, ingest the stream, close
@@ -258,7 +227,7 @@ def run_churn_plan(plan, root, *, n_cycles=4, n_rows=48, n_features=24,
 
     ref = make_supervisor("ref")
     _run_session(ref, data0, stream, supervised=False, deadline_at=deadline_at)
-    ref_versions, _, ref_problems = _audit_ledger(ref.corpus.ledger)
+    ref_versions, _, ref_problems = audit_version_ledger(ref.corpus.ledger)
     ref_digest = params_digest(ref.params)
 
     injector = FaultInjector(plan)
@@ -268,7 +237,7 @@ def run_churn_plan(plan, root, *, n_cycles=4, n_rows=48, n_features=24,
             sup, data0, stream, supervised=True, deadline_at=deadline_at,
             max_restarts=max_restarts)
     duration = time.monotonic() - t0
-    versions, rollbacks, problems = _audit_ledger(sup.corpus.ledger)
+    versions, rollbacks, problems = audit_version_ledger(sup.corpus.ledger)
     problems += [f"ref: {p}" for p in ref_problems]
 
     if detail != "completed":

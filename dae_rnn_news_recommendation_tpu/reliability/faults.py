@@ -60,10 +60,15 @@ SITES = (
     "refresh.encode",   # refresh/churn.py, before each encode dispatch
     "refresh.swap",     # serve/corpus.py swap_incremental, before the append
     "refresh.finetune", # refresh/churn.py, before a warm-start fine-tune
+    "fleet.route",      # fleet/router.py submit, at route selection
+    "fleet.hedge",      # fleet/router.py, before issuing a hedge attempt
+    "fleet.replica",    # fleet/replica.py submit, at replica admission
 )
 
-# Post-crash directives consumed by the chaos harness, not fired in-line.
-HARNESS_SITES = ("ckpt.corrupt",)
+# Post-crash / mid-run directives consumed by the chaos harness, not fired
+# in-line: ckpt.corrupt truncates the newest checkpoint between runs;
+# fleet.kill marks a replica the fleet harness kills mid-rollout.
+HARNESS_SITES = ("ckpt.corrupt", "fleet.kill")
 
 KINDS = ("preempt", "fatal", "transient", "truncate")
 

@@ -1,0 +1,160 @@
+"""Shared ledger audits for the chaos harnesses.
+
+Every chaos soak in this repo ends in the same two questions, asked with
+slightly different bookkeeping until ISSUE 12 unified them here:
+
+  * EXACTLY-ONE-OUTCOME: did every submitted request end in exactly one
+    terminal decision (reply | shed | error)? A request with zero outcomes is
+    a silent drop / deadlock; a request with two is a double-count — both are
+    the failure modes a hedged router can smuggle in, which is why the fleet
+    soak audits per-request records (`OutcomeLedger`) rather than only the
+    aggregate counts the single-service soak could get away with
+    (`audit_outcome_counts`).
+
+  * VERSION LEDGER: did the serving corpus only ever promote health-gated,
+    version-monotonic builds, and did every rollback leave a verified version
+    serving (`audit_version_ledger`)? The fleet rollout adds one legal move
+    the churn soak never makes — an explicit `revert` that re-installs the
+    pre-canary slot — so the audit accepts a version number being re-promoted
+    AFTER an intervening revert record, and nothing else.
+
+`reliability/chaos_churn.py` and `serve/chaos_serve.py` call these instead of
+their former private copies; `fleet/chaos_fleet.py` was built on them from
+the start.
+"""
+
+import threading
+
+
+class OutcomeLedger:
+    """Per-request submission/outcome records with an exactly-one audit.
+
+    `submit(req_id)` registers a request; `resolve(req_id, status, **info)`
+    records its terminal decision. Nothing raises at record time — a chaos
+    run must capture the misbehavior, not die on it — so a double resolve or
+    an unknown-request resolve is kept as evidence and surfaced by `audit()`.
+    Thread-safe: router callbacks resolve from replica batcher threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._submitted = {}   # req_id -> submit info
+        self._outcomes = {}    # req_id -> [outcome record, ...]
+        self.records = []      # resolve records in arrival order
+
+    def submit(self, req_id, **info):
+        with self._lock:
+            self._submitted[req_id] = dict(info)
+
+    def resolve(self, req_id, status, **info):
+        rec = {"id": req_id, "status": status, **info}
+        with self._lock:
+            self._outcomes.setdefault(req_id, []).append(rec)
+            self.records.append(rec)
+        return rec
+
+    @property
+    def n_submitted(self):
+        with self._lock:
+            return len(self._submitted)
+
+    def counts(self):
+        """{status: n} over FIRST outcomes (duplicates are audit findings,
+        not traffic)."""
+        with self._lock:
+            out = {}
+            for recs in self._outcomes.values():
+                out[recs[0]["status"]] = out.get(recs[0]["status"], 0) + 1
+            return out
+
+    def audit(self):
+        """Problems list, empty when every submitted request has exactly one
+        outcome: lost requests (no outcome), double outcomes, and outcomes
+        for requests never submitted (a ghost reply is as bad as a lost
+        one)."""
+        with self._lock:
+            problems = []
+            for req_id in self._submitted:
+                recs = self._outcomes.get(req_id, [])
+                if not recs:
+                    problems.append(f"lost request {req_id!r}: submitted but "
+                                    "no outcome recorded")
+                elif len(recs) > 1:
+                    statuses = [r["status"] for r in recs]
+                    problems.append(f"double outcome for {req_id!r}: "
+                                    f"{statuses}")
+            for req_id in self._outcomes:
+                if req_id not in self._submitted:
+                    problems.append(f"outcome for unknown request {req_id!r} "
+                                    "(never submitted)")
+            return problems
+
+
+def audit_outcome_counts(n_submitted, n_ok, n_shed, n_errors, n_unresolved=0):
+    """The aggregate-count form of the exactly-one check (the single-service
+    soak's original bookkeeping): every submitted request must be accounted
+    for by exactly one terminal bucket. Returns a problems list."""
+    problems = []
+    if n_unresolved:
+        problems.append(f"{n_unresolved} futures never resolved")
+    total = n_ok + n_shed + n_errors + n_unresolved
+    if n_submitted != total:
+        problems.append(
+            f"outcome leak: submitted {n_submitted} != "
+            f"ok {n_ok} + shed {n_shed} + err {n_errors}"
+            + (f" + unresolved {n_unresolved}" if n_unresolved else ""))
+    return problems
+
+
+def audit_version_ledger(ledger, allow_revert=False):
+    """Monotonicity + gate audit of a ServingCorpus ledger. Returns
+    (promoted_versions, n_rollbacks, problems).
+
+    Promoted records must bump the active version by exactly +1 and carry a
+    passing health gate; every rollback must leave a verified version
+    serving; an INJECTED swap crash must eventually be followed by a newer
+    verified version (the harness replays the cycle — a genuine gate refusal
+    is the gate working and owes nothing further).
+
+    With `allow_revert` (the fleet rollout path), a record carrying
+    `revert: True` legally moves the active version BACK to a previously
+    verified one, and the next promote re-bumps from there — so a version
+    number may repeat, but only with an intervening revert. Without it
+    (the churn path), any revert record is itself a problem."""
+    problems = []
+    promoted = [rec for rec in ledger if rec["ok"] and not rec.get("revert")]
+    versions = [rec["version"] for rec in promoted]
+    verified = set(versions)
+    active = 0
+    for rec in ledger:
+        if rec.get("revert"):
+            if not allow_revert:
+                problems.append(
+                    f"unexpected revert record (to v{rec['version']}) in a "
+                    "ledger that never rolls out")
+            elif rec["version"] not in verified:
+                problems.append(
+                    f"revert to v{rec['version']}, a version never promoted")
+            active = rec["version"]
+        elif rec["ok"]:
+            if rec["version"] != active + 1:
+                problems.append(
+                    f"promote to v{rec['version']} from active v{active} "
+                    "(not +1: versions must be monotonic per serving line)")
+            gate = rec.get("gate") or {}
+            if not gate.get("ok"):
+                problems.append(
+                    f"promoted v{rec['version']} without gate ok")
+            active = rec["version"]
+    rollbacks = [rec for rec in ledger if not rec["ok"]]
+    for rec in rollbacks:
+        if rec.get("active_version") not in verified:
+            problems.append(
+                "rollback left no verified version serving "
+                f"(active was v{rec.get('active_version')})")
+        if "injected" in rec.get("error", "") and not allow_revert:
+            newer = [v for v in versions if v > rec.get("active_version", 0)]
+            if not newer:
+                problems.append(
+                    "injected swap crash not followed by a verified newer "
+                    f"version (active was v{rec.get('active_version')})")
+    return versions, len(rollbacks), problems

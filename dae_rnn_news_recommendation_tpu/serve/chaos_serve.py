@@ -38,6 +38,7 @@ from ..analysis.runtime import compile_guard
 from ..models.dae_core import DAEConfig, init_params
 from ..reliability import faults as _faults
 from ..reliability.faults import FaultInjector, FaultPlan, FaultSpec
+from ..reliability.ledger import audit_outcome_counts
 from ..reliability.retry import RetryPolicy
 from .corpus import ServingCorpus
 from .service import RecommendationService
@@ -206,13 +207,9 @@ def run_serve_plan(seed, n_requests=48, log=None):
     rolled_back = any(e["event"] == "swap_rollback" for e in corpus.events)
     promoted = corpus.version > version_before
     summary = service.summary()
-    problems = []
-    if unresolved:
-        problems.append(f"{unresolved} futures never resolved")
-    if summary["counts"]["submitted"] != n_ok + n_shed + n_err + unresolved:
-        problems.append(
-            f"outcome leak: submitted {summary['counts']['submitted']} != "
-            f"ok {n_ok} + shed {n_shed} + err {n_err}")
+    # exactly-one-outcome, via the shared audit (reliability/ledger.py)
+    problems = audit_outcome_counts(summary["counts"]["submitted"], n_ok,
+                                    n_shed, n_err, n_unresolved=unresolved)
     if plan.specs and not injector.fired:
         # the mandatory family is planned where it provably lands (batch
         # call 1 / an enqueue within the trace / the mid-plan swap)

@@ -195,6 +195,8 @@ class ServingCorpus:
         self._lock = threading.Lock()
         self._swap_busy = threading.Lock()  # serializes swap/swap_incremental
         self._active = None
+        self._previous = None  # the slot the last promote displaced — what
+        # revert() re-installs when a staged fleet rollout aborts mid-fleet
         self._version = 0
         self._refreshing = threading.Event()
         self.events = []  # swap / swap_rollback records, in order
@@ -287,6 +289,7 @@ class ServingCorpus:
         """The single atomic assignment both swap flavors funnel through:
         version bump + slot reference + event + ledger record, one lock."""
         with self._lock:
+            self._previous = self._active
             self._version += 1
             standby.version = self._version
             if standby.ages is None:  # full rebuild: every row is this vintage
@@ -322,6 +325,43 @@ class ServingCorpus:
         if fallback is None:
             raise exc  # nothing to roll back TO: the caller must know
         return fallback
+
+    def revert(self, note=""):
+        """Single-level undo of the last promote: re-install the slot the
+        promote displaced and move the active version BACK to that slot's
+        number. This is the fleet-rollback primitive (ISSUE 12): a staged
+        rollout that fails mid-fleet calls revert() on every replica it
+        already promoted, restoring the whole fleet to the pre-canary
+        version — at most two corpus versions are ever live, and a failed
+        stage collapses the fleet back to one.
+
+        The previous slot was itself health-gated when IT promoted, so no
+        re-gating happens here; the record lands in `events` as
+        `swap_revert` and in `ledger` with `revert: True` (the shared audit
+        accepts a version repeating only after such a record). One level
+        only: a second revert without an intervening promote raises
+        SwapRejected, and so does a revert before any second promote."""
+        self._acquire_swap(note)
+        try:
+            with self._lock:
+                prev, cur = self._previous, self._active
+                if prev is None:
+                    raise SwapRejected(
+                        "no previous slot to revert to (need a promote that "
+                        "displaced a serving slot)")
+                self._active = prev
+                self._version = prev.version
+                self._previous = None
+                self.events.append({
+                    "event": "swap_revert", "note": note,
+                    "from_version": cur.version, "version": prev.version})
+                self.ledger.append({
+                    "version": prev.version, "kind": "revert", "ok": True,
+                    "revert": True, "from_version": cur.version,
+                    "note": note})
+            return prev
+        finally:
+            self._swap_busy.release()
 
     def swap_incremental(self, params, new_articles, *, max_rows=None,
                          max_age_versions=None, note="", emb=None):

@@ -88,11 +88,13 @@ class _Pending:
 class ReplyFuture:
     """Per-request future: resolved exactly once with a Reply."""
 
-    __slots__ = ("_event", "_reply")
+    __slots__ = ("_event", "_reply", "_lock", "_callbacks")
 
     def __init__(self):
         self._event = threading.Event()
         self._reply = None
+        self._lock = threading.Lock()
+        self._callbacks = []
 
     def done(self):
         return self._event.is_set()
@@ -104,11 +106,27 @@ class ReplyFuture:
             raise TimeoutError("reply not ready")
         return self._reply
 
+    def add_done_callback(self, fn):
+        """Invoke `fn(reply)` when the future resolves — immediately if it
+        already has. Callbacks run on the resolving thread (the batcher, or
+        the submitter for synchronous sheds) and MUST NOT raise: an exception
+        propagates to that thread. This is what lets the fleet router track
+        completions without one waiter thread per in-flight request."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self._reply)
+
     def _set(self, reply):
-        if self._event.is_set():  # pragma: no cover - single-resolver design
-            return False
-        self._reply = reply
-        self._event.set()
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._reply = reply
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            fn(reply)
         return True
 
 
@@ -216,12 +234,22 @@ class RecommendationService:
         self._thread.start()
 
     # ------------------------------------------------------------ admission
-    def submit(self, query, deadline_s=None):
+    def submit(self, query, deadline_s=None, deadline_at=None):
         """Admit one query (dense [F] feature vector). Returns a ReplyFuture
-        that ALWAYS resolves — with a reply, an explicit shed, or an error."""
+        that ALWAYS resolves — with a reply, an explicit shed, or an error.
+
+        `deadline_at` is an ABSOLUTE `time.monotonic()` deadline and wins
+        over `deadline_s`: a hedged or retried re-enqueue passes the original
+        request's absolute deadline so the remaining budget SHRINKS with
+        elapsed time instead of resetting — a nearly-expired request is shed
+        as provably unmeetable here, never re-queued with a fresh full
+        timeout (ISSUE 12 deadline-propagation fix)."""
         now = time.monotonic()
-        deadline_s = (self.default_deadline_s if deadline_s is None
-                      else float(deadline_s))
+        if deadline_at is not None:
+            deadline_s = float(deadline_at) - now
+        else:
+            deadline_s = (self.default_deadline_s if deadline_s is None
+                          else float(deadline_s))
         p = _Pending(np.asarray(query, np.float32).reshape(-1),
                      now + deadline_s, now)
         with self._lock:
@@ -236,10 +264,10 @@ class RecommendationService:
         except Exception as exc:
             return self._error(p, f"{type(exc).__name__}: {exc}")
         floor = self._floor_s
-        if floor > 0.0 and deadline_s < floor:
-            # provably unmeetable: the device has never answered a batch
-            # faster than `floor` — shedding NOW costs the caller nothing
-            # and spares the queue
+        if deadline_s <= 0.0 or (floor > 0.0 and deadline_s < floor):
+            # provably unmeetable: the budget is already spent, or the device
+            # has never answered a batch faster than `floor` — shedding NOW
+            # costs the caller nothing and spares the queue
             return self._shed(p, "deadline_unmeetable")
         try:
             self._q.put_nowait(p)

@@ -300,12 +300,19 @@ def _check_figures(stage, names):
 
 
 # ISSUE 11 satellite: the bench-trajectory regression gate. Named figures a
-# new round must not silently lose; all are higher-is-better (qps, articles/s,
-# speedup, recall). serve_ivf_* figures join dynamically once a record
-# carries them.
+# new round must not silently lose; these are higher-is-better (qps,
+# articles/s, speedup, recall). serve_ivf_* figures join dynamically once a
+# record carries them.
 BENCH_TRAJECTORY_METRICS = ("serve_queries_per_sec",
                             "fit_pipelined_articles_per_sec",
-                            "train_articles_per_sec")
+                            "train_articles_per_sec",
+                            "fleet_qps")
+# ISSUE 12: fleet latency/shed figures gate in the OPPOSITE direction — a
+# p99 or shed-rate that GROWS >tolerance vs the prior same-platform record is
+# the regression. Zero-valued bases (e.g. a 0.0 shed rate) never form a
+# ratio: the base search below requires base > 0, so those pass by absence.
+BENCH_TRAJECTORY_LOWER_IS_BETTER = ("fleet_p99_ms", "fleet_shed_rate",
+                                    "rollout_inflight_p95_ms")
 BENCH_REGRESSION_TOLERANCE = 0.15  # >15% drop vs prior same-platform fails
 
 
@@ -353,8 +360,10 @@ def _bench_trajectory_gate():
     metrics = list(BENCH_TRAJECTORY_METRICS) + sorted(
         k for k in latest
         if k.startswith("serve_ivf_") and isinstance(latest[k], (int, float)))
+    metrics += list(BENCH_TRAJECTORY_LOWER_IS_BETTER)
     drops, compared, uncovered = [], [], []
     for m in metrics:
+        lower_is_better = m in BENCH_TRAJECTORY_LOWER_IS_BETTER
         now = latest.get(m)
         if not isinstance(now, (int, float)):
             uncovered.append(m)
@@ -366,10 +375,20 @@ def _bench_trajectory_gate():
         if base is None:
             uncovered.append(m)
             continue
-        ratio = float(now) / float(base)
+        # one orientation for the threshold: ratio > 1 is always "better",
+        # so a lower-is-better metric inverts (base over now). A latency
+        # that drops to 0.0 would divide by zero AND is suspicious enough to
+        # surface as a drop rather than a win.
+        if lower_is_better and float(now) <= 0.0:
+            drops.append(f"{m} collapsed to {now} vs prior {base} "
+                         "(zero latency/shed reads as a broken figure)")
+            continue
+        ratio = (float(base) / float(now) if lower_is_better
+                 else float(now) / float(base))
         compared.append(f"{m} {ratio:.3f}x")
         if ratio < 1.0 - BENCH_REGRESSION_TOLERANCE:
-            drops.append(f"{m} {now} vs prior {base} ({ratio:.3f}x)")
+            drops.append(f"{m} {now} vs prior {base} ({ratio:.3f}x"
+                         + (", lower is better)" if lower_is_better else ")"))
     if drops:
         return False, (f"{latest_name} ({platform}) regressed >"
                        f"{BENCH_REGRESSION_TOLERANCE:.0%} vs prior "

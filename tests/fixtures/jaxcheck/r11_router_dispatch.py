@@ -1,0 +1,51 @@
+"""Planted R11, router-shaped: the dispatch-loop failure modes a replica
+fleet invites — an unbounded cross-replica dispatch queue, a blocking wait
+for attempt completions inside the dispatch loop, and joining a hedge worker
+without a timeout (one wedged replica then hangs the whole router's
+shutdown). Clean twins: the real router's shapes — bounded mailbox,
+timeout-polled waits with a stop check, bounded join."""
+
+import queue
+import threading
+
+
+def unbounded_dispatch_queue():
+    dispatch_q = queue.Queue()  # planted: R11
+    return dispatch_q
+
+
+def router_dispatch_loop(replicas):
+    dispatch_q = queue.Queue(maxsize=64)
+    while True:
+        req = dispatch_q.get()  # planted: R11
+        if req is None:
+            return
+        replicas[0].submit(req)
+
+
+def hedge_worker_shutdown(hedge_loop):
+    t = threading.Thread(target=hedge_loop)
+    t.start()
+    t.join()  # planted: R11
+    return t
+
+
+# ---------------------------------------------------------------- clean twins
+
+def bounded_dispatch_loop(replicas, stop):
+    dispatch_q = queue.Queue(maxsize=64)
+    while True:
+        try:
+            req = dispatch_q.get(timeout=0.05)  # bounded poll + stop check
+        except queue.Empty:
+            if stop.is_set():
+                return
+            continue
+        replicas[0].submit(req)
+
+
+def hedge_worker_bounded_shutdown(hedge_loop):
+    t = threading.Thread(target=hedge_loop, daemon=True)
+    t.start()
+    t.join(timeout=5)  # a wedged hedge worker surfaces, never hangs stop()
+    return t

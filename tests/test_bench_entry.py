@@ -30,7 +30,8 @@ TINY = dict(batch=64, n_batches=2, warmup=1, prefetch=1,
             train_batch=32, train_steps=2, train_warmup=1,
             stream_rows=128, stream_batch=64, stream_epochs=1,
             serve_corpus=64, serve_requests=8,
-            churn_corpus=64, churn_batch=16, churn_cycles=2)
+            churn_corpus=64, churn_batch=16, churn_cycles=2,
+            fleet_corpus=64, fleet_requests=24, fleet_replicas=3)
 
 
 def test_bench_functions_produce_finite_rates(bench):
@@ -108,6 +109,33 @@ def test_bench_churn_produces_finite_figures(bench):
     assert out["churn_final_version"] == 2 + TINY["churn_cycles"]
     assert out["churn_final_rows"] == (
         TINY["churn_corpus"] + (1 + TINY["churn_cycles"]) * TINY["churn_batch"])
+
+
+def test_bench_fleet_produces_finite_figures(bench):
+    """The fleet phase must land every gated metric at tiny sizes, and the
+    hedged run must beat the unhedged one at the tail: the straggler replica's
+    lag is deterministic and the hedge delay cap sits well under it, so
+    'hedging reduces p99' is a designed property here, not a coin flip."""
+    from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+
+    config = DAEConfig(
+        n_features=bench.F, n_components=bench.D, enc_act_func="sigmoid",
+        dec_act_func="sigmoid", loss_func="cross_entropy", corr_type="none",
+        corr_frac=0.0, triplet_strategy="none", compute_dtype="bfloat16")
+    params = jax.device_put(init_params(jax.random.PRNGKey(0), config))
+    out = bench._bench_fleet(jax, params, config, TINY)
+    assert out["fleet_qps"] > 0
+    assert out["fleet_p99_ms"] >= out["fleet_p95_ms"] >= out["fleet_p50_ms"] > 0
+    assert 0.0 <= out["fleet_shed_rate"] <= 1.0
+    assert out["rollout_inflight_p95_ms"] > 0
+    # directional hedging claim: the hedged p99 must undercut the unhedged
+    # p99 on the same trace (the straggler adds a fixed 750ms tail; hedges
+    # re-issue after <=400ms to a fast replica)
+    assert out["fleet_p99_ms"] < out["fleet_p99_ms_no_hedge"], out
+    assert out["fleet_hedge_p99_improvement_ms"] > 0
+    assert out["fleet_hedges"] > 0
+    # the mid-replay rollout must have promoted every replica exactly once
+    assert all(v == 2 for v in out["fleet_versions"].values()), out
 
 
 def test_bench_size_tables_consistent(bench):

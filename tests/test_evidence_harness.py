@@ -165,3 +165,33 @@ def test_bench_trajectory_gate_reads_committed_history(evrun):
     assert len(hist) >= 2           # r02..r05 carry parsed extras
     ok, detail = evrun._bench_trajectory_gate()
     assert ok, detail
+
+
+def test_bench_trajectory_gate_inverts_lower_is_better_metrics(evrun,
+                                                               monkeypatch):
+    """ISSUE 12 satellite: fleet tail-latency and shed-rate metrics gate in
+    the LOWER-is-better direction — a p99 that grows >15% fails even though
+    the raw ratio now/base would look like an 'improvement'."""
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [
+        ("r1", {"platform": "cpu", "fleet_p99_ms": 100.0}),
+        ("r2", {"platform": "cpu", "fleet_p99_ms": 140.0}),
+    ])
+    ok, detail = evrun._bench_trajectory_gate()
+    assert not ok and "fleet_p99_ms" in detail and "lower is better" in detail
+
+    # an improving (shrinking) p99 passes
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [
+        ("r1", {"platform": "cpu", "fleet_p99_ms": 140.0}),
+        ("r2", {"platform": "cpu", "fleet_p99_ms": 100.0}),
+    ])
+    ok, detail = evrun._bench_trajectory_gate()
+    assert ok
+
+    # a zero-valued base (e.g. a 0.0 shed rate) never forms a ratio: the
+    # metric passes by absence instead of dividing by zero
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [
+        ("r1", {"platform": "cpu", "fleet_shed_rate": 0.0}),
+        ("r2", {"platform": "cpu", "fleet_shed_rate": 0.0}),
+    ])
+    ok, detail = evrun._bench_trajectory_gate()
+    assert ok and "pass by absence" in detail

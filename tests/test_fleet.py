@@ -1,0 +1,393 @@
+"""Fleet contracts (ISSUE 12 tentpole): replica health/kill, the p2c router's
+exactly-one-outcome promise across hedges and retries, absolute-deadline
+propagation, and the staged canary->probe->fleet rollout with whole-fleet
+revert.
+
+Everything here is the unit-level story; the integrated
+faults x traffic x mid-rollout runs live in tests/test_chaos_fleet.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from dae_rnn_news_recommendation_tpu.fleet import (FleetSupervisor, Router,
+                                                   ServiceReplica)
+from dae_rnn_news_recommendation_tpu.models.dae_core import (DAEConfig,
+                                                             init_params)
+from dae_rnn_news_recommendation_tpu.refresh import ChurnConfig
+from dae_rnn_news_recommendation_tpu.reliability import OutcomeLedger, faults
+from dae_rnn_news_recommendation_tpu.reliability.faults import (FaultInjector,
+                                                                FaultPlan,
+                                                                FaultSpec)
+
+N, F, D = 64, 24, 8
+SLA = 10.0  # generous: CPU test boxes stall; routing logic is what's tested
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = DAEConfig(n_features=F, n_components=D,
+                       triplet_strategy="none", corr_frac=0.0)
+    params = init_params(jax.random.PRNGKey(3), config)
+    articles = np.random.default_rng(3).random((N, F), dtype=np.float32)
+    return config, params, articles
+
+
+def make_replica(setup, name="r0", warm=True, seed_corpus=True, **kw):
+    config, params, articles = setup
+    kw.setdefault("top_k", 5)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_inflight", 16)
+    kw.setdefault("default_deadline_s", SLA)
+    rep = ServiceReplica(name, params, config, **kw)
+    if seed_corpus:
+        rep.corpus.swap(params, articles, note="initial")
+    if warm:
+        rep.warmup()
+    return rep
+
+
+def make_fleet(setup, n=3, bootstrap=True, router_kw=None, **replica_kw):
+    config, params, articles = setup
+    replicas = [make_replica(setup, name=f"r{i}", warm=False,
+                             seed_corpus=not bootstrap, **replica_kw)
+                for i in range(n)]
+    router = Router(replicas, default_deadline_s=SLA, seed=5,
+                    ledger=OutcomeLedger(), **(router_kw or {}))
+    sup = FleetSupervisor(params, config, replicas, router,
+                          churn=ChurnConfig(microbatch=16,
+                                            drift_centroid_max=1.0,
+                                            drift_collapse_max=1.0))
+    if bootstrap:
+        sup.bootstrap(articles)
+    for r in replicas:
+        r.warmup()
+    return replicas, router, sup
+
+
+def stop_fleet(replicas, router):
+    router.stop()
+    for r in replicas:
+        r.stop()
+
+
+# ------------------------------------------------------------------ replica
+
+def test_replica_health_lifecycle(setup):
+    rep = make_replica(setup)
+    try:
+        assert rep.health() == "warm" and rep.routable
+        rep.drain()
+        assert rep.health() == "draining" and not rep.routable
+        reply = rep.submit(np.zeros(F, np.float32)).result(timeout=5)
+        assert reply.status == "shed" and reply.reason == "replica_draining"
+    finally:
+        rep.stop()
+    assert rep.health() == "dead"
+    reply = rep.submit(np.zeros(F, np.float32)).result(timeout=5)
+    assert reply.status == "shed" and reply.reason == "replica_dead"
+
+
+def test_replica_kill_resolves_inflight_as_shed(setup):
+    """kill() is the crash simulation: every queued future must resolve
+    (shed), never hang — the router depends on this to re-home requests."""
+    config, params, articles = setup
+    rep = make_replica(setup, linger_s=0.2, flush_slack_s=0.5)
+    futs = [rep.submit(articles[i]) for i in range(8)]
+    rep.kill()
+    statuses = {f.result(timeout=5).status for f in futs}
+    assert statuses <= {"ok", "shed"} and all(f.done() for f in futs)
+
+
+def test_replica_lag_delays_but_never_loses_outcomes(setup):
+    config, params, articles = setup
+    rep = make_replica(setup, lag_s=0.15)
+    try:
+        t0 = time.monotonic()
+        reply = rep.submit(articles[0]).result(timeout=5)
+        assert reply.ok
+        assert time.monotonic() - t0 >= 0.15
+    finally:
+        rep.stop()
+
+
+def test_replica_degraded_health_follows_service_events(setup):
+    rep = make_replica(setup)
+    try:
+        with rep.service._lock:
+            rep.service.events.append({"event": "degraded_enter"})
+        assert rep.health() == "degraded" and rep.routable
+        with rep.service._lock:
+            rep.service.events.append({"event": "degraded_exit"})
+        assert rep.health() == "warm"
+    finally:
+        rep.stop()
+
+
+# ------------------------------------------------------------------- router
+
+def test_router_exactly_one_outcome_under_load(setup):
+    config, params, articles = setup
+    replicas, router, _ = make_fleet(setup)
+    try:
+        futs = [router.submit(articles[i % N]) for i in range(32)]
+        replies = [f.result(timeout=30) for f in futs]
+        assert all(r.ok for r in replies), router.summary()
+        assert router.ledger.audit() == []
+        counts = router.counts
+        assert counts["submitted"] == 32
+        assert counts["replied"] + counts["shed"] + counts["errors"] == 32
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_router_p2c_spreads_load(setup):
+    config, params, articles = setup
+    replicas, router, _ = make_fleet(setup, router_kw={"hedge": False})
+    try:
+        futs = [router.submit(articles[i % N]) for i in range(48)]
+        [f.result(timeout=30) for f in futs]
+        used = {r["replica"] for r in router.records}
+        assert len(used) >= 2, f"p2c routed everything to {used}"
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_router_retries_on_killed_replica(setup):
+    """A replica death surfaces as retryable sheds; the router re-homes the
+    request on a live replica with the ORIGINAL deadline and the caller sees
+    one ok reply, never the shed."""
+    config, params, articles = setup
+    replicas, router, _ = make_fleet(setup, router_kw={"hedge": False})
+    try:
+        replicas[1].kill()
+        futs = [router.submit(articles[i % N]) for i in range(16)]
+        replies = [f.result(timeout=30) for f in futs]
+        assert all(r.ok for r in replies), router.summary()
+        assert router.ledger.audit() == []
+        assert all(r["replica"] != "r1" for r in router.records)
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_router_no_replica_is_an_explicit_shed(setup):
+    config, params, articles = setup
+    replicas, router, _ = make_fleet(setup, n=2, router_kw={"hedge": False})
+    try:
+        for r in replicas:
+            r.kill()
+        reply = router.submit(articles[0]).result(timeout=5)
+        assert reply.status == "shed" and reply.reason == "no_replica"
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_hedge_fires_and_wins_against_a_straggler(setup):
+    """One replica lags every reply by 0.4s; the hedge delay floor is 50ms,
+    so a request primary-routed to the straggler is re-issued to the fast
+    replica and the caller's latency is hedge-delay-bounded, not
+    lag-bounded. The loser resolves later and is discarded, not
+    double-counted."""
+    config, params, articles = setup
+    replicas = [make_replica(setup, name="fast"),
+                make_replica(setup, name="slow", lag_s=0.4)]
+    router = Router(replicas, default_deadline_s=SLA, seed=5,
+                    ledger=OutcomeLedger(), hedge=True,
+                    hedge_delay_floor_s=0.05, hedge_delay_cap_s=0.05)
+    try:
+        fut = router.submit(articles[0], pin="slow")  # warm the pin path
+        assert fut.result(timeout=10).ok
+        # route until a primary lands on the straggler
+        futs = [router.submit(articles[i % N]) for i in range(12)]
+        replies = [f.result(timeout=30) for f in futs]
+        assert all(r.ok for r in replies)
+        time.sleep(0.6)   # let the losing (lagged) attempts resolve
+        assert router.counts["hedges"] >= 1, router.summary()
+        assert router.counts["hedge_wins"] >= 1, router.summary()
+        assert router.ledger.audit() == []   # discarded losers stay hidden
+        hedged_ok = [r for r in router.records
+                     if r["status"] == "ok" and r["hedged"]
+                     and r["replica"] == "fast"]
+        assert all(r["latency_s"] < 0.4 for r in hedged_ok), hedged_ok
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_hedge_budget_bounds_duplication(setup):
+    config, params, articles = setup
+    replicas = [make_replica(setup, name="a", lag_s=0.2),
+                make_replica(setup, name="b", lag_s=0.2)]
+    router = Router(replicas, default_deadline_s=SLA, seed=5, hedge=True,
+                    hedge_delay_floor_s=0.01, hedge_delay_cap_s=0.01,
+                    hedge_burst=2, hedge_budget_frac=0.0)
+    try:
+        futs = [router.submit(articles[i % N]) for i in range(12)]
+        [f.result(timeout=30) for f in futs]
+        time.sleep(0.4)
+        assert router.counts["hedges"] <= 2
+        assert router.counts["hedge_suppressed_budget"] >= 1
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_nearly_expired_request_is_shed_not_hedged(setup):
+    """ISSUE 12 deadline-propagation regression: a request whose ABSOLUTE
+    deadline leaves less than the observed device floor must be shed as
+    provably unmeetable at the replica — and the hedge scheduler must refuse
+    to duplicate it rather than burn a second slot on a lost cause."""
+    config, params, articles = setup
+    replicas, router, _ = make_fleet(
+        setup, router_kw={"hedge": True, "hedge_delay_floor_s": 0.0,
+                          "hedge_delay_cap_s": 0.001})
+    try:
+        floor = max(r.service._floor_s for r in replicas)
+        assert floor > 0.0, "warmup must have seeded the device floor"
+        before = dict(router.counts)
+        reply = router.submit(articles[0],
+                              deadline_s=floor / 10.0).result(timeout=10)
+        assert reply.status == "shed"
+        assert reply.reason == "deadline_unmeetable"
+        time.sleep(0.1)   # let the hedge schedule drain
+        assert router.counts["hedges"] == before["hedges"]
+        assert router.ledger.audit() == []
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_router_propagates_absolute_deadline_to_retries(setup):
+    """A retried request must carry the ORIGINAL deadline_at: after the first
+    attempt burns most of the budget on a dead replica, the retry sees the
+    REMAINING budget, and a budget below the floor is shed, not retried into
+    a deadline it can't meet."""
+    config, params, articles = setup
+    replicas, router, _ = make_fleet(setup, router_kw={"hedge": False})
+    try:
+        deadline_at = time.monotonic() + 30.0
+        fut = router.submit(articles[0], deadline_at=deadline_at)
+        reply = fut.result(timeout=10)
+        assert reply.ok and reply.deadline_met
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_route_fault_is_an_explicit_error(setup):
+    config, params, articles = setup
+    replicas, router, _ = make_fleet(setup, router_kw={"hedge": False})
+    plan = FaultPlan(seed=0, specs=(
+        FaultSpec("fleet.route", 1, "fatal", note="route dies"),))
+    try:
+        with faults.install(FaultInjector(plan)):
+            reply = router.submit(articles[0]).result(timeout=10)
+        assert reply.status == "error"
+        assert router.ledger.audit() == []
+    finally:
+        stop_fleet(replicas, router)
+
+
+# ------------------------------------------------------------------ rollout
+
+def test_bootstrap_seeds_every_replica_at_v1(setup):
+    replicas, router, sup = make_fleet(setup)
+    try:
+        assert {r.corpus.version for r in replicas} == {1}
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_clean_rollout_advances_whole_fleet_one_version(setup):
+    config, params, articles = setup
+    replicas, router, sup = make_fleet(setup)
+    try:
+        batch = np.random.default_rng(9).random((16, F), dtype=np.float32)
+        stages = []
+        report = sup.rollout(batch, note="t", stage_hook=stages.append,
+                             probe_query=articles[0])
+        assert report["ok"], report
+        assert {r.corpus.version for r in replicas} == {2}
+        assert stages[0] == "canary" and stages[1] == "probe"
+        assert stages[-1] == "done"
+        assert report["probe"]["version"] == 2  # probe answered from the NEW slot
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_canary_gate_failure_leaves_fleet_untouched(setup):
+    """The canary's swap dies (injected): its corpus rolls itself back and
+    the rollout aborts with every replica still at the pre-canary version —
+    the fleet never saw the batch."""
+    config, params, articles = setup
+    replicas, router, sup = make_fleet(setup)
+    plan = FaultPlan(seed=0, specs=(
+        FaultSpec("refresh.swap", 1, "fatal", note="canary swap dies"),))
+    try:
+        batch = np.random.default_rng(9).random((16, F), dtype=np.float32)
+        with faults.install(FaultInjector(plan)):
+            report = sup.rollout(batch, probe_query=articles[0])
+        assert not report["ok"]
+        assert report["canary"]["action"] == "rollback"
+        assert report["reverted"] == []     # nothing promoted, nothing undone
+        assert {r.corpus.version for r in replicas} == {1}
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_fleet_stage_failure_reverts_canary_too(setup):
+    """A fleet-stage swap failure after the canary promoted must restore the
+    WHOLE fleet — canary included — to the pre-canary version."""
+    config, params, articles = setup
+    replicas, router, sup = make_fleet(setup)
+    plan = FaultPlan(seed=0, specs=(
+        FaultSpec("refresh.swap", 2, "fatal", note="fleet swap dies"),))
+    try:
+        batch = np.random.default_rng(9).random((16, F), dtype=np.float32)
+        with faults.install(FaultInjector(plan)):
+            report = sup.rollout(batch, probe_query=articles[0])
+        assert not report["ok"]
+        assert "r0" in report["reverted"]
+        assert {r.corpus.version for r in replicas} == {1}
+        # the canary corpus records the legal revert, and still serves
+        assert any(rec.get("revert") for rec in replicas[0].corpus.ledger)
+        reply = router.submit(articles[0]).result(timeout=10)
+        assert reply.ok and reply.corpus_version == 1
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_dead_replica_is_skipped_and_recorded(setup):
+    config, params, articles = setup
+    replicas, router, sup = make_fleet(setup)
+    try:
+        replicas[2].kill()
+        batch = np.random.default_rng(9).random((16, F), dtype=np.float32)
+        report = sup.rollout(batch, probe_query=articles[0])
+        assert report["ok"], report
+        assert report["skipped"] == ["r2"]
+        assert replicas[0].corpus.version == replicas[1].corpus.version == 2
+        assert replicas[2].corpus.version == 1
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_failed_probe_reverts_canary(setup):
+    """A canary that swaps clean but cannot ANSWER from the new version is a
+    failed rollout: the probe rides the real serving path, pinned."""
+    config, params, articles = setup
+    replicas, router, sup = make_fleet(setup)
+    try:
+        batch = np.random.default_rng(9).random((16, F), dtype=np.float32)
+
+        def kill_canary_before_probe(stage):
+            if stage == "probe":
+                replicas[0].kill()
+
+        report = sup.rollout(batch, stage_hook=kill_canary_before_probe,
+                             probe_query=articles[0])
+        assert not report["ok"] and "probe" in report["detail"]
+        assert report["reverted"] == ["r0"]
+        assert {r.corpus.version for r in replicas} == {1}
+    finally:
+        stop_fleet(replicas, router)

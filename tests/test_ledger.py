@@ -1,0 +1,159 @@
+"""reliability/ledger.py: the shared exactly-one-outcome and version-ledger
+audits every chaos harness now leans on. The failure modes these must catch
+are exactly the ones a hedged fleet can smuggle past aggregate counters — a
+request that resolves twice (double-count) and one that never resolves
+(silent drop) — plus the rollout-only legality of corpus version reverts."""
+
+import pytest
+
+from dae_rnn_news_recommendation_tpu.reliability.ledger import (
+    OutcomeLedger, audit_outcome_counts, audit_version_ledger)
+
+
+# ------------------------------------------------------------ OutcomeLedger
+
+def test_clean_ledger_audits_empty():
+    led = OutcomeLedger()
+    for i in range(4):
+        led.submit(i, t_submit=float(i))
+    for i in range(4):
+        led.resolve(i, "ok" if i % 2 else "shed", replica="r0")
+    assert led.audit() == []
+    assert led.n_submitted == 4
+    assert led.counts() == {"ok": 2, "shed": 2}
+
+
+def test_double_outcome_is_caught():
+    """The hedge failure mode: both the primary and the hedge surface a
+    terminal decision for the same request."""
+    led = OutcomeLedger()
+    led.submit(7)
+    led.resolve(7, "ok", replica="r0")
+    led.resolve(7, "ok", replica="r1")   # the losing hedge, wrongly surfaced
+    problems = led.audit()
+    assert len(problems) == 1
+    assert "double outcome" in problems[0] and "7" in problems[0]
+    # first outcome wins the counts; the duplicate is evidence, not traffic
+    assert led.counts() == {"ok": 1}
+
+
+def test_lost_request_is_caught():
+    """The deadlock/silent-drop failure mode: submitted, never resolved."""
+    led = OutcomeLedger()
+    led.submit("a")
+    led.submit("b")
+    led.resolve("a", "error")
+    problems = led.audit()
+    assert len(problems) == 1
+    assert "lost request" in problems[0] and "b" in problems[0]
+
+
+def test_ghost_outcome_is_caught():
+    led = OutcomeLedger()
+    led.resolve("never-submitted", "ok")
+    assert any("never submitted" in p for p in led.audit())
+
+
+def test_resolve_never_raises_at_record_time():
+    """A chaos run must capture misbehavior, not die on it."""
+    led = OutcomeLedger()
+    led.resolve("ghost", "ok")
+    led.resolve("ghost", "shed")
+    assert len(led.records) == 2
+
+
+# ------------------------------------------------------ aggregate counting
+
+def test_outcome_counts_balanced():
+    assert audit_outcome_counts(10, 7, 2, 1) == []
+
+
+def test_outcome_counts_leak_and_unresolved():
+    problems = audit_outcome_counts(10, 7, 1, 1, n_unresolved=0)
+    assert len(problems) == 1 and "outcome leak" in problems[0]
+    problems = audit_outcome_counts(10, 7, 2, 0, n_unresolved=1)
+    assert any("never resolved" in p for p in problems)
+    assert not any("outcome leak" in p for p in problems)  # 7+2+0+1 == 10
+
+
+# ---------------------------------------------------- version-ledger audit
+
+def _promote(v, **kw):
+    return {"version": v, "kind": "incremental", "ok": True,
+            "gate": {"ok": True}, **kw}
+
+
+def _rollback(active, error="gate refused"):
+    return {"version": active, "kind": "incremental", "ok": False,
+            "error": error, "active_version": active, "gate": None}
+
+
+def test_version_ledger_clean_monotonic():
+    versions, n_rb, problems = audit_version_ledger(
+        [_promote(1), _promote(2), _promote(3)])
+    assert versions == [1, 2, 3] and n_rb == 0 and problems == []
+
+
+def test_version_ledger_skip_is_a_problem():
+    _, _, problems = audit_version_ledger([_promote(1), _promote(3)])
+    assert any("not +1" in p for p in problems)
+
+
+def test_version_ledger_gateless_promote_is_a_problem():
+    bad = _promote(1)
+    bad["gate"] = {"ok": False}
+    _, _, problems = audit_version_ledger([bad])
+    assert any("without gate ok" in p for p in problems)
+
+
+def test_version_ledger_rollback_keeps_verified_version():
+    versions, n_rb, problems = audit_version_ledger(
+        [_promote(1), _rollback(1), _promote(2)])
+    assert versions == [1, 2] and n_rb == 1 and problems == []
+
+
+def test_version_ledger_injected_crash_must_recover():
+    _, _, problems = audit_version_ledger(
+        [_promote(1), _rollback(1, error="injected: swap crash")])
+    assert any("injected swap crash not followed" in p for p in problems)
+    # ...but an abandoned rollout is a legal terminal on the fleet path
+    _, _, problems = audit_version_ledger(
+        [_promote(1), _rollback(1, error="injected: swap crash")],
+        allow_revert=True)
+    assert problems == []
+
+
+@pytest.mark.parametrize("allow", (False, True))
+def test_version_ledger_revert_legality(allow):
+    """The fleet-rollout move: promote v2, revert to v1, re-promote v2. Legal
+    ONLY with allow_revert — the churn path must flag any revert record."""
+    ledger = [
+        _promote(1),
+        _promote(2),
+        {"version": 1, "kind": "revert", "ok": True, "revert": True,
+         "from_version": 2},
+        _promote(2),
+    ]
+    _, _, problems = audit_version_ledger(ledger, allow_revert=allow)
+    if allow:
+        assert problems == []
+    else:
+        assert any("unexpected revert" in p for p in problems)
+
+
+def test_version_ledger_revert_to_unverified_version():
+    ledger = [
+        _promote(1),
+        {"version": 5, "kind": "revert", "ok": True, "revert": True,
+         "from_version": 1},
+    ]
+    _, _, problems = audit_version_ledger(ledger, allow_revert=True)
+    assert any("never promoted" in p for p in problems)
+
+
+def test_version_ledger_repeat_without_revert_is_a_problem():
+    """A version number repeating WITHOUT an intervening revert is a torn
+    serving line, not a rollback."""
+    _, _, problems = audit_version_ledger(
+        [_promote(1), _promote(2), _promote(2)], allow_revert=True)
+    assert any("not +1" in p for p in problems)

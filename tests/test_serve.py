@@ -440,3 +440,93 @@ def test_serving_emits_fenced_batch_spans_and_request_spans(setup):
     assert "serve/request" in names
     batch = next(e for e in tracer.events() if e["name"] == "serve/batch")
     assert batch["args"]["n"] == 1 and batch["args"]["k"] == 5
+
+
+# ------------------------------------------- absolute deadlines (ISSUE 12)
+
+def test_absolute_deadline_budget_shrinks_instead_of_resetting(setup):
+    """The deadline-propagation fix: a hedge/retry re-enqueue passes the
+    original request's ABSOLUTE deadline, so a nearly-expired request is
+    shed as provably unmeetable at admission — never re-queued with a fresh
+    full budget."""
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    svc = make_service(config, params, corpus)
+    try:
+        assert svc._floor_s > 0  # warmup seeded the proof floor
+        nearly_spent = time.monotonic() + svc._floor_s / 10
+        reply = svc.submit(articles[0],
+                           deadline_at=nearly_spent).result(timeout=SLA)
+        assert reply.status == "shed"
+        assert reply.reason == "deadline_unmeetable"
+        # deadline_at WINS over deadline_s: the generous relative budget a
+        # buggy re-enqueue might pass alongside cannot resurrect the request
+        reply = svc.submit(articles[0], deadline_s=SLA,
+                           deadline_at=time.monotonic() - 1.0).result(
+                               timeout=SLA)
+        assert reply.status == "shed"
+        assert reply.reason == "deadline_unmeetable"
+        # a healthy absolute deadline serves like a relative one
+        reply = svc.submit(articles[3],
+                           deadline_at=time.monotonic() + SLA).result(
+                               timeout=SLA)
+        assert reply.ok and reply.indices[0] == 3
+    finally:
+        svc.stop()
+
+
+# --------------------------------------- readers vs swap/revert (ISSUE 12)
+
+def test_swap_rollback_and_revert_with_concurrent_readers(setup):
+    """Readers hammering `corpus.active` across promotes, reverts, and
+    fault-injected rollbacks must never observe a torn slot: every slot
+    reference is immutable once promoted, array shapes stay mutually
+    consistent, and only fully-promoted versions are ever visible. A reader
+    that pinned the pre-churn slot can still score against it afterwards."""
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    held = corpus.active  # a long-lived reader pins the pre-churn slot
+    stop = threading.Event()
+    torn, seen_versions = [], set()
+
+    def reader():
+        while not stop.is_set():
+            slot = corpus.active
+            seen_versions.add(slot.version)
+            emb = np.asarray(slot.emb)
+            if (emb.shape[0] != slot.valid.shape[0]
+                    or slot.n > slot.valid.shape[0] or slot.version < 1):
+                torn.append(slot.version)
+
+    threads = [threading.Thread(target=reader, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(4):
+            fresh = np.random.default_rng(100 + i).random(
+                (N, F), dtype=np.float32)
+            corpus.swap(params, fresh, note=f"promote-{i}")
+            corpus.revert(note=f"fleet-rollback-{i}")
+            # the OTHER failure path: a mid-build fault discards the standby
+            # and the serving slot never changes hands at all
+            plan = faults.FaultPlan(seed=i, specs=(
+                faults.FaultSpec("serve.swap", 1, "fatal"),))
+            with faults.install(faults.FaultInjector(plan)):
+                corpus.swap(params, fresh, note=f"doomed-{i}")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert torn == []
+    assert seen_versions <= {1, 2}  # never more than the two live versions
+    assert corpus.version == 1 and corpus.active is held
+    # the pinned pre-churn slot is still fully usable after all the churn
+    fn = make_serve_fn(config, 5, fused=True)
+    _, idx = jax.device_get(
+        fn(params, held.emb, held.valid, held.scales, articles[:3]))
+    np.testing.assert_array_equal(np.asarray(idx)[:, 0], [0, 1, 2])
+    from dae_rnn_news_recommendation_tpu.reliability.ledger import (
+        audit_version_ledger)
+    _, _, problems = audit_version_ledger(corpus.ledger, allow_revert=True)
+    assert problems == []
