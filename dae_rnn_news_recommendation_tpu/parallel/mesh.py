@@ -82,6 +82,66 @@ def shard_rows(x, mesh, axis_name="data"):
     return jax.tree_util.tree_map(put, x)
 
 
+def _sorted_shards(arr, axis=0):
+    """The array's addressable shards in ascending row order — shard i of the
+    returned list holds rows shard_spans(arr)[i]. Row-sharded layouts from
+    `shard_rows` keep device order == global row order, so this is also mesh
+    device order."""
+    def start(shard):
+        idx = shard.index[axis] if shard.index else slice(None)
+        return 0 if idx.start is None else int(idx.start)
+
+    return sorted(arr.addressable_shards, key=start)
+
+
+def shard_spans(arr, axis=0):
+    """[(row_start, row_stop, device)] per shard of a row-sharded array, in
+    ascending row order. Shard ids used across serve/corpus (loss injection,
+    degradation, recovery) are indices into this list."""
+    spans = []
+    n = int(arr.shape[axis])
+    for shard in _sorted_shards(arr, axis):
+        idx = shard.index[axis] if shard.index else slice(None)
+        lo = 0 if idx.start is None else int(idx.start)
+        hi = n if idx.stop is None else int(idx.stop)
+        spans.append((lo, hi, shard.device))
+    return spans
+
+
+def shard_host_copies(arr, axis=0):
+    """One host np array per shard, in ascending row order. Pure D2H
+    transfers of the existing buffers — no compiled program, so the
+    chaos-serve compile guard (zero post-warmup XLA compiles) stays clean
+    when the shard audit sweeps the corpus."""
+    return [np.asarray(shard.data) for shard in _sorted_shards(arr, axis)]
+
+
+def rebuild_shards(arr, replacements, axis=0):
+    """A new array with the same shape/sharding as `arr`, where shard i's
+    device buffer is replaced by `replacements[i]` (a host array of the
+    shard's shape) and every other shard REUSES `arr`'s live buffer —
+    no cross-device copy, no host round-trip for the survivors.
+
+    This is the device-buffer surgery both halves of shard fault tolerance
+    ride: `inject_shard_loss` swaps one shard for a poisoned buffer, and
+    `recover_shards` swaps the lost shard back in from the host mirror while
+    the surviving shards keep their exact bytes (the bitwise-recovery
+    contract the chaos-shard soak asserts)."""
+    shards = _sorted_shards(arr, axis)
+    bufs = []
+    for i, shard in enumerate(shards):
+        if i in replacements:
+            new = np.asarray(replacements[i])
+            assert new.shape == shard.data.shape, (
+                f"shard {i}: replacement shape {new.shape} != "
+                f"{shard.data.shape}")
+            bufs.append(jax.device_put(new.astype(arr.dtype), shard.device))
+        else:
+            bufs.append(shard.data)
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, arr.sharding, bufs)
+
+
 def get_mesh_2d(data_parallel, model_parallel, axis_names=("data", "model"),
                 devices=None):
     """2-D mesh: batch sharded over `data`, features (the wide F axis of W) over

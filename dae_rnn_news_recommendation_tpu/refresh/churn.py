@@ -222,19 +222,31 @@ class ChurnSupervisor:
         """Incremental swap + host-mirror bookkeeping. A rollback (injected
         refresh.swap fault, gate refusal) leaves both the corpus AND the
         mirror untouched — the caller sees action='rollback' and owns the
-        retry, so a replayed cycle reconverges to the fault-free state."""
+        retry, so a replayed cycle reconverges to the fault-free state.
+
+        A shard-degraded corpus (lost device shard quarantined, serving
+        partial coverage) blocks every swap until healed, so the supervisor
+        recovers FIRST — re-materializing the lost shard from the host
+        mirror — then appends; the returned action carries a 'recover+'
+        prefix so the soak can see the heal happened on this cycle."""
+        recovered = False
+        if getattr(self.corpus, "degraded_shards", ()):
+            self.corpus.recover_shards(note=f"churn-{cycle}-shard-recover")
+            recovered = True
         before = self.corpus.version
         self.corpus.swap_incremental(
             self.params, X, emb=emb, max_rows=self.churn.max_rows,
             max_age_versions=self.churn.max_age_versions,
             note=f"churn-{cycle}")
         led = self.corpus.ledger[-1]
+        prefix = "recover+" if recovered else ""
         if not led["ok"] or self.corpus.version == before:
-            return {"action": "rollback", "version": self.corpus.version,
+            return {"action": prefix + "rollback",
+                    "version": self.corpus.version,
                     "error": led.get("error", "")}
         self._store.append(X)
         self._trim_store(led["n_evicted"])
-        out = {"action": "incremental", "version": led["version"],
+        out = {"action": prefix + "incremental", "version": led["version"],
                "n_added": led["n_added"], "n_evicted": led["n_evicted"],
                "gate": led["gate"], "swap_s": led["duration_s"]}
         if getattr(self.corpus, "reindex_due", False):
@@ -243,8 +255,8 @@ class ChurnSupervisor:
             # through the same gate -> promote -> ledger path as any swap
             self.corpus.reindex(note=f"churn-{cycle}-reindex")
             led = self.corpus.ledger[-1]
-            out["action"] = ("incremental+reindex" if led["ok"]
-                             else "incremental+reindex_rollback")
+            out["action"] = prefix + ("incremental+reindex" if led["ok"]
+                                      else "incremental+reindex_rollback")
             out["reindex"] = {"ok": led["ok"], "version": led["version"]}
         return out
 

@@ -67,8 +67,11 @@ SITES = (
 
 # Post-crash / mid-run directives consumed by the chaos harness, not fired
 # in-line: ckpt.corrupt truncates the newest checkpoint between runs;
-# fleet.kill marks a replica the fleet harness kills mid-rollout.
-HARNESS_SITES = ("ckpt.corrupt", "fleet.kill")
+# fleet.kill marks a replica the fleet harness kills mid-rollout;
+# serve.shard poisons one shard of a mesh-sharded serving corpus mid-plan
+# (serve/chaos_serve.py applies it via ServingCorpus.inject_shard_loss and
+# records it through injector.note — a dead device never raises in-line).
+HARNESS_SITES = ("ckpt.corrupt", "fleet.kill", "serve.shard")
 
 KINDS = ("preempt", "fatal", "transient", "truncate")
 

@@ -119,13 +119,36 @@ def audit_version_ledger(ledger, allow_revert=False):
     `revert: True` legally moves the active version BACK to a previously
     verified one, and the next promote re-bumps from there — so a version
     number may repeat, but only with an intervening revert. Without it
-    (the churn path), any revert record is itself a problem."""
+    (the churn path), any revert record is itself a problem.
+
+    Sharded corpora add two record shapes. Promotes (and `recover` records)
+    carry `shards: {n, versions}` — the per-shard version stamps at commit
+    time — and every such record must be UNIFORM (a mixed stamp is a torn
+    commit: the two-phase swap either flips every shard or none) and within
+    one version of the record's own version (the ≤1-skew bound; in practice
+    the atomic commit makes skew zero, but the audit tolerates the one
+    in-flight version a lock-free reader could legally pin). A record with
+    `recover: True` re-materializes a lost shard from the host mirror: it is
+    ok=True at an UNCHANGED, already-verified version — neither a promote
+    (no +1 bump, no gate) nor a revert."""
     problems = []
-    promoted = [rec for rec in ledger if rec["ok"] and not rec.get("revert")]
+    promoted = [rec for rec in ledger
+                if rec["ok"] and not rec.get("revert")
+                and not rec.get("recover")]
     versions = [rec["version"] for rec in promoted]
     verified = set(versions)
     active = 0
     for rec in ledger:
+        sh = (rec.get("shards") or {}).get("versions") or []
+        if sh:
+            if max(sh) - min(sh) > 1:
+                problems.append(
+                    f"cross-shard version skew {sorted(set(sh))} on "
+                    f"v{rec['version']} record (>1: shards drifted apart)")
+            if len(set(sh)) > 1:
+                problems.append(
+                    f"torn shard commit on v{rec['version']} record: "
+                    f"mixed per-shard stamps {sorted(set(sh))}")
         if rec.get("revert"):
             if not allow_revert:
                 problems.append(
@@ -135,6 +158,19 @@ def audit_version_ledger(ledger, allow_revert=False):
                 problems.append(
                     f"revert to v{rec['version']}, a version never promoted")
             active = rec["version"]
+        elif rec.get("recover"):
+            if rec["version"] != active:
+                problems.append(
+                    f"recover record at v{rec['version']} while active is "
+                    f"v{active}: recovery must not move the version")
+            if rec["version"] not in verified:
+                problems.append(
+                    f"recover record at v{rec['version']}, a version never "
+                    "promoted")
+            if sh and any(v != rec["version"] for v in sh):
+                problems.append(
+                    f"recover at v{rec['version']} left shard stamps "
+                    f"{sorted(set(sh))} (must match the recovered version)")
         elif rec["ok"]:
             if rec["version"] != active + 1:
                 problems.append(
@@ -144,6 +180,11 @@ def audit_version_ledger(ledger, allow_revert=False):
             if not gate.get("ok"):
                 problems.append(
                     f"promoted v{rec['version']} without gate ok")
+            if sh and any(v != rec["version"] for v in sh):
+                problems.append(
+                    f"promote to v{rec['version']} committed shard stamps "
+                    f"{sorted(set(sh))} (commit must stamp every shard to "
+                    "the promoted version)")
             active = rec["version"]
     rollbacks = [rec for rec in ledger if not rec["ok"]]
     for rec in rollbacks:
@@ -158,3 +199,42 @@ def audit_version_ledger(ledger, allow_revert=False):
                     "injected swap crash not followed by a verified newer "
                     f"version (active was v{rec.get('active_version')})")
     return versions, len(rollbacks), problems
+
+
+def audit_shard_reads(samples):
+    """Torn-read audit over reader-thread samples of a sharded slot.
+
+    Each sample is `{"version": v, "shards": [per-shard version stamps]}`
+    captured by reading `slot.version` and `slot.shard_versions` from a
+    CONCURRENT thread while swaps/appends/recoveries run (the chaos-shard
+    soak's reader). The version-locked commit contract says a reader can
+    never observe a slot whose shards disagree — the commit stamps every
+    shard's version in the same assignment that publishes the slot — so:
+
+      * mixed stamps within one sample = torn cross-shard read;
+      * a stamp differing from the sample's own slot version = a shard
+        serving rows from a different corpus generation than the slot
+        claims (includes the staged sentinel leaking past prepare);
+      * empty samples list = the reader never ran, which would vacuously
+        pass — flagged so a broken harness can't silently certify itself.
+
+    Returns a problems list, empty when every sample is uniform."""
+    problems = []
+    if not samples:
+        return ["no shard-read samples captured (reader thread never ran)"]
+    for i, s in enumerate(samples):
+        sh = list(s.get("shards") or [])
+        if not sh:
+            problems.append(f"sample {i}: slot v{s.get('version')} carries "
+                            "no shard stamps (not a sharded slot?)")
+            continue
+        if len(set(sh)) > 1:
+            problems.append(
+                f"sample {i}: torn cross-shard read — mixed stamps "
+                f"{sorted(set(sh))} on slot v{s.get('version')}")
+        bad = sorted({v for v in sh if v != s.get("version")})
+        if bad:
+            problems.append(
+                f"sample {i}: shard stamps {bad} != slot version "
+                f"v{s.get('version')} (staged or stale shard visible)")
+    return problems

@@ -15,10 +15,12 @@ back rather than serving a bad build. Full story in docs/serving.md.
     svc.stop()
 """
 
-from .chaos_serve import (ServePlanResult, chaos_serve_soak, overload_trace,
-                          run_serve_plan, serve_fault_plan)
-from .corpus import (CORPUS_DTYPES, CorpusSlot, ServingCorpus, SwapInProgress,
-                     SwapRejected, dequantize_rows, quantize_corpus)
+from .chaos_serve import (ServePlanResult, ShardPlanResult, chaos_serve_soak,
+                          chaos_shard_soak, overload_trace, run_serve_plan,
+                          run_shard_plan, serve_fault_plan, shard_fault_plan)
+from .corpus import (CORPUS_DTYPES, CorpusSlot, ServingCorpus,
+                     ShardedUnsupported, SwapInProgress, SwapRejected,
+                     dequantize_rows, quantize_corpus)
 from .graph import (block_indices, make_corpus_encode_fn, make_ivf_serve_fn,
                     make_serve_fn, make_sharded_serve_fn)
 from .service import RecommendationService, Reply, ReplyFuture
@@ -31,10 +33,13 @@ __all__ = [
     "ReplyFuture",
     "ServePlanResult",
     "ServingCorpus",
+    "ShardPlanResult",
+    "ShardedUnsupported",
     "SwapInProgress",
     "SwapRejected",
     "block_indices",
     "chaos_serve_soak",
+    "chaos_shard_soak",
     "dequantize_rows",
     "make_corpus_encode_fn",
     "make_ivf_serve_fn",
@@ -43,5 +48,7 @@ __all__ = [
     "overload_trace",
     "quantize_corpus",
     "run_serve_plan",
+    "run_shard_plan",
     "serve_fault_plan",
+    "shard_fault_plan",
 ]

@@ -27,9 +27,26 @@ A plan passes when:
     unfaulted plan promoted to a new version;
   * bounded latency: p95 of answered requests stays under the generous
     deadline even when the plan ran degraded.
+
+The CHAOS-SHARD soak (`chaos_shard_soak` / `run_shard_plan`) is the
+mesh-sharded sibling: each seeded plan runs a row-sharded service over every
+local device and exercises one shard-fault family — shard lost under load
+(detect -> quarantine -> partial_corpus replies -> swaps blocked -> recover),
+shard lost mid-swap (the loss lands inside the prepare phase and the commit
+heals it), and prepare-phase crashes on both swap flavors (whole-slot
+rollback, no shard advances). A concurrent reader thread samples the active
+slot's per-shard version stamps the whole time, and a plan passes only when:
+exactly-one-outcome holds; `audit_shard_reads` finds zero torn cross-shard
+reads; `audit_version_ledger` accepts the promote/degrade/recover records
+(uniform shard stamps, <=1 skew); zero post-warmup XLA compiles (loss,
+quarantine, degraded serving and recovery all ride warmed variants and pure
+transfers); and the final slot is BITWISE equal to a fault-free reference
+replay of the same seeded operations — the recovery really is a byte-exact
+undo of the loss.
 """
 
 import dataclasses
+import threading
 import time
 
 import numpy as np
@@ -38,9 +55,12 @@ from ..analysis.runtime import compile_guard
 from ..models.dae_core import DAEConfig, init_params
 from ..reliability import faults as _faults
 from ..reliability.faults import FaultInjector, FaultPlan, FaultSpec
-from ..reliability.ledger import audit_outcome_counts
+from ..reliability.ledger import (OutcomeLedger, audit_outcome_counts,
+                                  audit_shard_reads, audit_version_ledger)
 from ..reliability.retry import RetryPolicy
-from .corpus import ServingCorpus
+from ..train.resident import build_resident
+from .corpus import ServingCorpus, SwapRejected
+from .graph import block_indices
 from .service import RecommendationService
 
 # CPU-sized service shapes: small enough for tier-1, busy enough to overload
@@ -249,6 +269,386 @@ def chaos_serve_soak(n_plans=6, n_requests=48, log=None):
     """Replay `n_plans` seeded plans (seeds 0..n-1; any 6 consecutive seeds
     cover every serve fault family). Returns {"results", "all_ok", ...}."""
     results = [run_serve_plan(seed, n_requests=n_requests, log=log)
+               for seed in range(n_plans)]
+    n_ok = sum(1 for r in results if r.ok)
+    return {"results": results, "n_ok": n_ok, "n_plans": n_plans,
+            "all_ok": n_ok == n_plans}
+
+
+# ------------------------------------------------------- chaos-shard soak
+# Mesh-sharded serving under shard faults. Shapes stay CONSTANT across a
+# plan (append batches keep the corpus at _N_ARTICLES rows, so N_pad never
+# moves) — that is what lets the compile guard demand ZERO post-warmup
+# compiles while shards die, degrade and recover mid-plan.
+
+_APPEND_ROWS = 32   # divides block=32 and the 8-device mesh; with
+# max_rows=_N_ARTICLES every append evicts exactly its own size, so n_pad
+# is pinned and every dispatch/swap rides the warmed programs
+
+_SHARD_FAMILIES = (
+    "shard-lost-under-load",   # loss while serving: detect -> quarantine ->
+    # partial_corpus -> swaps blocked -> recover -> append
+    "shard-lost-mid-swap",     # loss lands INSIDE an append's prepare
+    # phase; the commit re-places every shard and heals it
+    "prepare-crash-append",    # injected refresh.swap fatal: whole-slot
+    # rollback, retry promotes
+    "prepare-crash-rebuild",   # injected serve.swap fatal on a full
+    # rebuild: same rollback contract
+)
+
+
+@dataclasses.dataclass
+class ShardPlanResult:
+    seed: int
+    family: str
+    dtype: str
+    ok: bool
+    detail: str
+    n_submitted: int
+    n_replied: int
+    n_shed: int
+    n_errors: int
+    n_partial: int          # replies tagged partial_corpus
+    min_coverage: float     # lowest coverage stamped on any reply
+    final_version: int
+    bitwise_recovered: bool  # final slot == fault-free reference, byte-exact
+    n_read_samples: int     # reader-thread shard-stamp snapshots audited
+    n_post_warm_compiles: int
+    injected: list
+    duration_s: float
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def shard_fault_plan(seed):
+    """Seeded shard-fault plan: four families, round-robin on the seed (any
+    4 consecutive seeds cover every family), alternating float32/int8
+    corpora (any 2 consecutive seeds cover both quantization poisons —
+    float32 loses an embedding shard, int8 loses its f32 scales shard).
+
+    The two loss families plan the `serve.shard` HARNESS directive (a dead
+    device never raises in-line — `run_shard_plan` applies it via
+    `ServingCorpus.inject_shard_loss`); the two crash families plan in-line
+    fatals at the prepare phase of each swap flavor."""
+    family = _SHARD_FAMILIES[seed % len(_SHARD_FAMILIES)]
+    specs = {
+        "shard-lost-under-load": (FaultSpec(
+            "serve.shard", 1, "fatal", note="shard HBM lost under load"),),
+        "shard-lost-mid-swap": (FaultSpec(
+            "serve.shard", 1, "fatal",
+            note="shard HBM lost inside the prepare phase"),),
+        "prepare-crash-append": (FaultSpec(
+            "refresh.swap", 1, "fatal",
+            note="append prepare dies -> whole-slot rollback"),),
+        "prepare-crash-rebuild": (FaultSpec(
+            "serve.swap", 1, "fatal",
+            note="rebuild prepare dies -> whole-slot rollback"),),
+    }[family]
+    return FaultPlan(seed=int(seed), specs=specs)
+
+
+class _ShardLossAtPrepare(FaultInjector):
+    """Injector that lands the planned shard loss INSIDE the prepare phase:
+    the first `refresh.swap` fire (the very top of the staged append) poisons
+    one shard of the ACTIVE slot before the hook returns. The swap's base
+    snapshot predates the loss and the commit re-places every shard's
+    buffers, so the promote itself is the recovery — the family proves a
+    mid-prepare loss can neither tear the commit nor survive it."""
+
+    def __init__(self, plan, corpus, shard_id):
+        super().__init__(plan)
+        self._corpus = corpus
+        self._shard = int(shard_id)
+        self._armed = True
+
+    def fire(self, site, **info):
+        if site == "refresh.swap" and self._armed:
+            self._armed = False
+            self._corpus.inject_shard_loss(
+                self._shard, note="lost mid-swap (prepare phase)")
+        super().fire(site, **info)
+
+
+def _encode_rows(corpus, params, X):
+    """Unit-norm [n, D] f32 host embeddings of `X` via the corpus's own
+    jitted encoder — computed once per batch so `swap_incremental(emb=...)`
+    never pays (or recompiles) the encode inside the compile guard."""
+    import jax
+
+    resident = build_resident(X, device_put=corpus._device_put)
+    blocks = block_indices(int(X.shape[0]), corpus.block)
+    emb = corpus._encode_corpus(params, resident, blocks)
+    return np.asarray(jax.device_get(emb), np.float32)[: int(X.shape[0])]
+
+
+def _slot_fingerprint(slot):
+    """Host copy of every byte that defines the slot's serving behavior."""
+    import jax
+
+    return {"n": slot.n, "version": slot.version,
+            "emb": np.asarray(jax.device_get(slot.emb)),
+            "valid": np.asarray(jax.device_get(slot.valid)),
+            "scales": (None if slot.scales is None
+                       else np.asarray(jax.device_get(slot.scales))),
+            "ages": (None if slot.ages is None
+                     else np.asarray(slot.ages))}
+
+
+def _fingerprints_equal(a, b):
+    if a["n"] != b["n"] or a["version"] != b["version"]:
+        return False
+    for key in ("emb", "valid", "scales", "ages"):
+        x, y = a[key], b[key]
+        if (x is None) != (y is None):
+            return False
+        if x is not None and not (x.dtype == y.dtype
+                                  and np.array_equal(x, y)):
+            return False
+    return True
+
+
+def _make_sharded_service(seed, dtype):
+    """Row-sharded service over every local device, fully warmed: serve
+    variants (warmup), the append path (one fault-free incremental swap, so
+    encode/dequantize/requantize/gate programs for the plan's exact shapes
+    are all cached) — everything the plan dispatches after this point must
+    be a cache hit."""
+    from ..parallel.mesh import get_mesh
+    import jax
+
+    config = DAEConfig(n_features=_N_FEATURES, n_components=_N_COMPONENTS,
+                       enc_act_func="tanh", triplet_strategy="none",
+                       corr_type="masking", corr_frac=0.0)
+    params = init_params(jax.random.PRNGKey(7 + seed), config)
+    rng = np.random.default_rng(2000 + seed)
+    articles = rng.random((_N_ARTICLES, _N_FEATURES), dtype=np.float32)
+    mesh = get_mesh()
+    corpus = ServingCorpus(config, block=32, mesh=mesh, corpus_dtype=dtype)
+    corpus.swap(params, articles, note="initial")
+    service = RecommendationService(
+        params, config, corpus, top_k=5, max_batch=8, max_inflight=16,
+        flush_slack_s=0.02, linger_s=0.002, default_deadline_s=_SLA_S,
+        retry=RetryPolicy(max_attempts=3, backoff_s=0.001, max_elapsed_s=0.5),
+        sharded=True, mesh=mesh)
+    service.warmup()
+    batch1 = rng.random((_APPEND_ROWS, _N_FEATURES), dtype=np.float32)
+    corpus.swap_incremental(params, batch1,
+                            emb=_encode_rows(corpus, params, batch1),
+                            max_rows=_N_ARTICLES, note="warm-append")
+    return service, params, config, articles, batch1
+
+
+def _replay_reference(seed, dtype, family, params, config, articles, batch1,
+                      batch2, fresh):
+    """The fault-free twin: the exact data operations the faulted plan
+    performed, on a fresh corpus over the same mesh — its final slot is the
+    bitwise target the recovered corpus must hit."""
+    from ..parallel.mesh import get_mesh
+
+    corpus = ServingCorpus(config, block=32, mesh=get_mesh(),
+                           corpus_dtype=dtype)
+    corpus.swap(params, articles, note="initial")
+    corpus.swap_incremental(params, batch1,
+                            emb=_encode_rows(corpus, params, batch1),
+                            max_rows=_N_ARTICLES, note="warm-append")
+    if family == "prepare-crash-rebuild":
+        corpus.swap(params, fresh, note=f"refresh-{seed}")
+    else:
+        corpus.swap_incremental(params, batch2,
+                                emb=_encode_rows(corpus, params, batch2),
+                                max_rows=_N_ARTICLES, note=f"append-{seed}")
+    return corpus.active
+
+
+def run_shard_plan(seed, n_requests=24, log=None):
+    """Execute one chaos-shard plan on a row-sharded service. Returns
+    ShardPlanResult; see the module docstring for the pass criteria."""
+    import jax
+
+    t0 = time.monotonic()
+    family = _SHARD_FAMILIES[seed % len(_SHARD_FAMILIES)]
+    dtype = ("float32", "int8")[seed % 2]
+    plan = shard_fault_plan(seed)
+    service, params, config, articles, batch1 = _make_sharded_service(
+        seed, dtype)
+    corpus = service.corpus
+    n_shards = len(corpus.active.shard_versions)
+    shard_id = seed % n_shards
+    if family == "shard-lost-mid-swap":
+        injector = _ShardLossAtPrepare(plan, corpus, shard_id)
+    else:
+        injector = FaultInjector(plan)
+    rng = np.random.default_rng(3000 + seed)
+    batch2 = rng.random((_APPEND_ROWS, _N_FEATURES), dtype=np.float32)
+    fresh = rng.random((_N_ARTICLES, _N_FEATURES), dtype=np.float32)
+    led = OutcomeLedger()
+    futures = []
+    problems = []
+    samples = []
+    reader_stop = threading.Event()
+
+    def reader():
+        # concurrent torn-read probe: snapshot (slot version, per-shard
+        # stamps) from OUTSIDE the swap lock while swaps/losses/recoveries
+        # run; audit_shard_reads demands every snapshot is uniform
+        while not reader_stop.is_set():
+            slot = corpus.active
+            if slot is not None and slot.shard_versions is not None:
+                samples.append({"version": slot.version,
+                                "shards": [int(v)
+                                           for v in slot.shard_versions]})
+            time.sleep(0.0002)
+
+    def burst(n, tag):
+        out = []
+        for j in range(n):
+            q = articles[int(rng.integers(0, _N_ARTICLES))]
+            fut = service.submit(q, deadline_s=_SLA_S)
+            rid = f"{tag}-{j}"
+            led.submit(rid)
+            fut.add_done_callback(lambda r, rid=rid: led.resolve(
+                rid, r.status,
+                coverage=float(getattr(r, "coverage", 1.0)),
+                partial="partial_corpus" in tuple(r.degraded or ())))
+            out.append(fut)
+        futures.extend(out)
+        deadline = time.monotonic() + _HARNESS_DEADLINE_S
+        return [f.result(timeout=max(0.0, deadline - time.monotonic()))
+                for f in out]
+
+    reader_thread = threading.Thread(target=reader, daemon=True,
+                                     name="shard-read-probe")
+    reader_thread.start()
+    per_burst = max(1, n_requests // 3)
+    try:
+        with compile_guard() as guard, _faults.install(injector):
+            replies_a = burst(per_burst, f"s{seed}-pre")
+            if family == "shard-lost-under-load":
+                corpus.inject_shard_loss(shard_id, note="lost under load")
+                replies_b = burst(per_burst, f"s{seed}-degraded")
+                if not corpus.degraded_shards:
+                    problems.append("loss never quarantined: no dispatch "
+                                    "detected the poisoned shard")
+                if not any("partial_corpus" in r.degraded for r in replies_b
+                           if r.status == "ok"):
+                    problems.append("no post-loss reply tagged "
+                                    "partial_corpus")
+                if not any(r.status == "ok" and 0.0 < r.coverage < 1.0
+                           for r in replies_b):
+                    problems.append("no post-loss reply carried a "
+                                    "fractional coverage")
+                try:
+                    corpus.swap_incremental(
+                        params, batch2,
+                        emb=_encode_rows(corpus, params, batch2),
+                        max_rows=_N_ARTICLES, note="must-reject")
+                    problems.append("swap_incremental succeeded while "
+                                    "degraded (must be blocked)")
+                except SwapRejected:
+                    pass
+                corpus.recover_shards(note="heal after quarantine")
+                if not corpus.audit_shards()["ok"]:
+                    problems.append("shards still lost after "
+                                    "recover_shards()")
+                if corpus.coverage != 1.0:
+                    problems.append(f"coverage {corpus.coverage} != 1.0 "
+                                    "after recovery")
+            emb2 = _encode_rows(corpus, params, batch2)
+            if family == "prepare-crash-rebuild":
+                # first attempt dies at the injected prepare crash and rolls
+                # back (the active slot keeps serving); the retry — the spec
+                # is exhausted — must promote
+                corpus.swap(params, fresh, note=f"refresh-{seed}")
+                corpus.swap(params, fresh, note=f"refresh-{seed}")
+            else:
+                corpus.swap_incremental(params, batch2, emb=emb2,
+                                        max_rows=_N_ARTICLES,
+                                        note=f"append-{seed}")
+                if family == "prepare-crash-append":
+                    # first attempt died at the injected prepare crash and
+                    # rolled back; replay it fault-free (spec exhausted)
+                    corpus.swap_incremental(params, batch2, emb=emb2,
+                                            max_rows=_N_ARTICLES,
+                                            note=f"append-{seed}")
+            replies_c = burst(per_burst, f"s{seed}-post")
+            if not all(r.status == "ok" and r.coverage == 1.0
+                       and "partial_corpus" not in r.degraded
+                       for r in replies_c):
+                problems.append("post-recovery burst not served at full "
+                                "coverage")
+    finally:
+        reader_stop.set()
+        reader_thread.join(timeout=5.0)
+        service.stop()
+    if any(r.status != "ok" for r in replies_a):
+        problems.append("pre-fault burst had non-ok replies")
+    if family == "prepare-crash-rebuild":
+        crashed = [rec for rec in corpus.ledger
+                   if not rec["ok"] and "injected" in rec.get("error", "")]
+        if not crashed:
+            problems.append("prepare crash never rolled back in the ledger")
+    if family == "prepare-crash-append":
+        if not any(not rec["ok"] and "injected" in rec.get("error", "")
+                   for rec in corpus.ledger):
+            problems.append("prepare crash never rolled back in the ledger")
+    if family == "shard-lost-mid-swap":
+        if not any(e.get("site") == "serve.shard" for e in injector.fired):
+            problems.append("mid-swap loss was never applied")
+        if not corpus.audit_shards()["ok"]:
+            problems.append("commit did not heal the mid-prepare loss")
+    if corpus.version != 3:
+        problems.append(f"final version {corpus.version} != 3 "
+                        "(initial + warm append + plan swap)")
+    problems += led.audit()
+    counts = led.counts()
+    problems += audit_outcome_counts(
+        led.n_submitted, counts.get("ok", 0), counts.get("shed", 0),
+        counts.get("error", 0))
+    problems += audit_shard_reads(samples)
+    _, _, ledger_problems = audit_version_ledger(corpus.ledger)
+    problems += ledger_problems
+    if guard.count > 0:
+        problems.append(
+            f"{guard.count} XLA compiles after warmup — shard loss, "
+            "degraded serving and recovery must ride warmed programs")
+    # the fault-free twin runs OUTSIDE the guard (its fresh corpus compiles
+    # its own encoder); bitwise equality is the recovery contract
+    reference = _replay_reference(seed, dtype, family, params, config,
+                                  articles, batch1, batch2, fresh)
+    bitwise = _fingerprints_equal(_slot_fingerprint(corpus.active),
+                                  _slot_fingerprint(reference))
+    if not bitwise:
+        problems.append("final slot differs from the fault-free reference "
+                        "(recovery is not bitwise)")
+    partial = [r for r in led.records
+               if r["status"] == "ok" and r.get("partial")]
+    coverages = [r["coverage"] for r in led.records if r["status"] == "ok"]
+    result = ShardPlanResult(
+        seed=int(seed), family=family, dtype=dtype, ok=not problems,
+        detail="; ".join(problems) or "ok",
+        n_submitted=led.n_submitted, n_replied=counts.get("ok", 0),
+        n_shed=counts.get("shed", 0), n_errors=counts.get("error", 0),
+        n_partial=len(partial),
+        min_coverage=round(min(coverages), 4) if coverages else 0.0,
+        final_version=int(corpus.version), bitwise_recovered=bool(bitwise),
+        n_read_samples=len(samples),
+        n_post_warm_compiles=int(guard.count),
+        injected=list(injector.fired),
+        duration_s=round(time.monotonic() - t0, 2))
+    if log:
+        log(f"shard plan {seed} [{family}/{dtype}]: "
+            f"{'OK' if result.ok else 'FAIL'} ({result.n_replied} ok, "
+            f"{result.n_partial} partial, min coverage "
+            f"{result.min_coverage}) {result.detail}")
+    return result
+
+
+def chaos_shard_soak(n_plans=4, n_requests=24, log=None):
+    """Replay `n_plans` seeded chaos-shard plans (seeds 0..n-1; any 4
+    consecutive seeds cover every shard family, any 2 both corpus dtypes).
+    Returns {"results", "all_ok", ...}."""
+    results = [run_shard_plan(seed, n_requests=n_requests, log=log)
                for seed in range(n_plans)]
     n_ok = sum(1 for r in results if r.ok)
     return {"results": results, "n_ok": n_ok, "n_plans": n_plans,

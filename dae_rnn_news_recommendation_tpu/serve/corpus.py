@@ -37,6 +37,25 @@ imbalance_max` for `reindex_after` consecutive incremental swaps marks
 `reindex_due`, and `reindex()` refits the centroids on the active slot's
 rows, riding the same health-gate -> promote -> ledger path as any swap.
 
+MESH-SHARDED slots (rows placed over a 1-D device mesh, pass `mesh=` or a
+`device_put=shard_rows` closure) ride the same protocol with a TWO-PHASE
+commit: the build/gate/index work is the PREPARE phase — every shard's new
+rows, scales and valid mask are staged off to the side (a `swap_prepare`
+event marks the window, shard versions staged at a sentinel), and a host
+MIRROR of the staged quantized bytes is captured for shard recovery. COMMIT
+happens inside `_promote`: all shards' versions are stamped to the new
+corpus version in the same lock-held assignment that publishes the slot, so
+a concurrent reader either sees the whole old slot or the whole new one —
+never mixed shard versions (reliability/ledger.audit_version_ledger audits
+the per-promote shard stamps; audit_shard_reads audits live reader
+snapshots). A prepare-phase crash discards the entire staged slot — standard
+rollback, no shard advances. Shard LOSS is first-class: `inject_shard_loss`
+(the `serve.shard` chaos directive) poisons one shard's buffers in place;
+`audit_shards` detects it, `quarantine_lost_shards` degrades to
+partial-corpus serving (lost rows masked invalid, coverage fraction on the
+slot, swaps blocked), and `recover_shards` re-materializes the lost shard
+bitwise from the host mirror while surviving shards keep their live buffers.
+
 Corpus churn (refresh/) adds the INCREMENTAL variant of the same protocol:
 `swap_incremental` appends freshly-encoded articles to the active slot with
 age-based eviction instead of rebuilding the world, runs the identical health
@@ -72,6 +91,10 @@ from .graph import DEFAULT_BLOCK, block_indices, make_corpus_encode_fn
 COLLAPSE_CEILING = 0.98
 
 _GATE_SAMPLE = 256  # rows sampled for the collapse gate
+
+_STAGED = -2  # shard-version sentinel during the prepare phase: visible only
+# on the standby slot (never published), stamped to the real version by the
+# lock-held commit in _promote
 
 
 def quantize_corpus(emb, dtype):
@@ -113,14 +136,24 @@ class CorpusSlot:
     was ingested (-1 for padding), driving age-based eviction on incremental
     swaps. `stats` carries the gate sample's collapse score and centroid —
     the reference the drift gate (telemetry/health.drift_health) compares the
-    NEXT refresh batch against."""
+    NEXT refresh batch against.
+
+    Mesh-sharded slots carry four extra fields: `shard_versions` (host int32,
+    one entry per shard, all stamped to `version` by the lock-held commit —
+    None on single-device slots), `mirror` (host copy of the quantized
+    emb/valid/scales bytes, the source `recover_shards` re-materializes a
+    lost shard from), `lost` (frozenset of quarantined shard ids) and
+    `coverage` (valid-row fraction still served; < 1.0 while degraded — the
+    service stamps it on every `partial_corpus` reply)."""
 
     __slots__ = ("emb", "valid", "scales", "dtype", "n", "version", "note",
-                 "built_s", "ages", "stats", "ivf")
+                 "built_s", "ages", "stats", "ivf", "shard_versions",
+                 "mirror", "lost", "coverage")
 
     def __init__(self, emb, valid, n, version, note, built_s,
                  scales=None, dtype="float32", ages=None, stats=None,
-                 ivf=None):
+                 ivf=None, shard_versions=None, mirror=None, lost=frozenset(),
+                 coverage=1.0):
         self.emb = emb
         self.valid = valid
         self.scales = scales
@@ -132,6 +165,10 @@ class CorpusSlot:
         self.ages = ages
         self.stats = stats or {}
         self.ivf = ivf  # index.IVFCells when the corpus runs retrieval="ivf"
+        self.shard_versions = shard_versions
+        self.mirror = mirror
+        self.lost = frozenset(lost)
+        self.coverage = float(coverage)
 
     def resident_bytes(self):
         """Device bytes held by the scoring matrix (embeddings + scales; the
@@ -150,14 +187,20 @@ class SwapInProgress(RuntimeError):
     never interleaved slot state) and owns the retry decision."""
 
 
-def _slot_is_sharded(slot):
-    """True when the slot's embedding table spans more than one device.
+class ShardedUnsupported(ValueError):
+    """A requested feature does not compose with mesh-sharded slots (yet).
 
-    `swap_incremental` pulls the active slot to the host row-by-row and
-    rebuilds it single-device — on a mesh-sharded slot that silently
-    un-shards the corpus (and used to die later with an opaque placement
-    error). Until sharded append lands (ROADMAP item 1) the incremental
-    path refuses sharded slots explicitly."""
+    Raised by configuration-time guards BEFORE any device allocation or
+    corpus access — the caller gets a taxonomy error at construction, never
+    an opaque placement failure mid-request. Subclasses ValueError so
+    pre-taxonomy callers that caught ValueError keep working."""
+
+
+def _slot_is_sharded(slot):
+    """True when the slot's embedding table spans more than one device —
+    the switch that routes swaps through the two-phase prepare -> commit
+    (shard staging, host mirror, lock-held version stamp) and arms the
+    shard-loss degradation/recovery machinery."""
     sharding = getattr(slot.emb, "sharding", None)
     device_set = getattr(sharding, "device_set", None)
     return bool(device_set) and len(device_set) > 1
@@ -170,8 +213,8 @@ class ServingCorpus:
 
     def __init__(self, config, *, block=DEFAULT_BLOCK,
                  collapse_ceiling=COLLAPSE_CEILING, device_put=None,
-                 corpus_dtype="float32", retrieval="exact", n_cells=None,
-                 index_seed=0, index_iters=8, imbalance_max=4.0,
+                 mesh=None, corpus_dtype="float32", retrieval="exact",
+                 n_cells=None, index_seed=0, index_iters=8, imbalance_max=4.0,
                  reindex_after=3):
         if corpus_dtype not in CORPUS_DTYPES:
             raise ValueError(
@@ -179,6 +222,30 @@ class ServingCorpus:
         if retrieval not in ("exact", "ivf"):
             raise ValueError(
                 f"retrieval must be 'exact' or 'ivf': {retrieval!r}")
+        if retrieval == "ivf" and mesh is not None:
+            raise ShardedUnsupported(
+                "retrieval='ivf' does not compose with a mesh-sharded corpus:"
+                " the IVF cell layout is single-device (sharded IVF is future"
+                " work) — refused before any device allocation")
+        self.mesh = mesh
+        self._row_mult = None
+        if mesh is not None:
+            # slot arrays land row-sharded over the mesh; every build pads
+            # N to divide it (graph.block_indices row_multiple). Gather
+            # sources whose row count happens not to divide (raw article
+            # residents, never scored directly) stay single-device.
+            from ..parallel.mesh import shard_rows
+            self._row_mult = int(np.prod(list(mesh.shape.values())))
+            if device_put is None:
+                n_dev = self._row_mult
+
+                def device_put(x, _mesh=mesh, _n=n_dev):
+                    def put(leaf):
+                        if leaf.shape and leaf.shape[0] % _n == 0:
+                            return shard_rows(leaf, _mesh)
+                        return jax.device_put(leaf)
+
+                    return jax.tree_util.tree_map(put, x)
         self.config = config
         self.block = int(block)
         self.collapse_ceiling = float(collapse_ceiling)
@@ -198,6 +265,9 @@ class ServingCorpus:
         self._previous = None  # the slot the last promote displaced — what
         # revert() re-installs when a staged fleet rollout aborts mid-fleet
         self._version = 0
+        self._lost = set()  # quarantined shard ids: non-empty blocks every
+        # swap flavor until recover_shards() (or a promote that re-places
+        # every shard's buffers) heals the corpus
         self._refreshing = threading.Event()
         self.events = []  # swap / swap_rollback records, in order
         self.ledger = []  # append-only version ledger: one record per
@@ -221,6 +291,20 @@ class ServingCorpus:
         """True while a standby build is in flight — the service tags replies
         `stale_corpus` for the duration."""
         return self._refreshing.is_set()
+
+    @property
+    def degraded_shards(self):
+        """Sorted ids of quarantined (lost) shards; empty when fully
+        serving. Non-empty blocks every swap flavor — the churn supervisor
+        checks this and runs `recover_shards()` before appending."""
+        with self._lock:
+            return tuple(sorted(self._lost))
+
+    @property
+    def coverage(self):
+        """Valid-row fraction the active slot still serves (1.0 healthy)."""
+        with self._lock:
+            return 1.0 if self._active is None else self._active.coverage
 
     @property
     def ivf_stale_cycles(self):
@@ -248,9 +332,12 @@ class ServingCorpus:
         to fall back to (a failed FIRST build has nothing to serve).
 
         Raises `SwapInProgress` (without touching any state) if another swap
-        is already in flight on another thread."""
+        is already in flight on another thread, and `SwapRejected` while the
+        corpus is degraded (a lost shard must be recovered first — swapping
+        over a partially-dead mesh would mask the loss)."""
         self._acquire_swap(note)
         try:
+            self._reject_if_degraded("swap", note)
             return self._swap_full(params, articles, note)
         finally:
             self._swap_busy.release()
@@ -263,6 +350,22 @@ class ServingCorpus:
                                     "active_version": self._version})
             raise SwapInProgress(
                 f"a swap is already in flight (rejected: {note!r})")
+
+    def _reject_if_degraded(self, op, note):
+        """Swaps are blocked while a shard is quarantined: a promote would
+        place fresh buffers on a device the harness just declared dead, and
+        an incremental append would dequantize rows through the poisoned
+        slot. Recovery (`recover_shards`) is the only legal next move."""
+        with self._lock:
+            lost = sorted(self._lost)
+            if not lost:
+                return
+            self.events.append({"event": "swap_rejected_degraded", "op": op,
+                                "note": note, "lost": lost,
+                                "active_version": self._version})
+        raise SwapRejected(
+            f"{op} blocked while degraded (lost shards {lost}): run "
+            "recover_shards() before swapping")
 
     def _swap_full(self, params, articles, note):
         t0 = time.monotonic()
@@ -278,12 +381,45 @@ class ServingCorpus:
             # full rebuild REFITS the centroids, seeded from the gate
             # centroid the line above just stored on the slot
             self._attach_index(standby, refit=True, note=note)
+            self._stage_shards(standby, note)
         except Exception as exc:
             return self._rollback("full", note, exc, t0)
         finally:
             self._refreshing.clear()
         return self._promote(standby, gate, "full", note, t0,
                              n_added=standby.n, n_evicted=0)
+
+    def _stage_shards(self, standby, note, base=None):
+        """PREPARE phase of the two-phase sharded commit (no-op on
+        single-device slots): the staged rows/scales/valid already live off
+        to the side on every shard (the standby is invisible until commit);
+        here the shard-version vector is staged at the sentinel, and a host
+        MIRROR of the staged quantized bytes is captured — the recovery
+        source `recover_shards` re-materializes a lost shard from, bitwise.
+        Runs inside the swap's try block: any failure here discards the
+        whole staged slot (prepare-phase crash -> whole-slot rollback, no
+        shard advances)."""
+        if not _slot_is_sharded(standby):
+            return
+        from ..parallel.mesh import shard_spans
+
+        spans = shard_spans(standby.emb)
+        if (base is not None and standby.emb is base.emb
+                and base.mirror is not None):
+            standby.mirror = base.mirror  # reindex: the exact same bytes
+        else:
+            standby.mirror = {
+                "emb": np.asarray(jax.device_get(standby.emb)),
+                "valid": np.asarray(jax.device_get(standby.valid)),
+                "scales": (None if standby.scales is None else
+                           np.asarray(jax.device_get(standby.scales)))}
+        standby.shard_versions = np.full(len(spans), _STAGED, np.int32)
+        with self._lock:
+            self.events.append({
+                "event": "swap_prepare", "note": note,
+                "n_shards": len(spans),
+                "rows_per_shard": int(spans[0][1] - spans[0][0]),
+                "staged_version": self._version + 1})
 
     def _promote(self, standby, gate, kind, note, t0, *, n_added, n_evicted):
         """The single atomic assignment both swap flavors funnel through:
@@ -299,17 +435,32 @@ class ServingCorpus:
             else:  # incremental: appended rows were staged with age -1
                 standby.ages = np.where(standby.ages == -2, self._version,
                                         standby.ages).astype(np.int32)
+            if standby.shard_versions is not None:
+                # COMMIT phase of the two-phase sharded swap: every shard's
+                # version flips from the staged sentinel to the new corpus
+                # version in the same lock-held assignment that publishes
+                # the slot — a reader that can see the slot sees ALL shards
+                # already stamped, never a mix
+                standby.shard_versions = np.full_like(standby.shard_versions,
+                                                      self._version)
             self._active = standby
+            self._lost = set()  # a promote re-places every shard's buffers,
+            # healing any loss that slipped in mid-prepare
+            rec = {
+                "version": self._version, "kind": kind, "ok": True,
+                "gate": gate, "n": standby.n, "n_added": int(n_added),
+                "n_evicted": int(n_evicted), "note": note,
+                "duration_s": round(time.monotonic() - t0, 4)}
+            if standby.shard_versions is not None:
+                rec["shards"] = {
+                    "n": int(standby.shard_versions.size),
+                    "versions": [int(v) for v in standby.shard_versions]}
             self.events.append({
                 "event": "swap", "kind": kind, "note": note,
                 "version": self._version, "n_articles": standby.n,
                 "collapse": gate["collapse"],
                 "duration_s": round(time.monotonic() - t0, 4)})
-            self.ledger.append({
-                "version": self._version, "kind": kind, "ok": True,
-                "gate": gate, "n": standby.n, "n_added": int(n_added),
-                "n_evicted": int(n_evicted), "note": note,
-                "duration_s": round(time.monotonic() - t0, 4)})
+            self.ledger.append(rec)
         return standby
 
     def _rollback(self, kind, note, exc, t0):
@@ -343,6 +494,7 @@ class ServingCorpus:
         SwapRejected, and so does a revert before any second promote."""
         self._acquire_swap(note)
         try:
+            self._reject_if_degraded("revert", note)
             with self._lock:
                 prev, cur = self._previous, self._active
                 if prev is None:
@@ -381,23 +533,19 @@ class ServingCorpus:
         encoded the batch for its drift check and must not pay (or fault)
         the encode twice.
 
+        On a mesh-sharded slot the append is the same two-phase protocol as
+        a sharded full swap: the dequantize -> append -> evict -> requantize
+        round trip assembles the staged state, `_stage_shards` captures the
+        host mirror, and the re-placement goes back through the corpus's own
+        sharder (the `mesh`/`device_put` it was built with) so the standby
+        keeps the exact row-sharded topology — the commit then stamps every
+        shard's version under the lock.
+
         `refresh.swap` is the fault site (the full rebuild keeps
         `serve.swap`); rollback semantics are identical to `swap`."""
         self._acquire_swap(note)
         try:
-            active = self.active
-            if active is not None and _slot_is_sharded(active):
-                # the rebuild below round-trips rows through the host and
-                # re-places single-device — on a sharded slot that is a
-                # silent topology change, not an append. Refuse loudly
-                # (no rollback record: nothing was attempted).
-                with self._lock:
-                    self.events.append({
-                        "event": "swap_rejected_sharded", "note": note,
-                        "active_version": self._version})
-                raise SwapRejected(
-                    "sharded slot: incremental append unsupported — use a "
-                    "full swap() until sharded append lands (ROADMAP item 1)")
+            self._reject_if_degraded("swap_incremental", note)
             t0 = time.monotonic()
             self._refreshing.set()
             try:
@@ -421,6 +569,7 @@ class ServingCorpus:
                 # keep the centroids: appended rows ROUTE to their nearest
                 # existing cell; no re-clustering on the churn path
                 self._attach_index(standby, refit=False, base=base, note=note)
+                self._stage_shards(standby, note, base=base)
             except Exception as exc:
                 return self._rollback("incremental", note, exc, t0)
             finally:
@@ -465,7 +614,13 @@ class ServingCorpus:
 
         combined = np.concatenate([old[keep], new_emb], axis=0)
         n = combined.shape[0]
-        n_pad = block_indices(n, self.block).size
+        # a sharded base must stay sharded: pad so the standby divides the
+        # mesh (inferred from the base slot when the corpus was built with a
+        # bare device_put closure instead of mesh=)
+        row_mult = self._row_mult
+        if row_mult is None and _slot_is_sharded(base):
+            row_mult = len(base.emb.sharding.device_set)
+        n_pad = block_indices(n, self.block, row_multiple=row_mult).size
         emb_pad = np.zeros((n_pad, combined.shape[1]), np.float32)
         emb_pad[:n] = combined
         # staged age -2 marks the appended rows; _promote stamps them with
@@ -490,7 +645,7 @@ class ServingCorpus:
         _faults.fire("serve.swap", note=note)
         n = int(articles.shape[0])
         resident = build_resident(articles, device_put=self._device_put)
-        blocks = block_indices(n, self.block)
+        blocks = block_indices(n, self.block, row_multiple=self._row_mult)
         emb = self._encode_corpus(params, resident, blocks)
         emb, scales = quantize_corpus(emb, self.corpus_dtype)
         n_pad = blocks.size
@@ -601,6 +756,7 @@ class ServingCorpus:
             raise SwapRejected("reindex() requires retrieval='ivf'")
         self._acquire_swap(note)
         try:
+            self._reject_if_degraded("reindex", note)
             t0 = time.monotonic()
             self._refreshing.set()
             try:
@@ -621,11 +777,194 @@ class ServingCorpus:
                         raise SwapRejected(
                             f"reindex standby failed the health gate: {gate}")
                     self._attach_index(standby, refit=True, note=note)
+                    self._stage_shards(standby, note, base=base)
             except Exception as exc:
                 return self._rollback("reindex", note, exc, t0)
             finally:
                 self._refreshing.clear()
             return self._promote(standby, gate, "reindex", note, t0,
                                  n_added=0, n_evicted=0)
+        finally:
+            self._swap_busy.release()
+
+    # -------------------------------------------------- shard fault tolerance
+    def _clone_slot(self, slot, **overrides):
+        """A new CorpusSlot sharing every field of `slot` except
+        `overrides` — the degraded/recovered views replace one or two
+        arrays and keep everything else (version, ages, stats, mirror)
+        byte-identical."""
+        kw = dict(emb=slot.emb, valid=slot.valid, n=slot.n,
+                  version=slot.version, note=slot.note, built_s=slot.built_s,
+                  scales=slot.scales, dtype=slot.dtype, ages=slot.ages,
+                  stats=slot.stats, ivf=slot.ivf,
+                  shard_versions=slot.shard_versions, mirror=slot.mirror,
+                  lost=slot.lost, coverage=slot.coverage)
+        kw.update(overrides)
+        return CorpusSlot(**kw)
+
+    def inject_shard_loss(self, shard_id, note=""):
+        """CHAOS HOOK — the executor for the `serve.shard` harness fault
+        directive (reliability/faults.HARNESS_SITES). Replaces one shard's
+        device buffers with NaN poison in place: same version, same shard
+        stamps, no event ordering with swaps — the loss is SILENT until a
+        dispatch comes back nonfinite or `audit_shards()` sweeps, exactly
+        like a real device dropping its HBM. float32/bfloat16 corpora poison
+        the embedding shard; int8 corpora poison the f32 scales shard (int8
+        has no NaN, and the scorer multiplies scales back in, so every score
+        against the shard goes NaN either way). Returns the poisoned shard
+        id."""
+        from ..parallel.mesh import rebuild_shards, shard_spans
+
+        with self._lock:
+            slot = self._active
+        if slot is None or not _slot_is_sharded(slot):
+            raise SwapRejected(
+                "inject_shard_loss needs a mesh-sharded active slot")
+        spans = shard_spans(slot.emb)
+        i = int(shard_id) % len(spans)
+        lo, hi, _ = spans[i]
+        if slot.scales is not None:
+            poison = np.full(hi - lo, np.nan, np.float32)
+            emb, scales = slot.emb, rebuild_shards(slot.scales, {i: poison})
+        else:
+            poison = np.full((hi - lo, int(slot.emb.shape[1])), np.nan,
+                             np.float32)
+            emb, scales = rebuild_shards(slot.emb, {i: poison}), slot.scales
+        poisoned = self._clone_slot(slot, emb=emb, scales=scales)
+        with self._lock:
+            self._active = poisoned
+            self.events.append({"event": "shard_lost", "shard": i,
+                                "note": note, "version": slot.version})
+        inj = _faults.active_injector()
+        if inj is not None:
+            inj.note("serve.shard", "fatal", shard=i, note=note)
+        return i
+
+    def audit_shards(self):
+        """Per-shard finiteness sweep of the ACTIVE slot — the shard-level
+        arm of the health gate, invoked by the service when a dispatch comes
+        back nonfinite (and by the chaos harness directly). Host-copies one
+        shard's resident buffers at a time via pure D2H transfers
+        (parallel.mesh.shard_host_copies): no compiled program, so the
+        serving compile guard stays clean. Off the steady-state request
+        path — it runs only on suspected loss."""
+        with self._lock:
+            slot = self._active
+        if slot is None or not _slot_is_sharded(slot):
+            return {"sharded": False, "ok": True, "lost": [], "n_shards": 1}
+        from ..parallel.mesh import shard_host_copies
+
+        emb_shards = shard_host_copies(slot.emb)
+        scale_shards = (shard_host_copies(slot.scales)
+                        if slot.scales is not None
+                        else [None] * len(emb_shards))
+        lost = []
+        for i, (e, s) in enumerate(zip(emb_shards, scale_shards)):
+            ok = bool(np.all(np.isfinite(np.asarray(e, np.float32))))
+            if ok and s is not None:
+                ok = bool(np.all(np.isfinite(s)))
+            if not ok:
+                lost.append(i)
+        return {"sharded": True, "ok": not lost, "lost": lost,
+                "n_shards": len(emb_shards)}
+
+    def quarantine_lost_shards(self, note=""):
+        """Detect lost shards and degrade to PARTIAL-CORPUS serving: the
+        lost shards' rows are masked invalid (the scorer's `where` mask
+        turns their NaN scores into -inf, so surviving shards keep
+        answering), the slot's `coverage` drops below 1.0 (the service
+        stamps it on every `partial_corpus` reply), and every swap flavor
+        is blocked until `recover_shards()` heals the mesh. Version is
+        UNCHANGED — degradation is a serving-state change, not a new
+        corpus — recorded in both `events` and the version ledger
+        (kind="shard_degraded", ok=False). Returns the sorted lost ids
+        (empty when the audit finds nothing, a no-op)."""
+        audit = self.audit_shards()
+        lost = list(audit["lost"])
+        if not lost:
+            return []
+        from ..parallel.mesh import shard_spans
+
+        with self._lock:
+            slot = self._active
+        spans = shard_spans(slot.emb)
+        mirror = slot.mirror
+        assert mirror is not None, (
+            "sharded promotes always stage a host mirror (_stage_shards)")
+        valid_host = np.asarray(mirror["valid"], np.float32).copy()
+        for i in lost:
+            valid_host[spans[i][0]:spans[i][1]] = 0.0
+        total = float(np.asarray(mirror["valid"], np.float32).sum())
+        coverage = float(valid_host.sum()) / max(total, 1.0)
+        put = self._device_put or jax.device_put
+        degraded = self._clone_slot(slot, valid=put(jnp.asarray(valid_host)),
+                                    lost=frozenset(lost), coverage=coverage)
+        with self._lock:
+            self._active = degraded
+            self._lost = set(lost)
+            self.events.append({
+                "event": "shard_degraded", "lost": sorted(lost),
+                "coverage": round(coverage, 4), "note": note,
+                "version": slot.version})
+            self.ledger.append({
+                "version": slot.version, "kind": "shard_degraded",
+                "ok": False,
+                "error": (f"shard loss: {sorted(lost)} quarantined "
+                          f"(coverage {coverage:.3f})"),
+                "active_version": slot.version,
+                "coverage": round(coverage, 4), "note": note})
+        return sorted(lost)
+
+    def recover_shards(self, note=""):
+        """Re-materialize every quarantined shard from the host mirror and
+        return to full-coverage serving — BITWISE: the lost shards' buffers
+        are rebuilt from the mirror's exact quantized bytes, the surviving
+        shards keep their live device buffers untouched
+        (parallel.mesh.rebuild_shards), and the valid mask comes back from
+        the mirror, so the healed slot equals the pre-loss slot
+        byte-for-byte (the chaos-shard soak asserts it). Version unchanged;
+        the ledger records kind="recover" with `recover: True` — the audit
+        accepts it only at an already-verified version. Serializes with
+        swaps through the same non-blocking guard."""
+        self._acquire_swap(note)
+        try:
+            with self._lock:
+                slot = self._active
+                lost = sorted(self._lost)
+            if slot is None or not _slot_is_sharded(slot):
+                raise SwapRejected(
+                    "recover_shards needs a mesh-sharded active slot")
+            if not lost:
+                raise SwapRejected("no lost shards to recover")
+            from ..parallel.mesh import rebuild_shards, shard_spans
+
+            mirror = slot.mirror
+            spans = shard_spans(slot.emb)
+            emb = rebuild_shards(slot.emb, {
+                i: mirror["emb"][spans[i][0]:spans[i][1]] for i in lost})
+            scales = slot.scales
+            if scales is not None:
+                scales = rebuild_shards(slot.scales, {
+                    i: mirror["scales"][spans[i][0]:spans[i][1]]
+                    for i in lost})
+            put = self._device_put or jax.device_put
+            valid = put(jnp.asarray(np.asarray(mirror["valid"], np.float32)))
+            healed = self._clone_slot(slot, emb=emb, scales=scales,
+                                      valid=valid, lost=frozenset(),
+                                      coverage=1.0)
+            with self._lock:
+                self._active = healed
+                self._lost = set()
+                self.events.append({
+                    "event": "shard_recovered", "shards": lost,
+                    "note": note, "version": slot.version})
+                self.ledger.append({
+                    "version": slot.version, "kind": "recover", "ok": True,
+                    "recover": True, "recovered": lost,
+                    "shards": {
+                        "n": len(spans),
+                        "versions": [int(v) for v in slot.shard_versions]},
+                    "note": note})
+            return healed
         finally:
             self._swap_busy.release()

@@ -72,11 +72,21 @@ def _gather_rows(resident, idx, config):
     return densify_on_device(ind, val, config.n_features)
 
 
-def block_indices(n_rows, block=DEFAULT_BLOCK):
+def block_indices(n_rows, block=DEFAULT_BLOCK, row_multiple=None):
     """[S, block] int32 index blocks covering 0..n_rows-1, tail padded by
     repeating index 0 (the pad rows are masked out of scoring via the valid
-    vector, so the duplicate gather is inert)."""
+    vector, so the duplicate gather is inert).
+
+    `row_multiple` additionally rounds the padded total S*block up until it
+    divides evenly — the sharded-corpus constraint: `parallel.mesh.shard_rows`
+    needs N_pad divisible by the mesh size, which a block multiple alone does
+    not guarantee for n_dev > block."""
     n_pad = int(-(-max(int(n_rows), 1) // block) * block)
+    if row_multiple is not None:
+        m = int(row_multiple)
+        assert m >= 1
+        lcm = block * m // np.gcd(block, m)
+        n_pad = int(-(-n_pad // lcm) * lcm)
     idx = np.zeros(n_pad, np.int32)
     idx[:n_rows] = np.arange(n_rows, dtype=np.int32)
     return idx.reshape(-1, block)

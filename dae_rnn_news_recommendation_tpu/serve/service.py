@@ -51,6 +51,7 @@ from ..analysis.runtime import CompileWatcher
 from ..reliability import faults as _faults
 from ..reliability.retry import RetryPolicy
 from ..train.pipeline import bucket_sizes
+from .corpus import ShardedUnsupported
 from .graph import make_serve_fn
 
 _LATENCY_WINDOW = 4096  # replies kept for p50/p95 (bounded, like the queue)
@@ -67,8 +68,12 @@ class Reply:
     latency_s: float = 0.0    # submit -> resolve wall clock
     deadline_met: bool = False
     degraded: tuple = ()      # subset of ("topk_truncated", "coarse_batching",
-    #                           "stale_corpus") that shaped this reply
+    #                           "stale_corpus", "partial_corpus") that shaped
+    #                           this reply
     corpus_version: int = 0
+    coverage: float = 1.0     # valid-row fraction the answering slot served;
+    # < 1.0 exactly when "partial_corpus" is in `degraded` (a shard is lost
+    # and the surviving shards answered)
 
     @property
     def ok(self):
@@ -151,9 +156,11 @@ class RecommendationService:
         (default: 3 attempts, full jitter, 0.25 s cumulative cap).
     :param sharded: score against a ROW-SHARDED corpus: the serve graphs are
         built with `make_sharded_serve_fn` over `mesh`, so corpus capacity
-        scales with device count. The corpus must be placed with
-        `ServingCorpus(device_put=lambda x: parallel.mesh.shard_rows(x, mesh))`
-        — same mesh, N_pad divisible by it, shard rows >= top_k.
+        scales with device count. Build the corpus with
+        `ServingCorpus(mesh=mesh)` (same mesh; builds pad N_pad to divide it
+        and swaps ride the two-phase shard commit) — or pass an explicit
+        `device_put=lambda x: parallel.mesh.shard_rows(x, mesh)` with
+        divisible shapes. Shard rows must stay >= top_k.
     :param mesh: the 1-D mesh for `sharded=True` (default: all devices via
         `parallel.mesh.get_mesh()`).
     :param retrieval: "exact" (scan every corpus row) or "ivf" (probe the
@@ -175,8 +182,14 @@ class RecommendationService:
             raise ValueError(
                 f"retrieval must be 'exact' or 'ivf': {retrieval!r}")
         if retrieval == "ivf" and sharded:
-            raise ValueError("retrieval='ivf' does not compose with "
-                             "sharded=True yet (ROADMAP item 1)")
+            # configuration-time taxonomy error, raised BEFORE any device
+            # allocation or corpus access: the IVF cell layout is
+            # single-device, so composing it with a row-sharded corpus can
+            # only fail later with an opaque placement error
+            raise ShardedUnsupported(
+                "retrieval='ivf' does not compose with sharded=True: the "
+                "IVF cell layout is single-device (sharded IVF is future "
+                "work)")
         self.params = params
         self.config = config
         self.corpus = corpus
@@ -340,7 +353,9 @@ class RecommendationService:
                 tags.append("topk_truncated")
         if self.corpus.refreshing:
             tags.append("stale_corpus")
-        tags = tuple(tags)
+        if getattr(slot, "coverage", 1.0) < 1.0:
+            tags.append("partial_corpus")  # already-degraded steady state:
+            # a shard is quarantined and the surviving shards answer
         b = len(live)
         target = min((s for s in self.buckets if s >= b),
                      default=self.buckets[-1])
@@ -375,8 +390,63 @@ class RecommendationService:
                 self._floor_s, wall)
         scores = np.asarray(scores)
         indices = np.asarray(indices)
+        if not np.all(np.isfinite(scores[:b])):
+            # the shard-loss detection path: NaN sorts above every finite
+            # cosine in the top-k merge, so a poisoned shard provably shows
+            # up here on the first post-loss dispatch
+            redo = self._quarantine_and_redispatch(serve_fn, batch, b, slot)
+            if redo is None:
+                for p in live:
+                    self._error(p, "nonfinite_scores")
+                return
+            slot, scores, indices = redo
+            if (getattr(slot, "coverage", 1.0) < 1.0
+                    and "partial_corpus" not in tags):
+                tags.append("partial_corpus")
+        coverage = float(getattr(slot, "coverage", 1.0))
+        tags = tuple(tags)
         for i, p in enumerate(live):
-            self._reply(p, indices[i], scores[i], tags, slot.version)
+            self._reply(p, indices[i], scores[i], tags, slot.version,
+                        coverage)
+
+    def _quarantine_and_redispatch(self, serve_fn, batch, n, slot):
+        """Nonfinite scores from a sharded corpus mean a shard's buffers
+        died under us (the `serve.shard` fault class): quarantine the lost
+        shards (`corpus.quarantine_lost_shards` masks their rows invalid,
+        drops coverage below 1.0 and blocks swaps), then re-dispatch the
+        SAME padded batch against the degraded slot — identical shapes and
+        shardings, so it rides the variant warmup() compiled, never a
+        recompile. Returns (slot, scores, indices) served by the surviving
+        shards, or None when the corpus isn't sharded, nothing was actually
+        lost and the slot didn't change under us (a genuine compute fault),
+        or the re-dispatch itself failed — the caller turns None into
+        explicit error replies for the whole batch."""
+        if not self.sharded:
+            return None
+        try:
+            lost = self.corpus.quarantine_lost_shards(
+                note="nonfinite dispatch scores")
+        # jaxcheck: disable=R9 (nothing swallowed: returning None routes every request in the batch to an explicit error Reply)
+        except Exception:
+            return None
+        fresh = self.corpus.active
+        if not lost and fresh is slot:
+            return None
+        if lost:
+            self._record_event(
+                "partial_corpus_enter", lost=list(lost),
+                coverage=round(float(getattr(fresh, "coverage", 1.0)), 4),
+                corpus_version=fresh.version)
+        try:
+            out = serve_fn(self.params, *self._slot_args(fresh), batch)
+            jax.block_until_ready(out)
+        # jaxcheck: disable=R9 (same contract: None -> explicit error Replies for the whole batch)
+        except Exception:
+            return None
+        scores, indices = np.asarray(out[0]), np.asarray(out[1])
+        if not np.all(np.isfinite(scores[:n])):
+            return None
+        return fresh, scores, indices
 
     def _note_overload(self):
         """Degraded-mode hysteresis: enter past the watermark, leave when the
@@ -418,12 +488,13 @@ class RecommendationService:
             pass
         return p.future
 
-    def _reply(self, p, indices, scores, degraded, version):
+    def _reply(self, p, indices, scores, degraded, version, coverage=1.0):
         now = time.monotonic()
         return self._finish(p, Reply(
             status="ok", indices=indices, scores=scores,
             latency_s=now - p.t_submit, deadline_met=now <= p.deadline,
-            degraded=degraded, corpus_version=version))
+            degraded=degraded, corpus_version=version,
+            coverage=float(coverage)))
 
     def _shed(self, p, reason):
         return self._finish(p, Reply(
@@ -515,6 +586,10 @@ class RecommendationService:
                 "buckets": list(self.buckets), "top_k": self.top_k,
                 "degraded_top_k": self.degraded_top_k,
                 "sharded": self.sharded, "retrieval": self.retrieval,
+                "coverage": round(float(getattr(self.corpus, "coverage",
+                                                1.0)), 4),
+                "lost_shards": list(getattr(self.corpus, "degraded_shards",
+                                            ()) or ()),
                 "probes": (self.probes if self.retrieval == "ivf" else None),
                 "floor_ms": round(self._floor_s * 1e3, 3),
                 "compiles": {

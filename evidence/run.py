@@ -571,6 +571,51 @@ def main(argv=None):
         chaos_serve_out = staged("chaos-serve soak (6 seeded fault plans x "
                                  "overload traces)", _chaos_serve)
 
+        def _chaos_shard():
+            # ISSUE 13 acceptance: mesh-sharded serving under shard loss
+            # (serve/chaos_serve.py chaos-shard plans). Four seeded families
+            # — shard lost under load, shard lost inside an append's prepare
+            # phase, and a prepare-crash in each swap flavor — over fp32 and
+            # int8 corpora. Each plan audits in-harness: exactly one outcome
+            # per request with a coverage fraction on every reply, zero torn
+            # cross-shard reads (a concurrent reader samples slot/shard
+            # version stamps throughout), a version ledger whose promotes
+            # carry uniform shard stamps, bitwise slot equality vs the
+            # fault-free reference after recovery, and zero post-warmup
+            # compiles.
+            from dae_rnn_news_recommendation_tpu.serve import chaos_shard_soak
+
+            out = chaos_shard_soak(n_plans=4, n_requests=24, log=print)
+            return {"n_ok": out["n_ok"], "n_plans": out["n_plans"],
+                    "all_ok": out["all_ok"],
+                    "plans": [{"seed": r.seed, "family": r.family,
+                               "dtype": r.dtype, "ok": r.ok,
+                               "detail": r.detail,
+                               "n_submitted": r.n_submitted,
+                               "n_replied": r.n_replied,
+                               "n_partial": r.n_partial,
+                               "min_coverage": r.min_coverage,
+                               "final_version": r.final_version,
+                               "bitwise_recovered": r.bitwise_recovered,
+                               "n_read_samples": r.n_read_samples,
+                               "n_post_warm_compiles": r.n_post_warm_compiles,
+                               "n_injected": len(r.injected),
+                               "duration_s": round(r.duration_s, 2)}
+                              for r in out["results"]]}
+
+        # the shard plans need a mesh: >= 2 devices (the 8-virtual-device CPU
+        # mesh in tests comes from an XLA flag this harness does not force)
+        if len(jax.devices()) >= 2:
+            chaos_shard_out = staged("chaos-shard soak (4 seeded shard-loss/"
+                                     "prepare-crash plans, sharded corpus)",
+                                     _chaos_shard)
+        else:
+            chaos_shard_out = None
+            print("chaos-shard soak skipped: needs >= 2 devices "
+                  f"(have {len(jax.devices())}); run under "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=8 or on "
+                  "a multi-device accelerator to capture it")
+
         def _chaos_churn():
             # ISSUE 10 acceptance: 6 seeded fault plans against the
             # continuous-refresh loop (reliability/chaos_churn.py), one per
@@ -879,6 +924,21 @@ def main(argv=None):
           if sv_swap else
           "no plan exercised serve.swap — the 6-family round-robin should "
           "always include seed 4's swap-fatal plan")
+    if chaos_shard_out is not None:
+        sh_plans = chaos_shard_out["plans"]
+        n_sh_bitwise = sum(1 for pl in sh_plans if pl["bitwise_recovered"])
+        n_sh_compiles = sum(pl["n_post_warm_compiles"] for pl in sh_plans)
+        check("chaos_shard_consistent",
+              chaos_shard_out["all_ok"]
+              and n_sh_bitwise == chaos_shard_out["n_plans"]
+              and n_sh_compiles == 0,
+              f"{chaos_shard_out['n_ok']}/{chaos_shard_out['n_plans']} "
+              "chaos-shard plans passed (families: "
+              + ", ".join(sorted({pl["family"] for pl in sh_plans}))
+              + f"); {n_sh_bitwise} recovered the sharded slot bitwise from "
+              "the host mirror, every degraded reply carried its coverage, "
+              "zero torn cross-shard reads, "
+              f"{n_sh_compiles} post-warmup compiles")
     cc_plans = chaos_churn_out["plans"]
     n_cc_mono = sum(1 for pl in cc_plans if pl["versions_monotonic"])
     n_cc_bitwise = sum(1 for pl in cc_plans if pl["bitwise"])
@@ -1013,6 +1073,7 @@ def main(argv=None):
         "user_model": dict(user),
         "chaos_soak": chaos_out,
         "chaos_serve_soak": chaos_serve_out,
+        "chaos_shard_soak": chaos_shard_out,
         "chaos_churn_soak": chaos_churn_out,
         "checks": checks,
     }
@@ -1307,6 +1368,31 @@ def _write_md(p):
                 f"| {pl['seed']} | {pl['ok']} | {pl['n_replied']} | "
                 f"{pl['n_shed']} | {pl['n_errors']} | {pl['swap_faulted']} | "
                 f"{pl['swap_rolled_back']} | {pl['p95_ms']} | "
+                f"{pl['duration_s']} |")
+    csh = p.get("chaos_shard_soak")
+    if csh:
+        lines += [
+            "",
+            "## Chaos-shard soak (mesh-sharded serving)",
+            "",
+            f"{csh['n_ok']}/{csh['n_plans']} seeded shard fault plans "
+            "against the mesh-sharded corpus (docs/serving.md): shard lost "
+            "under load / inside an append's prepare / prepare-crash per "
+            "swap flavor, fp32 and int8. Each plan must quarantine, serve "
+            "partial_corpus with coverage on every reply, refuse swaps "
+            "while degraded, recover the slot bitwise from the host mirror, "
+            "and show zero torn cross-shard reads and zero post-warmup "
+            "compiles:",
+            "",
+            "| plan | family | dtype | ok | partial | min cov | bitwise | "
+            "compiles | s |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for pl in csh["plans"]:
+            lines.append(
+                f"| {pl['seed']} | {pl['family']} | {pl['dtype']} | "
+                f"{pl['ok']} | {pl['n_partial']} | {pl['min_coverage']} | "
+                f"{pl['bitwise_recovered']} | {pl['n_post_warm_compiles']} | "
                 f"{pl['duration_s']} |")
     cc = p.get("chaos_churn_soak")
     if cc:

@@ -1,0 +1,50 @@
+"""Planted R1: shard-recovery host re-materialization inside a jitted region.
+
+The shard-recovery path (serve/corpus.recover_shards) re-materializes a lost
+shard from the HOST mirror — a D2H/H2D round trip that must live on the host
+side of the dispatch boundary. Jitting the recovery "for speed" drags the
+materialization under trace, where np.asarray / jax.device_get either break
+tracing outright or pin a silent sync into every dispatch. The clean twin
+keeps the host surgery outside the jit and hands the jitted installer a
+finished device value.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_recover_shard(emb, mirror, lo, hi):
+    host = jax.device_get(emb)  # planted: R1
+    patch = np.asarray(mirror)  # planted: R1
+    return jnp.asarray(host).at[lo:hi].set(jnp.asarray(patch[lo:hi]))
+
+
+def _rematerialize(mirror, lo, hi):
+    # reachable from the jitted caller below: host-sync is a bug anywhere
+    # trace can reach, not just under the decorator itself
+    rows = np.asarray(mirror[lo:hi])  # planted: R1
+    return rows
+
+
+@jax.jit
+def bad_recover_via_helper(emb, mirror, lo, hi):
+    patch = _rematerialize(mirror, lo, hi)
+    return emb.at[lo:hi].set(patch)
+
+
+# -------------------------------------------------------------- clean twin
+
+def recover_shard(emb, mirror, lo, hi):
+    """Host-side surgery OUTSIDE any trace: materialize the mirror rows on
+    the host, then hand the jitted installer a finished device value — the
+    shape serve/corpus.recover_shards actually uses (mesh.rebuild_shards is
+    pure transfers; only the install is compiled)."""
+    patch = jnp.asarray(np.asarray(mirror[lo:hi]))
+    return _install(emb, patch, lo)
+
+
+@jax.jit
+def _install(emb, patch, lo):
+    return jax.lax.dynamic_update_slice(emb, patch, (lo, 0))

@@ -4,13 +4,20 @@ stack. Tier-1 runs a 2-plan smoke; the 6-plan soak is marked `slow`
 serve/chaos_serve.py): zero unresolved requests, exactly-one-outcome per
 submission, injected swap faults roll back with the old corpus still serving,
 and p95 stays bounded even in degraded mode.
+
+The chaos-SHARD plans (ISSUE 13) run the mesh-sharded sibling over the 8
+virtual CPU devices conftest pins: tier-1 smokes the two shard-loss families
+(seeds 0-1, one per corpus dtype); the full 4-family soak is `slow`.
 """
 
 import pytest
 
 from dae_rnn_news_recommendation_tpu.serve import (chaos_serve_soak,
+                                                   chaos_shard_soak,
                                                    run_serve_plan,
-                                                   serve_fault_plan)
+                                                   run_shard_plan,
+                                                   serve_fault_plan,
+                                                   shard_fault_plan)
 
 
 def test_fault_plans_are_seeded_and_cover_all_serve_sites():
@@ -48,3 +55,59 @@ def test_chaos_serve_full_soak():
     failing = [r.detail for r in out["results"] if not r.ok]
     assert out["all_ok"], failing
     assert out["n_ok"] == out["n_plans"] == 6
+
+
+# ------------------------------------------------- chaos-shard (ISSUE 13)
+
+def test_shard_fault_plans_are_seeded_and_cover_all_families():
+    a = shard_fault_plan(2)
+    b = shard_fault_plan(2)
+    assert [s.__dict__ for s in a.specs] == [s.__dict__ for s in b.specs]
+    sites = set()
+    for seed in range(4):
+        plan = shard_fault_plan(seed)
+        assert plan.specs
+        sites |= {s.site for s in plan.specs}
+    # two loss families plan the harness directive, two crash families plan
+    # in-line prepare fatals — one per swap flavor
+    assert sites == {"serve.shard", "refresh.swap", "serve.swap"}
+    # the serve.shard directive is harness-applied, never fired in-line
+    for seed in (0, 1):
+        plan = shard_fault_plan(seed)
+        assert plan.harness_specs and not plan.inline_specs
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_chaos_shard_smoke_plan(seed):
+    """Tier-1 shard-loss smoke: seed 0 loses a float32 embedding shard under
+    load (quarantine -> partial_corpus -> blocked swaps -> recover); seed 1
+    loses an int8 corpus's scales shard inside an append's prepare phase
+    (the commit heals it). Both must end bitwise-equal to the fault-free
+    reference with zero torn reads and zero post-warmup compiles."""
+    result = run_shard_plan(seed, n_requests=24)
+    assert result.ok, result.detail
+    assert result.n_replied + result.n_shed + result.n_errors \
+        == result.n_submitted
+    assert result.n_errors == 0 and result.n_shed == 0
+    assert result.bitwise_recovered
+    assert result.n_read_samples > 0
+    assert result.n_post_warm_compiles == 0
+    assert any(e.get("site") == "serve.shard" for e in result.injected)
+    if result.family == "shard-lost-under-load":
+        assert result.n_partial > 0
+        assert 0.0 < result.min_coverage < 1.0
+    else:
+        assert result.n_partial == 0 and result.min_coverage == 1.0
+
+
+@pytest.mark.slow
+def test_chaos_shard_full_soak():
+    out = chaos_shard_soak(n_plans=4, n_requests=24)
+    failing = [f"{r.seed}[{r.family}]: {r.detail}"
+               for r in out["results"] if not r.ok]
+    assert out["all_ok"], failing
+    assert out["n_ok"] == out["n_plans"] == 4
+    families = {r.family for r in out["results"]}
+    assert len(families) == 4
+    dtypes = {r.dtype for r in out["results"]}
+    assert dtypes == {"float32", "int8"}

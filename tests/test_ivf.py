@@ -13,9 +13,10 @@ int8 quantized cells.
 On top: k-means fit/reseed/determinism, the cell-major layout permutation
 invariants, corpus/service wiring (`retrieval="ivf"`), churn composition
 (appends route into existing cells WITHOUT refitting; sustained imbalance
-trips a background reindex), and the ISSUE 11 satellite regression — a
-mesh-sharded slot must REFUSE `swap_incremental` with a clear
-`SwapRejected` instead of corrupting the shard layout.
+trips a background reindex), and the sharded-composition contracts: a
+mesh-sharded slot built from a bare `device_put` closure now APPENDS
+through the two-phase protocol (ISSUE 13 replaced the r11 refusal), while
+ivf + sharded still refuses with the typed `ShardedUnsupported`.
 """
 
 import numpy as np
@@ -434,17 +435,22 @@ def test_reindex_bumps_version_and_keeps_serving_exactly(setup):
 
 # --------------------------------- satellite: sharded slots refuse appends
 
-def test_sharded_slot_rejects_incremental_swap(setup):
+def test_sharded_slot_appends_through_two_phase_swap(setup):
+    """ISSUE 13 replaced the r11 refusal: a slot sharded through a bare
+    `device_put` closure (no mesh= kwarg) appends via the same two-phase
+    prepare -> commit as the mesh= flavor — the row multiple is inferred
+    from the base slot, and the commit stamps every shard uniformly."""
     config, params, articles = setup
     mesh = get_mesh(4)
     corpus = ServingCorpus(config, block=16,
                            device_put=lambda x: shard_rows(x, mesh))
     corpus.swap(params, articles, note="sharded")     # full swap is fine
     assert corpus.version == 1
-    with pytest.raises(SwapRejected, match="sharded slot"):
-        corpus.swap_incremental(
-            params, np.random.default_rng(23).random((4, F),
-                                                     dtype=np.float32))
-    assert corpus.events[-1]["event"] == "swap_rejected_sharded"
-    # the active slot is untouched — still version 1, still serving
-    assert corpus.version == 1 and corpus.active.n == N
+    corpus.swap_incremental(
+        params, np.random.default_rng(23).random((4, F), dtype=np.float32),
+        note="sharded-append")
+    assert corpus.version == 2 and corpus.active.n == N + 4
+    # the appended slot keeps the 4-way row sharding and uniform stamps
+    assert len(corpus.active.emb.sharding.device_set) == 4
+    assert list(corpus.active.shard_versions) == [2] * 4
+    assert corpus.active.emb.shape[0] % 4 == 0

@@ -7,7 +7,8 @@ request that resolves twice (double-count) and one that never resolves
 import pytest
 
 from dae_rnn_news_recommendation_tpu.reliability.ledger import (
-    OutcomeLedger, audit_outcome_counts, audit_version_ledger)
+    OutcomeLedger, audit_outcome_counts, audit_shard_reads,
+    audit_version_ledger)
 
 
 # ------------------------------------------------------------ OutcomeLedger
@@ -157,3 +158,114 @@ def test_version_ledger_repeat_without_revert_is_a_problem():
     _, _, problems = audit_version_ledger(
         [_promote(1), _promote(2), _promote(2)], allow_revert=True)
     assert any("not +1" in p for p in problems)
+
+
+# ------------------------------------- sharded ledger records (ISSUE 13)
+
+def _shards(v, n=4):
+    return {"n": n, "versions": [v] * n}
+
+
+def test_version_ledger_sharded_promotes_clean():
+    ledger = [_promote(1, shards=_shards(1)), _promote(2, shards=_shards(2))]
+    versions, n_rb, problems = audit_version_ledger(ledger)
+    assert versions == [1, 2] and n_rb == 0 and problems == []
+
+
+def test_version_ledger_torn_shard_commit_is_caught():
+    """The failure the two-phase commit exists to prevent: a promote whose
+    per-shard stamps disagree means some shards flipped and some did not."""
+    bad = _promote(2, shards={"n": 4, "versions": [2, 2, 1, 2]})
+    _, _, problems = audit_version_ledger([_promote(1, shards=_shards(1)),
+                                           bad])
+    assert any("torn shard commit" in p for p in problems)
+    # the same stamps also violate the promoted-version equality check
+    assert any("commit must stamp every shard" in p for p in problems)
+
+
+def test_version_ledger_cross_shard_skew_bound():
+    """Stamps more than one version apart are drifted shards, flagged even
+    on a record the other checks would pass over."""
+    bad = _promote(3, shards={"n": 3, "versions": [3, 1, 3]})
+    _, _, problems = audit_version_ledger(
+        [_promote(1, shards=_shards(1)), _promote(2, shards=_shards(2)),
+         bad])
+    assert any("skew" in p for p in problems)
+
+
+def test_version_ledger_recover_record_is_not_a_promote():
+    """A recover record (lost shard re-materialized from the host mirror)
+    is ok=True at an UNCHANGED version: it must neither bump the serving
+    line nor count as a promote, and its shard stamps must match the
+    recovered version."""
+    ledger = [
+        _promote(1, shards=_shards(1)),
+        _promote(2, shards=_shards(2)),
+        {"version": 2, "kind": "shard_degraded", "ok": False,
+         "error": "shard loss: [1] quarantined (coverage 0.750)",
+         "active_version": 2, "coverage": 0.75},
+        {"version": 2, "kind": "recover", "ok": True, "recover": True,
+         "recovered": [1], "shards": _shards(2)},
+        _promote(3, shards=_shards(3)),
+    ]
+    versions, n_rb, problems = audit_version_ledger(ledger)
+    assert versions == [1, 2, 3]  # recover did not enter the promote line
+    assert n_rb == 1              # the degrade record is the only not-ok
+    assert problems == []
+
+
+def test_version_ledger_recover_at_wrong_version_is_caught():
+    ledger = [
+        _promote(1, shards=_shards(1)),
+        {"version": 2, "kind": "recover", "ok": True, "recover": True,
+         "recovered": [0], "shards": _shards(2)},
+    ]
+    _, _, problems = audit_version_ledger(ledger)
+    assert any("recovery must not move the version" in p for p in problems)
+    assert any("never promoted" in p for p in problems)
+
+
+# ------------------------------------------- torn-read audit (ISSUE 13)
+
+def test_shard_reads_uniform_samples_pass():
+    samples = [{"version": v, "shards": [v] * 8} for v in (1, 1, 2, 2, 3)]
+    assert audit_shard_reads(samples) == []
+
+
+def test_shard_reads_catch_torn_and_stale_and_staged():
+    problems = audit_shard_reads([
+        {"version": 2, "shards": [2, 2, 1, 2]},    # torn mix
+        {"version": 3, "shards": [2, 2, 2, 2]},    # stale vs slot version
+        {"version": 2, "shards": [-2, -2, -2, -2]},  # staged sentinel leaked
+    ])
+    assert any("torn cross-shard read" in p for p in problems)
+    assert sum("!= slot version" in p for p in problems) >= 2
+
+
+def test_shard_reads_empty_reader_cannot_vacuously_pass():
+    assert any("never ran" in p for p in audit_shard_reads([]))
+    assert any("no shard stamps" in p
+               for p in audit_shard_reads([{"version": 1, "shards": []}]))
+
+
+def test_partial_corpus_outcomes_counted_exactly_once_with_coverage():
+    """Satellite: degraded partial_corpus replies flow through the same
+    exactly-one-outcome ledger as healthy ones — each carries its coverage
+    fraction, resolves exactly once, and a hedged double-resolve of a
+    degraded reply is still caught."""
+    led = OutcomeLedger()
+    for i in range(6):
+        led.submit(i)
+    for i in range(4):
+        led.resolve(i, "ok", coverage=1.0, partial=False)
+    led.resolve(4, "ok", coverage=0.875, partial=True)
+    led.resolve(5, "ok", coverage=0.875, partial=True)
+    assert led.audit() == []
+    assert led.counts() == {"ok": 6}
+    partial = [r for r in led.records if r.get("partial")]
+    assert len(partial) == 2
+    assert all(0.0 < r["coverage"] < 1.0 for r in partial)
+    # a duplicate resolve of a degraded reply is evidence, not traffic
+    led.resolve(4, "ok", coverage=0.875, partial=True)
+    assert any("double outcome" in p for p in led.audit())
+    assert led.counts() == {"ok": 6}

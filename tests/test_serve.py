@@ -530,3 +530,135 @@ def test_swap_rollback_and_revert_with_concurrent_readers(setup):
         audit_version_ledger)
     _, _, problems = audit_version_ledger(corpus.ledger, allow_revert=True)
     assert problems == []
+
+
+# ------------------------------------------------ revert edges (ISSUE 13)
+
+def test_revert_refuses_without_a_displaced_slot(setup):
+    """Revert is a single-level undo of a promote that DISPLACED a serving
+    slot: after only the initial swap there is nothing to re-install, and
+    a second revert without an intervening promote is equally illegal."""
+    from dae_rnn_news_recommendation_tpu.serve import SwapRejected
+
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)  # v1, nothing displaced
+    with pytest.raises(SwapRejected, match="no previous slot"):
+        corpus.revert(note="nothing to undo")
+    assert corpus.version == 1  # the refusal left the serving line alone
+    corpus.swap(params, articles, note="promote")  # v2 displaces v1
+    corpus.revert(note="legal undo")
+    assert corpus.version == 1
+    with pytest.raises(SwapRejected, match="no previous slot"):
+        corpus.revert(note="double undo")
+    assert corpus.version == 1
+    # the guard is released both times: a follow-up promote works
+    corpus.swap(params, articles, note="after")
+    assert corpus.version == 2
+
+
+def test_revert_racing_concurrent_readers_never_tears(setup):
+    """Readers pinning and re-reading `corpus.active` across a promote ->
+    revert churn loop only ever observe fully-promoted slots, and a slot
+    pinned BEFORE a revert stays scoreable after it."""
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    stop = threading.Event()
+    bad = []
+
+    def reader():
+        while not stop.is_set():
+            slot = corpus.active
+            if slot.version not in (1, 2) or slot.n > slot.valid.shape[0]:
+                bad.append(slot.version)
+
+    threads = [threading.Thread(target=reader, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    pinned = corpus.active
+    try:
+        for i in range(6):
+            corpus.swap(params, articles, note=f"promote-{i}")
+            corpus.revert(note=f"revert-{i}")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert bad == []
+    assert corpus.version == 1
+    fn = make_serve_fn(config, 5, fused=True)
+    _, idx = jax.device_get(
+        fn(params, pinned.emb, pinned.valid, pinned.scales, articles[:2]))
+    np.testing.assert_array_equal(np.asarray(idx)[:, 0], [0, 1])
+
+
+# ------------------------------------- sharded swap/append (ISSUE 13)
+
+def test_ivf_sharded_composition_refused_before_any_device_work():
+    """Satellite regression: retrieval='ivf' + sharded raises the typed
+    ShardedUnsupported (a ValueError subclass) from BOTH constructors
+    BEFORE touching params, corpus, mesh or any device — proven by passing
+    sentinels that would explode on first attribute access."""
+    from dae_rnn_news_recommendation_tpu.serve import ShardedUnsupported
+
+    assert issubclass(ShardedUnsupported, ValueError)
+    config = DAEConfig(n_features=F, n_components=D,
+                       triplet_strategy="none", corr_frac=0.0)
+    with pytest.raises(ShardedUnsupported, match="sharded IVF is future"):
+        ServingCorpus(config, retrieval="ivf", mesh=object())
+    with pytest.raises(ShardedUnsupported, match="sharded IVF is future"):
+        RecommendationService(object(), object(), object(),
+                              retrieval="ivf", sharded=True)
+
+
+def test_sharded_swap_incremental_promotes_with_uniform_shard_stamps(setup):
+    """The ISSUE 13 acceptance path: `swap_incremental` on a mesh-sharded
+    slot SUCCEEDS (the r10 refusal is gone), the append rides the two-phase
+    prepare -> commit (a swap_prepare event stages the shards, the promote
+    stamps every shard to the new version), the ledger stays
+    version-monotonic with uniform per-shard stamps, and the sharded
+    ranking matches a single-device corpus running the same ops."""
+    from dae_rnn_news_recommendation_tpu.parallel.mesh import get_mesh
+    from dae_rnn_news_recommendation_tpu.reliability.ledger import (
+        audit_version_ledger)
+
+    config, params, articles = setup
+    mesh = get_mesh()
+    n_dev = len(jax.devices())
+    batch = np.random.default_rng(77).random((16, F), dtype=np.float32)
+
+    corpus = ServingCorpus(config, block=16, mesh=mesh)
+    corpus.swap(params, articles, note="initial")
+    slot = corpus.swap_incremental(params, batch, max_rows=N,
+                                   note="sharded append")
+    assert corpus.version == 2 and slot.n == N
+    assert slot.shard_versions is not None
+    assert list(slot.shard_versions) == [2] * n_dev
+    prepares = [e for e in corpus.events if e["event"] == "swap_prepare"]
+    assert len(prepares) == 2  # one per two-phase swap (full + incremental)
+    assert prepares[-1]["n_shards"] == n_dev
+    versions, n_rollbacks, problems = audit_version_ledger(corpus.ledger)
+    assert (versions, n_rollbacks, problems) == ([1, 2], 0, [])
+    for rec in corpus.ledger:
+        assert rec["shards"]["versions"] == [rec["version"]] * n_dev
+
+    # ledger + ranking parity with the single-device line of the same ops
+    ref = ServingCorpus(config, block=16)
+    ref.swap(params, articles, note="initial")
+    ref_slot = ref.swap_incremental(params, batch, max_rows=N,
+                                    note="append")
+    ref_versions, _, ref_problems = audit_version_ledger(ref.ledger)
+    assert ref_versions == versions and ref_problems == []
+    assert ref_slot.n == slot.n
+    np.testing.assert_array_equal(slot.ages, ref_slot.ages)
+    from dae_rnn_news_recommendation_tpu.serve import make_sharded_serve_fn
+
+    sharded_fn = make_sharded_serve_fn(config, 5, mesh)
+    flat_fn = make_serve_fn(config, 5, fused=True)
+    queries = articles[:6]
+    _, idx_sharded = jax.device_get(sharded_fn(
+        params, slot.emb, slot.valid, slot.scales, queries))
+    _, idx_flat = jax.device_get(flat_fn(
+        params, ref_slot.emb, ref_slot.valid, ref_slot.scales, queries))
+    np.testing.assert_array_equal(np.asarray(idx_sharded),
+                                  np.asarray(idx_flat))
