@@ -1232,9 +1232,12 @@ def _bench_fleet(jax, params, config, sz):
     which is what makes the hedged-vs-unhedged p99 delta a measured property
     of the hedging discipline instead of scheduler noise. Records the hedged
     headline (fleet_qps, fleet_p50/p95/p99_ms, fleet_shed_rate), the
-    no-hedge p99 on the SAME trace for the delta, and the p95 latency of
-    requests resolved while a staged canary->fleet rollout is actually in
-    flight (rollout_inflight_p95_ms — the cost of refreshing under fire)."""
+    no-hedge p99 on the SAME trace for the delta, the instrumented-vs-bare
+    qps race (`fleet_qps_traced` — same trace with span tracing + metric
+    registries on, gated <3% below `fleet_qps` by evidence/run.py), and the
+    p95 latency of requests resolved while a staged canary->fleet rollout is
+    actually in flight (rollout_inflight_p95_ms — the cost of refreshing
+    under fire)."""
     import threading
 
     import scipy.sparse as sp
@@ -1319,6 +1322,34 @@ def _bench_fleet(jax, params, config, sz):
         out["fleet_shape"] = (
             f"{n_requests} Zipf reqs over {n_replicas} replicas "
             f"(1 straggler +{lag_s * 1e3:.0f}ms), corpus {n_corpus}, {F}->{D}")
+
+        _phase("fleet: instrumented re-replay (tracing-overhead race)")
+        # the same trace through an identically-configured hedged router,
+        # but with full observability on: span tracing enabled, a registry
+        # on the router and every replica. evidence/run.py gates
+        # fleet_qps_traced / fleet_qps — instrumentation must cost <3%.
+        from dae_rnn_news_recommendation_tpu import telemetry
+        from dae_rnn_news_recommendation_tpu.telemetry import MetricsRegistry
+        traced_router = Router(replicas, hedge=True,
+                               default_deadline_s=sla_s,
+                               hedge_delay_floor_s=hedge_floor_s,
+                               hedge_delay_cap_s=hedge_cap_s, seed=17,
+                               registry=MetricsRegistry("bench-router"))
+        for r in replicas:
+            r.attach_registry(MetricsRegistry(f"bench-{r.name}"))
+        telemetry.enable(xla_events=False)
+        try:
+            t_replies, t_wall = replay(traced_router, trace)
+        finally:
+            telemetry.disable()
+            traced_router.stop()
+            for r in replicas:
+                r.attach_registry(None)  # rollout section measures bare
+        t_counts = dict(traced_router.counts)
+        out["fleet_qps_traced"] = round(
+            t_counts["replied"] / max(t_wall, 1e-9), 1)
+        out["fleet_tracing_overhead"] = round(
+            1.0 - out["fleet_qps_traced"] / max(out["fleet_qps"], 1e-9), 4)
 
         _phase("fleet: staged rollout under replay (inflight percentiles)")
         fresh = sp.random(64, F, density=0.005, format="csr",

@@ -1,4 +1,4 @@
-"""jaxcheck rules R1-R13 — AST checkers for the JAX hazard classes this repo
+"""jaxcheck rules R1-R14 — AST checkers for the JAX hazard classes this repo
 has been bitten by (see docs/jaxcheck.md for the catalog with in-repo
 examples of each).
 
@@ -1543,4 +1543,80 @@ def check_r13(ctx):
                 if kw.arg and _r13_deadline_name(kw.arg) and \
                         _r13_wall_call(kw.value):
                     flag(node, f"`{kw.arg}=` argument")
+    return out
+
+
+# ------------------------------------------------------------------- R14
+
+_R14_MUTATORS = {"inc", "observe", "set"}
+_R14_FACTORIES = {"counter", "gauge", "histogram"}
+# identifier parts that mark a receiver as metric state (underscore-split
+# parts, not substrings: `self._stop.set()` carries no metric token and
+# stays clean)
+_R14_TOKENS = {"metric", "metrics", "counter", "counters", "gauge", "gauges",
+               "histogram", "histograms", "registry", "meter"}
+
+
+def _r14_metric_name(name):
+    if not name:
+        return False
+    parts = name.lower().replace(".", "_").split("_")
+    return bool(set(parts) & _R14_TOKENS)
+
+
+@rule("R14", "metric/counter mutation inside jit-traced code")
+def check_r14(ctx):
+    """Telemetry mutation (`registry.counter(...).inc()`, `gauge.set(...)`,
+    `histogram.observe(...)`) inside jit-traced code is a silent lie: the
+    Python side effect runs ONCE at trace time and never again, so after the
+    first call the counter freezes while the compiled computation keeps
+    executing — the registry reports one batch served however many millions
+    ran. (A mutation that also READS a traced value forces a mid-graph host
+    sync on top.) Metrics belong on the host side of the dispatch boundary —
+    serve/service.py increments around its jitted step, never inside.
+    Flagged inside any traced root (and the same-module functions it calls):
+    `.inc()/.observe()/.set()` chained straight off a registry factory
+    (`m.counter("x").inc()`), on a name bound from a factory in the same
+    scope (`c = m.counter("x"); ...; c.inc()`), or on a metric-ish dotted
+    name (`self.metrics.*`, `shed_counter`)."""
+    out = []
+    seen = set()
+    direct, closure = traced_roots(ctx.tree)
+
+    def flag(node, what):
+        if node.lineno in seen:
+            return
+        seen.add(node.lineno)
+        out.append(ctx.finding(
+            node, f"{what} mutates a metric inside jit-traced code — the "
+            "Python side effect runs once at TRACE time, so the metric "
+            "freezes while the compiled function keeps executing; record "
+            "metrics on the host side of the dispatch boundary"))
+
+    for root in direct + closure:
+        bound = set()
+        for node in scope_walk(root):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    isinstance(node.value.func, ast.Attribute) and \
+                    node.value.func.attr in _R14_FACTORIES:
+                for t in node.targets:
+                    d = dotted(t)
+                    if d:
+                        bound.add(d)
+        for node in scope_walk(root):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _R14_MUTATORS):
+                continue
+            recv = node.func.value
+            if isinstance(recv, ast.Call) and \
+                    isinstance(recv.func, ast.Attribute) and \
+                    recv.func.attr in _R14_FACTORIES:
+                flag(node, f"`.{node.func.attr}()` chained off a registry "
+                     "factory")
+                continue
+            d = dotted(recv)
+            if d and (d in bound or _r14_metric_name(d)):
+                flag(node, f"`{d}.{node.func.attr}()`")
     return out

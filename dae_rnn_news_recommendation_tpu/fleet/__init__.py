@@ -33,8 +33,11 @@ fault-site table: docs/reliability.md.
 """
 
 from .chaos_fleet import (FleetPlanResult, chaos_fleet_soak, fleet_fault_plan,
-                          run_fleet_plan)
+                          run_fleet_plan, run_fleet_reference)
 from .loadgen import make_session_trace, replay_trace
+from .observability import (FAMILY_ALERTS, dump_fleet_observability,
+                            fleet_fault_slo_specs, fleet_observability_bundle,
+                            fleet_registries)
 from .replica import HEALTH_STATES, ServiceReplica
 from .rollout import FleetSupervisor
 from .router import Router
@@ -43,5 +46,7 @@ __all__ = [
     "HEALTH_STATES", "ServiceReplica", "Router", "FleetSupervisor",
     "make_session_trace", "replay_trace",
     "FleetPlanResult", "fleet_fault_plan", "run_fleet_plan",
-    "chaos_fleet_soak",
+    "run_fleet_reference", "chaos_fleet_soak",
+    "FAMILY_ALERTS", "fleet_fault_slo_specs", "fleet_registries",
+    "fleet_observability_bundle", "dump_fleet_observability",
 ]

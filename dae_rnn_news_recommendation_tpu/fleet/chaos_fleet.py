@@ -39,6 +39,14 @@ Fleet-wide invariants audited after every plan, whatever was injected:
     families advance every live replica exactly one version.
   * per-replica version ledgers replay clean under the shared
     `audit_version_ledger` (reverts allowed — that is the rollback story).
+  * SLO ATTRIBUTION: every run carries per-replica metric registries and a
+    burn-rate SLOMonitor (telemetry/slo.py); the injected family's
+    zero-tolerance alert (fleet/observability.FAMILY_ALERTS) must FIRE,
+    and `run_fleet_reference` — the fault-free twin of the same trace and
+    rollout — proves the whole spec set stays SILENT when nothing is wrong.
+  * TIMING HONESTY: every resolved request's per-hop timing decomposition
+    (reply.timings: admit/queue/batch/compute/resolve + router share) sums
+    back to its observed latency.
 """
 
 import dataclasses
@@ -54,7 +62,11 @@ from ..reliability.ledger import (OutcomeLedger, audit_outcome_counts,
                                   audit_version_ledger)
 from ..reliability.retry import RetryPolicy
 from ..serve.corpus import ServingCorpus
+from ..telemetry.metrics_registry import MetricsRegistry, aggregate
+from ..telemetry.slo import SLOMonitor, serving_slo_specs
 from .loadgen import make_session_trace, replay_trace
+from .observability import (FAMILY_ALERTS, dump_fleet_observability,
+                            fleet_fault_slo_specs, fleet_registries)
 from .replica import ServiceReplica
 from .rollout import FleetSupervisor
 from .router import Router
@@ -89,6 +101,7 @@ class FleetPlanResult:
     reverted: list
     skipped: list
     injected: list
+    slo_alerts: list
     duration_s: float
 
     def to_dict(self):
@@ -136,6 +149,7 @@ def _make_fleet(seed):
         corpus = ServingCorpus(config, block=32)
         replicas.append(ServiceReplica(
             f"r{i}", params, config, corpus=corpus,
+            registry=MetricsRegistry(f"replica-r{i}"),
             lag_s=_STRAGGLER_LAG_S if i == _N_REPLICAS - 1 else 0.0,
             top_k=5, max_batch=8, max_inflight=16, flush_slack_s=0.02,
             linger_s=0.002, default_deadline_s=_SLA_S,
@@ -144,19 +158,39 @@ def _make_fleet(seed):
     return replicas, params, config, articles
 
 
-def run_fleet_plan(seed, n_requests=48, log=None):
-    """Execute one fault-plan x Zipf-trace x mid-trace-rollout run."""
+def _fleet_slo_monitor():
+    """The chaos harness's monitor: generic serving objectives (thresholds
+    loose enough that the harness's deliberately bursty trace cannot flake
+    the fault-free reference) + one zero-tolerance spec per fault family."""
+    return SLOMonitor(serving_slo_specs(deadline_miss_max=0.2, shed_max=0.2,
+                                        p95_ms_max=4000.0)
+                      + fleet_fault_slo_specs())
+
+
+def _observe(monitor, regs):
+    """One aggregate snapshot into the monitor's ring."""
+    monitor.observe(aggregate([m.snapshot() for m in regs]))
+
+
+def run_fleet_plan(seed, n_requests=48, log=None, dump_path=None):
+    """Execute one fault-plan x Zipf-trace x mid-trace-rollout run.
+    `dump_path` (optional) writes the joined fleet observability bundle
+    (fleet_observability.json shape) there before returning — the
+    `telemetry report --fleet` input."""
     t0 = time.monotonic()
     family = seed % 6
     replicas, params, config, articles = _make_fleet(seed)
     ledger = OutcomeLedger()
     router = Router(replicas, default_deadline_s=_SLA_S, seed=seed,
                     hedge_delay_floor_s=0.002, hedge_delay_cap_s=0.05,
-                    ledger=ledger)
+                    ledger=ledger, registry=MetricsRegistry("router"))
     sup = FleetSupervisor(
         params, config, replicas, router,
+        registry=MetricsRegistry("supervisor"),
         churn=ChurnConfig(microbatch=32, drift_centroid_max=1.0,
                           drift_collapse_max=1.0))
+    monitor = _fleet_slo_monitor()
+    regs = fleet_registries(router=router, replicas=replicas, supervisor=sup)
     plan = fleet_fault_plan(seed, n_requests)
     injector = FaultInjector(plan)
     rng = np.random.default_rng(3000 + seed)
@@ -179,6 +213,9 @@ def run_fleet_plan(seed, n_requests=48, log=None):
         sup.bootstrap(articles)
         for r in replicas:
             r.warmup()
+        # SLO baseline BEFORE any traffic or fault: the burn windows must
+        # see the fault-family counters move from zero
+        _observe(monitor, regs)
         with _faults.install(injector):
             pre_versions = {r.name: r.corpus.version for r in replicas}
             pairs = replay_trace(router, articles, trace[:half])
@@ -195,6 +232,10 @@ def run_fleet_plan(seed, n_requests=48, log=None):
                         timeout=max(0.0, harness_deadline - time.monotonic())))
                 except TimeoutError:
                     unresolved += 1  # a lost request — fails the plan
+        # evaluate BEFORE teardown: stop() sheds stragglers as "shutdown",
+        # and those planned sheds must not pollute the SLO record
+        _observe(monitor, regs)
+        monitor.evaluate()
     finally:
         router.stop()
         for r in replicas:
@@ -220,6 +261,24 @@ def run_fleet_plan(seed, n_requests=48, log=None):
         _, _, led_problems = audit_version_ledger(r.corpus.ledger,
                                                   allow_revert=True)
         problems += [f"{r.name}: {p}" for p in led_problems]
+    # SLO attribution: the injected family's zero-tolerance alert must have
+    # fired (other alerts MAY fire — a kill also sheds, a revert also
+    # aborts; the contract is attribution, and the fault-free reference
+    # replay proves the silent side)
+    alert_names = [a["slo"] for a in monitor.alerts]
+    expected_alert = FAMILY_ALERTS[family]
+    if expected_alert not in alert_names:
+        problems.append(f"SLO alert '{expected_alert}' did not fire for "
+                        f"family {family} (fired: {alert_names or 'none'})")
+    # per-request timing honesty: every resolved request's hop decomposition
+    # sums back to its observed latency (rounding tolerance only — the
+    # stamps are consecutive monotonic reads)
+    for rec in router.records:
+        gap = abs(sum(rec["timings"].values()) - rec["latency_s"])
+        if gap > 1e-3:
+            problems.append(f"request {rec['request_id']}: timings sum off "
+                            f"by {gap * 1e3:.3f} ms")
+            break
     result = FleetPlanResult(
         seed=int(seed), ok=not problems, detail="; ".join(problems) or "ok",
         family=family, n_submitted=counts["submitted"],
@@ -231,8 +290,13 @@ def run_fleet_plan(seed, n_requests=48, log=None):
         versions_seen=[int(v) for v in versions_seen],
         rollout_ok=bool(report["ok"]), rollout_stage=report["stage"],
         reverted=list(report["reverted"]), skipped=list(report["skipped"]),
-        injected=list(injector.fired),
+        injected=list(injector.fired), slo_alerts=alert_names,
         duration_s=round(time.monotonic() - t0, 2))
+    if dump_path is not None:
+        dump_fleet_observability(dump_path, router=router, replicas=replicas,
+                                 supervisor=sup, monitor=monitor,
+                                 ledger=ledger,
+                                 extra={"plan": result.to_dict()})
     if log:
         log(f"fleet plan {seed} (family {family}): "
             f"{'OK' if result.ok else 'FAIL'} ({result.n_replied} ok / "
@@ -279,6 +343,73 @@ def _audit_rollout(family, report, pre_versions, replicas, victim):
                     f"{r.name} at v{now[r.name]}, expected "
                     f"v{pre_versions[r.name] + 1} after a clean rollout")
     return problems
+
+
+def run_fleet_reference(seed, n_requests=48, log=None):
+    """The fault-free twin of `run_fleet_plan`: same fleet shape, same Zipf
+    trace, same mid-trace rollout — NO injector, no kill. The SLO monitor
+    must stay completely silent; any alert here means a spec burns on
+    normal operation and its signal under faults is noise. Returns a dict
+    with `ok`, `alerts`, and the fleet counts."""
+    t0 = time.monotonic()
+    replicas, params, config, articles = _make_fleet(seed)
+    ledger = OutcomeLedger()
+    router = Router(replicas, default_deadline_s=_SLA_S, seed=seed,
+                    hedge_delay_floor_s=0.002, hedge_delay_cap_s=0.05,
+                    ledger=ledger, registry=MetricsRegistry("router"))
+    sup = FleetSupervisor(
+        params, config, replicas, router,
+        registry=MetricsRegistry("supervisor"),
+        churn=ChurnConfig(microbatch=32, drift_centroid_max=1.0,
+                          drift_collapse_max=1.0))
+    monitor = _fleet_slo_monitor()
+    regs = fleet_registries(router=router, replicas=replicas, supervisor=sup)
+    rng = np.random.default_rng(3000 + seed)
+    trace = make_session_trace(seed, n_requests, _N_ARTICLES,
+                               mean_gap_s=0.002, deadline_s=_SLA_S,
+                               deadline_spread=0.2)
+    half = len(trace) // 2
+    unresolved = 0
+    try:
+        sup.bootstrap(articles)
+        for r in replicas:
+            r.warmup()
+        _observe(monitor, regs)
+        pairs = replay_trace(router, articles, trace[:half])
+        fresh = rng.random((32, _N_FEATURES), dtype=np.float32)
+        report = sup.rollout(fresh, note=f"reference-{seed}",
+                             probe_query=articles[0])
+        pairs += replay_trace(router, articles, trace[half:])
+        harness_deadline = time.monotonic() + _HARNESS_DEADLINE_S
+        for _, f in pairs:
+            try:
+                f.result(timeout=max(0.0,
+                                     harness_deadline - time.monotonic()))
+            except TimeoutError:
+                unresolved += 1
+        _observe(monitor, regs)
+        monitor.evaluate()
+    finally:
+        router.stop()
+        for r in replicas:
+            r.stop()
+    problems = list(ledger.audit())
+    if unresolved:
+        problems.append(f"{unresolved} futures never resolved")
+    if not report["ok"]:
+        problems.append(f"fault-free rollout failed: {report['detail']}")
+    if monitor.alerts:
+        problems.append("SLO alerts fired in a fault-free run: "
+                        f"{[a['slo'] for a in monitor.alerts]}")
+    out = {"seed": int(seed), "ok": not problems,
+           "detail": "; ".join(problems) or "ok",
+           "alerts": list(monitor.alerts),
+           "counts": dict(router.counts),
+           "duration_s": round(time.monotonic() - t0, 2)}
+    if log:
+        log(f"fleet reference {seed}: {'OK' if out['ok'] else 'FAIL'} "
+            f"({out['detail']})")
+    return out
 
 
 def chaos_fleet_soak(seeds=(0, 1, 2, 3, 4, 5), n_requests=48, log=None):

@@ -1,0 +1,115 @@
+"""Fleet observability: the fault-family SLO catalog and the joined bundle.
+
+Two jobs live here, both consumers of the pieces built elsewhere
+(telemetry/metrics_registry, telemetry/slo, the router's per-request
+records):
+
+  * `fleet_fault_slo_specs()` — one ZERO-TOLERANCE SLO spec per injectable
+    fleet fault family, each burning on the counter that family (and only
+    that family) increments. `FAMILY_ALERTS` maps chaos_fleet's
+    `seed % 6` family number to the alert that must fire under it: that is
+    the contract the chaos soak audits (alert fires under the fault,
+    stays silent in the fault-free reference replay).
+
+  * `fleet_observability_bundle()` / `dump_fleet_observability()` — the one
+    JSON artifact (`fleet_observability.json`) that `telemetry report
+    --fleet` joins: per-request router records (request id + timing
+    decomposition), every registry snapshot + the fleet aggregate, the SLO
+    monitor's specs/alerts, the rollout history, and the outcome ledger's
+    counts — all keyed so a request id found in a trace can be followed
+    into the table.
+"""
+
+import json
+import os
+
+from ..telemetry.metrics_registry import aggregate
+from ..telemetry.slo import SLOSpec
+
+# chaos_fleet fault family number -> the alert that must fire under it
+FAMILY_ALERTS = {
+    0: "replica-kills",
+    1: "rollout-aborts",
+    2: "fleet-reverts",
+    3: "route-transients",
+    4: "hedge-faults",
+    5: "replica-admission-transients",
+}
+
+
+def fleet_fault_slo_specs(window_s=3600.0):
+    """One zero-tolerance spec per fleet fault family. Objective 0.0 means
+    ANY occurrence inside the window is an infinite burn — these events
+    (an unplanned kill, a rollout abort, a whole-fleet revert, an absorbed
+    transient) must never happen in a healthy run, so one is an alert.
+    The window is generous by default: a chaos plan is seconds long and
+    the baseline must predate its first fault."""
+    zero = dict(short_window_s=float(window_s), long_window_s=float(window_s),
+                fast_burn=1.0, slow_burn=1.0)
+    return (
+        SLOSpec("replica-kills", "rate_max", 0.0,
+                numerator="replica_kills", **zero),
+        SLOSpec("rollout-aborts", "rate_max", 0.0,
+                numerator="rollout_aborts", **zero),
+        SLOSpec("fleet-reverts", "rate_max", 0.0,
+                numerator="fleet_reverts", **zero),
+        SLOSpec("route-transients", "rate_max", 0.0,
+                numerator="route_transient_retries", **zero),
+        SLOSpec("hedge-faults", "rate_max", 0.0,
+                numerator="hedge_faults", **zero),
+        SLOSpec("replica-admission-transients", "rate_max", 0.0,
+                numerator="replica_admission_transients", **zero),
+    )
+
+
+def fleet_registries(router=None, replicas=(), supervisor=None):
+    """The distinct MetricsRegistry objects a fleet carries (router,
+    replicas, supervisor), deduplicated by identity — components may share
+    one registry, and a shared one must be snapshotted (and aggregated)
+    exactly once."""
+    regs = []
+    for obj in (router, *replicas, supervisor):
+        m = getattr(obj, "metrics", None)
+        if m is not None and all(m is not seen for seen in regs):
+            regs.append(m)
+    return regs
+
+
+def fleet_observability_bundle(router=None, replicas=(), supervisor=None,
+                               monitor=None, ledger=None, extra=None):
+    """Join the fleet's observability surfaces into one serializable dict —
+    the `report --fleet` input. Every section is optional and None-safe:
+    whatever the run actually wired shows up, nothing crashes on absence."""
+    regs = fleet_registries(router=router, replicas=replicas,
+                            supervisor=supervisor)
+    snaps = [m.snapshot() for m in regs]
+    bundle = {
+        "requests": (list(router.records) if router is not None else []),
+        "registries": snaps,
+        "aggregate": aggregate(snaps) if snaps else None,
+        "slo": monitor.summary() if monitor is not None else None,
+        "rollout": (list(supervisor.history)
+                    if supervisor is not None else []),
+        "ledger": ({"n_submitted": ledger.n_submitted,
+                    "counts": ledger.counts(),
+                    "problems": list(ledger.audit())}
+                   if ledger is not None else None),
+    }
+    if extra:
+        bundle.update(extra)
+    return bundle
+
+
+def dump_fleet_observability(path, **bundle_kw):
+    """Write the bundle as JSON (atomic tmp+rename, like every other
+    artifact dump in this repo) and return `path`. Dropped as
+    `fleet_observability.json` next to a trace, `telemetry report`
+    auto-detects it."""
+    bundle = fleet_observability_bundle(**bundle_kw)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(bundle, f, indent=2, default=str)
+    os.replace(tmp, path)
+    return path

@@ -52,13 +52,22 @@ class ServiceReplica:
         copy). Built here when None.
     :param lag_s: fixed extra delay added to every reply's resolution — the
         deterministic straggler knob (0 = none).
-    :param service_kw: forwarded to RecommendationService.
+    :param registry: optional telemetry.MetricsRegistry shared with the
+        inner service — the replica adds its own admission/lifecycle
+        counters (replica_admission_transients, replica_kills) on top of
+        the service's request metrics.
+    :param service_kw: forwarded to RecommendationService (the replica's
+        name is forwarded too unless overridden, so request ids and the
+        batcher's trace track carry the replica identity).
     """
 
     def __init__(self, name, params, config, *, corpus=None, lag_s=0.0,
-                 **service_kw):
+                 registry=None, **service_kw):
         self.name = str(name)
+        self.metrics = registry
         self.corpus = corpus if corpus is not None else ServingCorpus(config)
+        service_kw.setdefault("name", self.name)
+        service_kw.setdefault("registry", registry)
         self.service = RecommendationService(params, config, self.corpus,
                                              **service_kw)
         self.lag_s = float(lag_s)
@@ -75,15 +84,19 @@ class ServiceReplica:
             self._delayer.start()
 
     # ------------------------------------------------------------ admission
-    def submit(self, query, deadline_s=None, deadline_at=None):
+    def submit(self, query, deadline_s=None, deadline_at=None,
+               request_id=None):
         """Admit one query; returns a ReplyFuture that always resolves.
         The router passes `deadline_at` (absolute) so hedges and retries
-        spend the ORIGINAL budget, never a fresh one."""
+        spend the ORIGINAL budget, never a fresh one — and `request_id`
+        (its hop-suffixed attempt id) so the Reply stays attributable."""
+        rid = "" if request_id is None else str(request_id)
         if self._dead.is_set() or self._draining.is_set():
             fut = ReplyFuture()
             fut._set(Reply(status="shed",
                            reason=("replica_dead" if self._dead.is_set()
-                                   else "replica_draining")))
+                                   else "replica_draining"),
+                           request_id=rid))
             return fut
         try:
             _faults.fire("fleet.replica", replica=self.name)
@@ -92,18 +105,25 @@ class ServiceReplica:
             # and the router re-enqueues it on a live replica
             self.kill()
             fut = ReplyFuture()
-            fut._set(Reply(status="shed", reason="replica_preempted"))
+            fut._set(Reply(status="shed", reason="replica_preempted",
+                           request_id=rid))
             return fut
         except _faults.TransientFault:
-            pass  # admission blip: the replica still takes the request —
-            # the service's own enqueue/batch retry discipline is downstream
+            # admission blip: the replica still takes the request — the
+            # service's own enqueue/batch retry discipline is downstream.
+            # Counted, because "absorbed" must not mean "invisible": the
+            # zero-tolerance fleet.replica SLO spec burns on this counter.
+            if self.metrics is not None:
+                self.metrics.counter("replica_admission_transients").inc()
         except _faults.InjectedFault as exc:
             fut = ReplyFuture()
             fut._set(Reply(status="error",
-                           reason=f"{type(exc).__name__}: {exc}"))
+                           reason=f"{type(exc).__name__}: {exc}",
+                           request_id=rid))
             return fut
         inner = self.service.submit(query, deadline_s=deadline_s,
-                                    deadline_at=deadline_at)
+                                    deadline_at=deadline_at,
+                                    request_id=request_id)
         if self._delayer is None:
             return inner
         outer = ReplyFuture()
@@ -161,13 +181,18 @@ class ServiceReplica:
         """Stop taking new requests; in-flight ones finish normally."""
         self._draining.set()
 
-    def kill(self, timeout=5.0):
+    def kill(self, timeout=5.0, _clean=False):
         """The crash simulation: mark dead, stop the service (in-flight
         futures resolve as shed("shutdown") — the service's drain-and-join
         contract), and flush the lag mailbox so no outcome is parked
-        forever."""
+        forever. `_clean` marks a planned shutdown (stop()): same mechanics,
+        but it is NOT counted as a kill — the replica_kills counter feeds a
+        zero-tolerance SLO spec, and a fault-free run tearing its fleet down
+        must stay silent."""
         if self._dead.is_set():
             return
+        if not _clean and self.metrics is not None:
+            self.metrics.counter("replica_kills").inc()
         self._dead.set()
         self.service.stop(timeout=timeout)
         if self._delayer is not None:
@@ -180,10 +205,17 @@ class ServiceReplica:
                 outer._set(reply)
 
     def stop(self, timeout=5.0):
-        """Clean shutdown — same mechanics as kill(), different intent."""
-        self.kill(timeout=timeout)
+        """Clean shutdown — same mechanics as kill(), different intent (and
+        not counted as a kill)."""
+        self.kill(timeout=timeout, _clean=True)
 
     # ----------------------------------------------------------- reporting
+    def attach_registry(self, registry):
+        """Late-bind a MetricsRegistry to the replica AND its service."""
+        self.metrics = registry
+        self.service.attach_registry(registry)
+        return registry
+
     def warmup(self):
         self.service.warmup()
 

@@ -46,10 +46,14 @@ class FleetSupervisor:
     :param router: the fleet.Router (for the canary probe).
     :param churn: ChurnConfig for the canary's supervisor.
     :param probe_deadline_s: budget for the canary probe request.
+    :param registry: optional telemetry.MetricsRegistry for rollout
+        lifecycle counters (rollouts, rollout_aborts, fleet_reverts) —
+        zero-tolerance SLO material: an abort or a whole-fleet revert in a
+        supposedly fault-free run is an alert, not a log line.
     """
 
     def __init__(self, params, config, replicas, router, *, churn=None,
-                 probe_deadline_s=5.0, **churn_kw):
+                 probe_deadline_s=5.0, registry=None, **churn_kw):
         assert replicas, "a rollout needs at least one replica"
         self.params = params
         self.config = config
@@ -57,6 +61,8 @@ class FleetSupervisor:
         self.router = router
         self.canary = replicas[0]
         self.probe_deadline_s = float(probe_deadline_s)
+        self.metrics = registry
+        churn_kw.setdefault("registry", registry)
         self.churn = ChurnSupervisor(params, config, self.canary.corpus,
                                      churn=churn or ChurnConfig(),
                                      **churn_kw)
@@ -96,6 +102,10 @@ class FleetSupervisor:
             report["versions"] = {r.name: r.corpus.version
                                   for r in self.replicas}
             report["duration_s"] = round(time.monotonic() - t0, 4)
+            if self.metrics is not None:
+                self.metrics.counter("rollouts").inc()
+                if not ok:
+                    self.metrics.counter("rollout_aborts").inc()
             hook("done" if ok else "aborted")
             self.history.append(report)
             return report
@@ -193,6 +203,8 @@ class FleetSupervisor:
         pre-canary slot. Dead replicas can still revert — the corpus is
         independent of the service — so a killed-then-promoted replica does
         not strand a version."""
+        if self.metrics is not None:
+            self.metrics.counter("fleet_reverts").inc()
         for r in reversed(promoted):
             r.corpus.revert(note=f"rollout-abort:{note}")
             report["reverted"].append(r.name)

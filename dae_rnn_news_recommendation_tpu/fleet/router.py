@@ -65,12 +65,14 @@ _RETRYABLE_SHEDS = frozenset((
 class _FleetRequest:
     """Router-side record of one caller request across all its attempts."""
 
-    __slots__ = ("id", "query", "deadline_at", "t_submit", "future", "lock",
-                 "inflight", "resolved", "retries", "hedged", "parked",
-                 "tried")
+    __slots__ = ("id", "rid", "query", "deadline_at", "t_submit", "future",
+                 "lock", "inflight", "resolved", "retries", "hedged",
+                 "parked", "tried")
 
     def __init__(self, req_id, query, deadline_at, t_submit):
         self.id = req_id
+        self.rid = f"flt-{req_id}"   # trace id; attempts suffix hops:
+        #                              retry -> "/rN", hedge twin -> "/h"
         self.query = query
         self.deadline_at = deadline_at
         self.t_submit = t_submit
@@ -105,12 +107,15 @@ class Router:
     :param ledger: optional reliability.ledger.OutcomeLedger the chaos soak
         audits; the router records one submit and exactly one resolve per
         request into it.
+    :param registry: optional telemetry.MetricsRegistry for the router's own
+        fleet-level metrics (routed/retry/hedge counters, per-replica
+        outstanding gauges, fleet latency histogram). None = no metrics.
     """
 
     def __init__(self, replicas, *, default_deadline_s=1.0, hedge=True,
                  hedge_delay_floor_s=0.005, hedge_delay_cap_s=0.25,
                  hedge_budget_frac=0.1, hedge_burst=4, max_retries=2,
-                 retry=None, seed=0, ledger=None):
+                 retry=None, seed=0, ledger=None, registry=None):
         assert replicas, "a fleet needs at least one replica"
         names = [r.name for r in replicas]
         assert len(set(names)) == len(names), f"duplicate replica names: {names}"
@@ -126,6 +131,7 @@ class Router:
         self.retry = retry if retry is not None else RetryPolicy(
             max_attempts=3, backoff_s=0.001, max_elapsed_s=0.25)
         self.ledger = ledger
+        self.metrics = registry
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()          # counts/latencies/records/rng
         self._out_lock = threading.Lock()      # outstanding counters only —
@@ -160,10 +166,27 @@ class Router:
             self._next_id += 1
             req = _FleetRequest(self._next_id, query, float(deadline_at), now)
             self.counts["submitted"] += 1
+        m = self.metrics
+        if m is not None:
+            # "fleet_" prefix: the per-replica registries already carry
+            # submitted/replied/shed at attempt granularity — the aggregate
+            # sums by name, so the router's request-granularity outcomes
+            # must not fold into them
+            m.counter("fleet_submitted").inc()
         if self.ledger is not None:
             self.ledger.submit(req.id, t_submit=now)
+
+        def route_fire():
+            try:
+                _faults.fire("fleet.route")
+            except _faults.TransientFault:
+                # absorbed by the retry policy below, but never invisibly:
+                # the zero-tolerance fleet.route SLO spec burns on this
+                if m is not None:
+                    m.counter("route_transient_retries").inc()
+                raise
         try:
-            self.retry.run(_faults.fire, "fleet.route", site="fleet.route")
+            self.retry.run(route_fire, site="fleet.route")
         except Exception as exc:
             return self._resolve_direct(
                 req, Reply(status="error",
@@ -204,7 +227,11 @@ class Router:
             oj = self._outstanding[cands[int(j)].name]
         return cands[int(i)] if oi <= oj else cands[int(j)]
 
-    def _dispatch(self, req, replica):
+    def _dispatch(self, req, replica, hop=""):
+        """Issue one attempt. `hop` suffixes the request's trace id ("" for
+        the primary, "/rN" for a cross-replica retry, "/h" for the hedge
+        twin) — all attempts share the parent id, so whichever one wins the
+        exactly-one-outcome race stays attributable in traces and ledger."""
         with req.lock:
             if req.resolved:
                 return
@@ -212,9 +239,15 @@ class Router:
             req.tried.append(replica.name)
         with self._out_lock:
             self._outstanding[replica.name] += 1
+            out_now = self._outstanding[replica.name]
         with self._lock:
             self.counts["routed"] += 1
-        fut = replica.submit(req.query, deadline_at=req.deadline_at)
+        m = self.metrics
+        if m is not None:
+            m.counter("routed").inc()
+            m.gauge(f"outstanding.{replica.name}").set(out_now)
+        fut = replica.submit(req.query, deadline_at=req.deadline_at,
+                             request_id=req.rid + hop)
         fut.add_done_callback(
             lambda reply: self._on_attempt(req, replica, reply))
 
@@ -224,6 +257,9 @@ class Router:
         as discarded, never double-surfaced."""
         with self._out_lock:
             self._outstanding[replica.name] -= 1
+            out_now = self._outstanding[replica.name]
+        if self.metrics is not None:
+            self.metrics.gauge(f"outstanding.{replica.name}").set(out_now)
         redispatch = None
         with req.lock:
             req.inflight -= 1
@@ -255,7 +291,9 @@ class Router:
         if redispatch is not None:
             with self._lock:
                 self.counts["retries"] += 1
-            self._dispatch(req, redispatch)
+            if self.metrics is not None:
+                self.metrics.counter("retries").inc()
+            self._dispatch(req, redispatch, hop=f"/r{req.retries}")
 
     # ------------------------------------------------------------- hedging
     def _hedge_delay(self):
@@ -315,6 +353,8 @@ class Router:
             # primary attempt is untouched and still owns the outcome
             with self._lock:
                 self.counts["hedge_faults"] += 1
+            if self.metrics is not None:
+                self.metrics.counter("hedge_faults").inc()
             return
         cand = self._pick(exclude=set(req.tried))
         if cand is None:
@@ -327,7 +367,9 @@ class Router:
             req.hedged = True
         with self._lock:
             self.counts["hedges"] += 1
-        self._dispatch(req, cand)
+        if self.metrics is not None:
+            self.metrics.counter("hedges").inc()
+        self._dispatch(req, cand, hop="/h")
 
     # ------------------------------------------------------------ terminals
     def _resolve_direct(self, req, reply):
@@ -340,23 +382,45 @@ class Router:
         assert not req.resolved
         req.resolved = True
         now = time.monotonic()
-        final = dataclasses.replace(reply, latency_s=now - req.t_submit,
-                                    deadline_met=now <= req.deadline_at)
+        latency_s = now - req.t_submit
+        # the winning attempt's replica-level timing record, extended with
+        # the router's own share (routing decisions, callback plumbing, the
+        # time a retried request spent on its losing attempts) as the exact
+        # remainder — the fleet decomposition still sums to latency_s
+        timings = dict(reply.timings or {})
+        timings["router_s"] = round(latency_s - sum(timings.values()), 6)
+        final = dataclasses.replace(reply, latency_s=latency_s,
+                                    deadline_met=now <= req.deadline_at,
+                                    request_id=reply.request_id or req.rid,
+                                    timings=timings)
         req.future._set(final)
-        rec = {"id": req.id, "status": final.status, "reason": final.reason,
+        rec = {"id": req.id, "request_id": final.request_id,
+               "status": final.status, "reason": final.reason,
                "replica": replica, "corpus_version": final.corpus_version,
                "hedged": req.hedged, "retries": req.retries,
-               "latency_s": round(final.latency_s, 6), "t_resolved": now}
+               "latency_s": round(final.latency_s, 6),
+               "timings": timings, "t_resolved": now}
+        hedge_win = (final.ok and req.hedged and req.tried
+                     and replica != req.tried[0])
         with self._lock:
             key = {"ok": "replied", "shed": "shed", "error": "errors"}
             self.counts[key[final.status]] += 1
-            if (final.ok and req.hedged and req.tried
-                    and replica != req.tried[0]):
+            if hedge_win:
                 self.counts["hedge_wins"] += 1
             if final.ok:
                 self._latencies.append(final.latency_s)
                 del self._latencies[:-_LATENCY_WINDOW]
             self.records.append(rec)
+        m = self.metrics
+        if m is not None:
+            m.counter({"ok": "fleet_replied", "shed": "fleet_shed",
+                       "error": "fleet_errors"}[final.status]).inc()
+            if hedge_win:
+                m.counter("hedge_wins").inc()
+            if final.ok:
+                m.histogram("fleet_latency_ms").observe(latency_s * 1e3)
+                if not final.deadline_met:
+                    m.counter("fleet_deadline_missed").inc()
         if self.ledger is not None:
             self.ledger.resolve(req.id, final.status, **{
                 k: v for k, v in rec.items() if k not in ("id", "status")})
@@ -373,6 +437,12 @@ class Router:
         self._hedge_thread.join(timeout=timeout)
 
     # ----------------------------------------------------------- reporting
+    def attach_registry(self, registry):
+        """Late-bind a MetricsRegistry (bench attaches for the instrumented
+        leg of the tracing-overhead race)."""
+        self.metrics = registry
+        return registry
+
     def latency_stats(self):
         with self._lock:
             lat = [r["latency_s"] for r in self.records

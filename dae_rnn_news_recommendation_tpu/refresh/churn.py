@@ -97,16 +97,21 @@ class ChurnSupervisor:
         DriftTripped instead of fine-tuning.
     :param retry: RetryPolicy absorbing transient ingest/encode faults
         (default: 3 attempts, small jittered backoff).
+    :param registry: optional telemetry.MetricsRegistry — the supervisor
+        keeps corpus_version / corpus staleness gauges and cycle / drift /
+        rollback counters current so the SLO monitor sees refresh health
+        without reaching into the history list.
     """
 
     def __init__(self, params, config, corpus, *, churn=None, vectorizer=None,
-                 finetune_fn=None, retry=None):
+                 finetune_fn=None, retry=None, registry=None):
         self.params = params
         self.config = config
         self.corpus = corpus
         self.churn = churn or ChurnConfig()
         self.vectorizer = vectorizer
         self.finetune_fn = finetune_fn
+        self.metrics = registry
         self.retry = retry if retry is not None else RetryPolicy(
             max_attempts=3, backoff_s=0.005, max_elapsed_s=0.5)
         self._encode_fn = make_corpus_encode_fn(config)
@@ -159,6 +164,16 @@ class ChurnSupervisor:
             report["action"] = "incremental+finetune_rebuild"
         report["cycle_s"] = round(time.monotonic() - t0, 4)
         self.history.append(report)
+        m = self.metrics
+        if m is not None:
+            m.counter("churn_cycles").inc()
+            if drift is not None and drift["tripped"]:
+                m.counter("drift_trips").inc()
+            if "rollback" in report["action"]:
+                m.counter("corpus_rollbacks").inc()
+            m.gauge("corpus_version").set(self.corpus.version)
+            m.gauge("corpus_staleness").set(
+                getattr(self.corpus, "ivf_stale_cycles", 0) or 0)
         return report
 
     def finetune(self, reason="requested"):

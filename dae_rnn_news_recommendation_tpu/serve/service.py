@@ -74,6 +74,12 @@ class Reply:
     coverage: float = 1.0     # valid-row fraction the answering slot served;
     # < 1.0 exactly when "partial_corpus" is in `degraded` (a shard is lost
     # and the surviving shards answered)
+    request_id: str = ""      # trace id; the fleet router suffixes hops
+    # ("/h" hedge twin, "/rN" retry), so the winning attempt is attributable
+    timings: dict = dataclasses.field(default_factory=dict)
+    # per-hop decomposition in seconds (admit_s, queue_s, batch_form_s,
+    # compute_s, resolve_s — plus router_s at the fleet level): consecutive
+    # monotonic stamps, so the components SUM to latency_s (± rounding)
 
     @property
     def ok(self):
@@ -81,13 +87,21 @@ class Reply:
 
 
 class _Pending:
-    __slots__ = ("query", "deadline", "t_submit", "future")
+    __slots__ = ("query", "deadline", "t_submit", "future", "rid",
+                 "t_admit", "t_dequeue", "t_batch", "compute_s")
 
-    def __init__(self, query, deadline, t_submit):
+    def __init__(self, query, deadline, t_submit, rid=""):
         self.query = query
         self.deadline = deadline
         self.t_submit = t_submit
         self.future = ReplyFuture()
+        self.rid = rid
+        # hop stamps (monotonic), filled as the request moves: admission
+        # decision -> queue -> batch formation -> fenced compute
+        self.t_admit = None
+        self.t_dequeue = None
+        self.t_batch = None
+        self.compute_s = None
 
 
 class ReplyFuture:
@@ -170,13 +184,24 @@ class RecommendationService:
     :param probes: cells scanned per query under `retrieval="ivf"` — baked
         into the compiled variants, so `warmup()` precompiles one program
         per (bucket, k, probes) and probing depth never recompiles live.
+    :param name: service identity — the request-id prefix for locally
+        generated ids and the batcher thread's trace-track suffix, so a
+        fleet of replicas lands on distinguishable Chrome-trace tracks.
+    :param registry: optional telemetry.MetricsRegistry this service
+        mutates on the host side (admission, terminals, batcher loop) —
+        exact counts, unaffected by trace sampling. None = no metrics.
+    :param trace_sample_rate: fraction of `serve/request` terminal spans
+        recorded while tracing is enabled (deterministic every-Nth, 1.0 =
+        keep all, 0.0 = none). Sampling applies ONLY to that zero-length
+        span: batch spans, registry counters, and replies are unaffected.
     """
 
     def __init__(self, params, config, corpus, *, top_k=10,
                  degraded_top_k=None, max_batch=32, max_inflight=64,
                  flush_slack_s=0.02, linger_s=0.005, default_deadline_s=1.0,
                  overload_watermark=0.75, retry=None, fused=True,
-                 sharded=False, mesh=None, retrieval="exact", probes=8):
+                 sharded=False, mesh=None, retrieval="exact", probes=8,
+                 name="svc", registry=None, trace_sample_rate=1.0):
         assert int(top_k) >= 1 and int(max_batch) >= 1
         if retrieval not in ("exact", "ivf"):
             raise ValueError(
@@ -242,12 +267,18 @@ class RecommendationService:
         self.counts = {"submitted": 0, "replied": 0, "shed": 0, "errors": 0,
                        "deadline_missed": 0, "batches": 0}
         self.events = []          # degraded-mode transitions, in order
+        self.name = str(name)
+        self.metrics = registry
+        self.trace_sample_rate = float(trace_sample_rate)
+        self._trace_seen = 0      # terminal spans considered (sampling)
+        self._rid_n = 0           # locally generated request-id sequence
         self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="serve-batcher")
+                                        name=f"serve-batcher[{self.name}]")
         self._thread.start()
 
     # ------------------------------------------------------------ admission
-    def submit(self, query, deadline_s=None, deadline_at=None):
+    def submit(self, query, deadline_s=None, deadline_at=None,
+               request_id=None):
         """Admit one query (dense [F] feature vector). Returns a ReplyFuture
         that ALWAYS resolves — with a reply, an explicit shed, or an error.
 
@@ -256,17 +287,27 @@ class RecommendationService:
         request's absolute deadline so the remaining budget SHRINKS with
         elapsed time instead of resetting — a nearly-expired request is shed
         as provably unmeetable here, never re-queued with a fresh full
-        timeout (ISSUE 12 deadline-propagation fix)."""
+        timeout (ISSUE 12 deadline-propagation fix).
+
+        `request_id` propagates a caller-assigned trace id (the fleet router
+        passes its hop-suffixed attempt ids); None generates one from the
+        service name. The id rides the Reply with a per-hop timing record."""
         now = time.monotonic()
         if deadline_at is not None:
             deadline_s = float(deadline_at) - now
         else:
             deadline_s = (self.default_deadline_s if deadline_s is None
                           else float(deadline_s))
-        p = _Pending(np.asarray(query, np.float32).reshape(-1),
-                     now + deadline_s, now)
         with self._lock:
             self.counts["submitted"] += 1
+            self._rid_n += 1
+            rid = (str(request_id) if request_id is not None
+                   else f"{self.name}-{self._rid_n}")
+        p = _Pending(np.asarray(query, np.float32).reshape(-1),
+                     now + deadline_s, now, rid=rid)
+        m = self.metrics
+        if m is not None:
+            m.counter("submitted").inc()
         if self._stop.is_set():
             return self._shed(p, "shutdown")
         try:
@@ -282,10 +323,16 @@ class RecommendationService:
             # has never answered a batch faster than `floor` — shedding NOW
             # costs the caller nothing and spares the queue
             return self._shed(p, "deadline_unmeetable")
+        # the admission decision is made: stamp BEFORE the enqueue so the
+        # batcher can never dequeue an unstamped request (admit_s = decision
+        # cost, queue_s starts here)
+        p.t_admit = time.monotonic()
         try:
             self._q.put_nowait(p)
         except queue.Full:
             return self._shed(p, "queue_full")
+        if m is not None:
+            m.gauge("queue_depth").set(self._q.qsize())
         if self._stop.is_set() and not self._thread.is_alive():
             # raced a concurrent stop(): the batcher is gone, so nothing will
             # ever pull this queue again — shed the stragglers explicitly
@@ -319,7 +366,9 @@ class RecommendationService:
                     return
                 poll = 0.005
             try:
-                pending.append(self._q.get(timeout=poll))
+                p = self._q.get(timeout=poll)
+                p.t_dequeue = time.monotonic()   # queue wait ends here
+                pending.append(p)
             except queue.Empty:
                 pass
 
@@ -364,6 +413,9 @@ class RecommendationService:
             batch[i] = p.query
         serve_fn = self._serve_fns[k]
         t0 = time.monotonic()
+        for p in live:
+            # batch formation ends / fenced compute begins for every rider
+            p.t_batch = t0
         try:
             with telemetry.span("serve/batch",
                                 args={"n": b, "bucket": int(batch.shape[0]),
@@ -388,6 +440,9 @@ class RecommendationService:
             self.counts["batches"] += 1
             self._floor_s = wall if self._floor_s == 0.0 else min(
                 self._floor_s, wall)
+        for p in live:
+            p.compute_s = wall   # the shared fenced device wall — every
+            # rider paid it; the per-request remainder is resolve_s
         scores = np.asarray(scores)
         indices = np.asarray(indices)
         if not np.all(np.isfinite(scores[:b])):
@@ -404,6 +459,13 @@ class RecommendationService:
                     and "partial_corpus" not in tags):
                 tags.append("partial_corpus")
         coverage = float(getattr(slot, "coverage", 1.0))
+        m = self.metrics
+        if m is not None:
+            m.counter("batches").inc()
+            m.histogram("batch_compute_ms").observe(wall * 1e3)
+            m.gauge("corpus_version").set(slot.version)
+            m.gauge("corpus_coverage").set(coverage)
+            m.gauge("queue_depth").set(self._q.qsize())
         tags = tuple(tags)
         for i, p in enumerate(live):
             self._reply(p, indices[i], scores[i], tags, slot.version,
@@ -456,6 +518,8 @@ class RecommendationService:
             self._degraded = True
             self._record_event("degraded_enter", occupancy=round(occupancy, 3),
                                top_k=self.degraded_top_k)
+            if self.metrics is not None:
+                self.metrics.counter("degraded_enter").inc()
         elif self._degraded and occupancy == 0.0:
             self._degraded = False
             self._record_event("degraded_exit", occupancy=0.0)
@@ -466,6 +530,38 @@ class RecommendationService:
             self.events.append({"event": event, "t": time.monotonic(), **info})
 
     # ------------------------------------------------------------ terminals
+    def _timings(self, p, now):
+        """The per-hop decomposition from the stamps `p` collected on its way
+        through the service. Consecutive monotonic deltas: whatever hops the
+        request reached appear, and `resolve_s` is always the remainder — so
+        the components SUM to `now - t_submit` (± 6-decimal rounding) for
+        every terminal, including sheds that never left admission."""
+        out = {}
+        last = p.t_submit
+        for key, stamp in (("admit_s", p.t_admit), ("queue_s", p.t_dequeue),
+                           ("batch_form_s", p.t_batch)):
+            if stamp is None:
+                break
+            out[key] = stamp - last
+            last = stamp
+        if p.compute_s is not None:
+            out["compute_s"] = p.compute_s
+            last = last + p.compute_s
+        out["resolve_s"] = max(0.0, now - last)
+        return {k: round(v, 6) for k, v in out.items()}
+
+    def _sample_trace(self):
+        """Deterministic every-Nth keep decision for the terminal span."""
+        rate = self.trace_sample_rate
+        if rate >= 1.0:
+            return True
+        if rate <= 0.0:
+            return False
+        period = max(1, int(round(1.0 / rate)))
+        with self._lock:
+            self._trace_seen += 1
+            return (self._trace_seen - 1) % period == 0
+
     def _finish(self, p, reply):
         if not p.future._set(reply):
             return p.future  # lost a shed/shed race: first decision stands
@@ -477,15 +573,32 @@ class RecommendationService:
                     self.counts["deadline_missed"] += 1
                 self._latencies.append(reply.latency_s)
                 del self._latencies[:-_LATENCY_WINDOW]
+        m = self.metrics
+        if m is not None:
+            # exact, sampling-independent: the registry is the record the
+            # SLO monitor burns against, so every terminal lands here
+            m.counter({"ok": "replied", "shed": "shed",
+                       "error": "errors"}[reply.status]).inc()
+            if reply.status == "ok":
+                if not reply.deadline_met:
+                    m.counter("deadline_missed").inc()
+                m.histogram("request_latency_ms").observe(
+                    reply.latency_s * 1e3)
+            elif reply.status == "shed" and reply.reason:
+                m.counter(f"shed.{reply.reason}").inc()
         # a zero-length per-request span: the request's terminal decision
         # lands on the trace timeline next to the batch that produced it
-        with telemetry.span("serve/request", fence=False,
-                            args={"status": reply.status,
-                                  "reason": reply.reason,
-                                  "latency_ms": round(reply.latency_s * 1e3,
-                                                      3),
-                                  "degraded": list(reply.degraded)}):
-            pass
+        # (subject to trace_sample_rate — counters above are not)
+        if self._sample_trace():
+            with telemetry.span("serve/request", fence=False,
+                                args={"id": reply.request_id,
+                                      "status": reply.status,
+                                      "reason": reply.reason,
+                                      "latency_ms": round(
+                                          reply.latency_s * 1e3, 3),
+                                      "timings": reply.timings,
+                                      "degraded": list(reply.degraded)}):
+                pass
         return p.future
 
     def _reply(self, p, indices, scores, degraded, version, coverage=1.0):
@@ -494,17 +607,20 @@ class RecommendationService:
             status="ok", indices=indices, scores=scores,
             latency_s=now - p.t_submit, deadline_met=now <= p.deadline,
             degraded=degraded, corpus_version=version,
-            coverage=float(coverage)))
+            coverage=float(coverage), request_id=p.rid,
+            timings=self._timings(p, now)))
 
     def _shed(self, p, reason):
+        now = time.monotonic()
         return self._finish(p, Reply(
-            status="shed", reason=reason,
-            latency_s=time.monotonic() - p.t_submit))
+            status="shed", reason=reason, latency_s=now - p.t_submit,
+            request_id=p.rid, timings=self._timings(p, now)))
 
     def _error(self, p, detail):
+        now = time.monotonic()
         return self._finish(p, Reply(
-            status="error", reason=detail,
-            latency_s=time.monotonic() - p.t_submit))
+            status="error", reason=detail, latency_s=now - p.t_submit,
+            request_id=p.rid, timings=self._timings(p, now)))
 
     def _slot_args(self, slot):
         """Positional slot operands for the compiled serve variants — the
@@ -561,6 +677,14 @@ class RecommendationService:
             except queue.Empty:
                 break
 
+    def attach_registry(self, registry):
+        """Late-bind a MetricsRegistry (bench attaches after construction so
+        the bare/instrumented race shares one service build path). Counters
+        start from the attach point — they are deltas-over-window material
+        for the SLO monitor, so a zero start is fine."""
+        self.metrics = registry
+        return registry
+
     # ------------------------------------------------------------ reporting
     def latency_stats(self):
         with self._lock:
@@ -578,7 +702,8 @@ class RecommendationService:
         with self._lock:
             counts = dict(self.counts)
             events = list(self.events)
-        return {"counts": counts, "latency": self.latency_stats(),
+        return {"name": self.name, "counts": counts,
+                "latency": self.latency_stats(),
                 "degraded_events": events,
                 "corpus_events": list(self.corpus.events),
                 "corpus_ledger": list(self.corpus.ledger),

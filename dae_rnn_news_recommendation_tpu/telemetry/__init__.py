@@ -22,15 +22,27 @@ regions; jaxcheck R6 flags device work inside them.
 from .health import (drift_health, embedding_health, mining_health,
                      sentinel_metrics)
 from .manifest import build_manifest, read_manifest, write_manifest
+from .metrics_registry import (DEFAULT_LATENCY_BOUNDS_MS, Counter, Gauge,
+                               Histogram, MetricsRegistry, aggregate,
+                               histogram_percentile)
 from .recorder import FlightRecorder, summarize_batch
+from .slo import SLOMonitor, SLOSpec, serving_slo_specs
 from .tracer import (Tracer, counters, current_tracer, device_fence, disable,
                      enable, enabled, instrument, record_transfer, span)
 from .xla_events import XlaEventListener
 
 __all__ = [
+    "Counter",
+    "DEFAULT_LATENCY_BOUNDS_MS",
     "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SLOMonitor",
+    "SLOSpec",
     "Tracer",
     "XlaEventListener",
+    "aggregate",
     "build_manifest",
     "counters",
     "current_tracer",
@@ -40,11 +52,13 @@ __all__ = [
     "embedding_health",
     "enable",
     "enabled",
+    "histogram_percentile",
     "instrument",
     "mining_health",
     "read_manifest",
     "record_transfer",
     "sentinel_metrics",
+    "serving_slo_specs",
     "span",
     "summarize_batch",
     "write_manifest",

@@ -1,7 +1,7 @@
 """CLI: `python -m dae_rnn_news_recommendation_tpu.telemetry report ...`
 
     report <trace.json> [--metrics PATH] [--bench PATH] [--health PATH]
-                        [--churn PATH] [--json]
+                        [--churn PATH] [--fleet [PATH]] [--json]
 
 Prints the per-span p50/p95/total table (with feed-stall and compile-count
 columns) from a trace exported by a traced fit; optionally joins metrics.jsonl
@@ -39,6 +39,10 @@ def main(argv=None):
     rep.add_argument("--churn", default=None,
                      help="churn_history.json dumped by a ChurnSupervisor "
                           "(default: auto-detect next to the trace)")
+    rep.add_argument("--fleet", nargs="?", const="auto", default=None,
+                     help="fleet_observability.json dumped by "
+                          "dump_fleet_observability; bare --fleet (or no "
+                          "flag) auto-detects next to the trace")
     rep.add_argument("--json", action="store_true",
                      help="emit the report as JSON instead of a table")
     args = parser.parse_args(argv)
@@ -46,7 +50,8 @@ def main(argv=None):
     try:
         text, code = report(args.trace, metrics_path=args.metrics,
                             bench_path=args.bench, health_path=args.health,
-                            churn_path=args.churn, as_json=args.json)
+                            churn_path=args.churn, fleet_path=args.fleet,
+                            as_json=args.json)
     except (OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2

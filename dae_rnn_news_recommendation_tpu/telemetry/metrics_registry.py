@@ -1,0 +1,250 @@
+"""Fleet metrics registry: cheap thread-safe counters, gauges, and
+fixed-bucket latency histograms.
+
+The tracer (telemetry/tracer.py) answers "where did the time go" for one
+diagnosed run; this module answers "what is the fleet doing right now" for
+every run. The design constraints are the serving hot path's, not a metrics
+product's:
+
+  * NO PER-OBSERVATION ALLOCATION. A histogram is a fixed list of bucket
+    counts chosen at creation (`bisect` into a precomputed bound tuple);
+    `Counter.inc` / `Gauge.set` touch one int/float under a lock. Nothing
+    appends, nothing resizes, nothing formats — a registry attached to the
+    microbatcher costs nanoseconds per request, so it stays on in
+    production, unlike tracing (a diagnosis mode).
+  * THREAD-SAFE BY LOCK, NOT BY HOPE. `x += 1` on a Python attribute is a
+    read-modify-write — two batcher threads CAN lose increments. Every
+    metric carries its own small lock; `snapshot()` takes each once, so a
+    snapshot is per-metric consistent (counters never tear) without a
+    global stop-the-world.
+  * PER-REPLICA REGISTRIES + ONE FLEET AGGREGATE. Each replica/router owns
+    a named `MetricsRegistry`; `aggregate()` folds their snapshots into the
+    fleet view (counters sum, gauges keep min/max/mean, histogram buckets
+    add) — the shape `telemetry report --fleet` renders and the SLO monitor
+    (telemetry/slo.py) evaluates.
+
+Metric mutation belongs on the HOST side of the serving stack — admission,
+callbacks, the batcher loop. Inside a jitted function an `.inc()` runs once
+at trace time and never again (or re-runs spuriously on retrace); jaxcheck
+R14 flags metric mutation reachable from traced code.
+"""
+
+import threading
+from bisect import bisect_right
+
+# default latency bucket upper bounds, in milliseconds: sub-ms serving
+# replies up through the multi-second straggler tail. The last bucket is
+# open-ended (+inf) by construction.
+DEFAULT_LATENCY_BOUNDS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0)
+
+
+class Counter:
+    """Monotonic event count. `inc(n)` only — a counter never goes down
+    (rates are computed from deltas by the SLO monitor's windows)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (queue depth, corpus version,
+    coverage). `None` until first set — a snapshot distinguishes "never
+    observed" from 0."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = None
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket distribution. Buckets are chosen ONCE at creation
+    (upper bounds, ascending; a final +inf bucket is implicit), so
+    `observe()` is a bisect + one increment — no allocation, no resize.
+    Tracks count/sum/min/max exactly; percentiles are bucket estimates
+    (linear interpolation within the landing bucket)."""
+
+    __slots__ = ("name", "bounds", "_lock", "_counts", "_count", "_sum",
+                 "_min", "_max")
+
+    def __init__(self, name, bounds=DEFAULT_LATENCY_BOUNDS_MS):
+        bounds = tuple(float(b) for b in bounds)
+        assert bounds == tuple(sorted(bounds)) and bounds, (
+            f"histogram bounds must be ascending and non-empty: {bounds}")
+        self.name = name
+        self.bounds = bounds
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(bounds) + 1)  # last = overflow (+inf)
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+
+    def observe(self, value):
+        value = float(value)
+        idx = bisect_right(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._count += 1
+            self._sum += value
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    def state(self):
+        """One consistent read of the whole distribution."""
+        with self._lock:
+            return {"bounds": list(self.bounds),
+                    "counts": list(self._counts), "count": self._count,
+                    "sum": round(self._sum, 6), "min": self._min,
+                    "max": self._max}
+
+    def percentile(self, q):
+        """Bucket-estimated q-th percentile (None when empty)."""
+        return histogram_percentile(self.state(), q)
+
+
+def histogram_percentile(state, q):
+    """q-th percentile estimate from a histogram snapshot/state dict:
+    nearest-rank into the cumulative bucket counts, linearly interpolated
+    within the landing bucket. The overflow bucket reports the observed max
+    (the honest answer for an open-ended bucket). None when empty."""
+    counts = state.get("counts") or []
+    total = state.get("count") or 0
+    if not total:
+        return None
+    bounds = state["bounds"]
+    rank = max(1, int(round(q / 100.0 * total)))
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= rank:
+            if i >= len(bounds):       # overflow bucket: no upper bound
+                return state["max"]
+            lo = bounds[i - 1] if i > 0 else min(
+                state["min"] if state["min"] is not None else 0.0, bounds[i])
+            frac = (rank - cum) / c
+            return round(lo + (bounds[i] - lo) * frac, 6)
+        cum += c
+    return state["max"]
+
+
+class MetricsRegistry:
+    """One component's named metrics (a replica, the router, the fleet
+    supervisor). `counter/gauge/histogram` are create-or-get, so call sites
+    never coordinate registration; `snapshot()` is the serializable view
+    every consumer (SLO monitor, report --fleet, chaos audits) reads."""
+
+    def __init__(self, name="default"):
+        self.name = str(name)
+        self._lock = threading.Lock()   # metric-map mutations only
+        self._counters = {}
+        self._gauges = {}
+        self._histograms = {}
+
+    def _get(self, table, name, factory):
+        m = table.get(name)             # lock-free fast path (dict reads
+        if m is not None:               # are atomic under the GIL)
+            return m
+        with self._lock:
+            return table.setdefault(name, factory())
+
+    def counter(self, name):
+        return self._get(self._counters, name, lambda: Counter(name))
+
+    def gauge(self, name):
+        return self._get(self._gauges, name, lambda: Gauge(name))
+
+    def histogram(self, name, bounds=DEFAULT_LATENCY_BOUNDS_MS):
+        return self._get(self._histograms, name,
+                         lambda: Histogram(name, bounds=bounds))
+
+    def snapshot(self):
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {"registry": self.name,
+                "counters": {n: c.value for n, c in sorted(counters.items())},
+                "gauges": {n: g.value for n, g in sorted(gauges.items())},
+                "histograms": {n: h.state()
+                               for n, h in sorted(histograms.items())}}
+
+
+def aggregate(snapshots, name="fleet"):
+    """Fold per-component snapshots into one fleet-level snapshot: counters
+    sum, gauges keep {min, max, mean} across components that observed them,
+    histograms with IDENTICAL bounds merge bucket-wise (mismatched bounds
+    keep the first and note the skip — never a crash mid-report)."""
+    counters, gauge_vals, hists, notes = {}, {}, {}, []
+    for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue
+        for n, v in (snap.get("counters") or {}).items():
+            counters[n] = counters.get(n, 0) + int(v)
+        for n, v in (snap.get("gauges") or {}).items():
+            if v is not None:
+                gauge_vals.setdefault(n, []).append(float(v))
+        for n, st in (snap.get("histograms") or {}).items():
+            if n not in hists:
+                hists[n] = {"bounds": list(st["bounds"]),
+                            "counts": list(st["counts"]),
+                            "count": st["count"], "sum": st["sum"],
+                            "min": st["min"], "max": st["max"]}
+                continue
+            agg = hists[n]
+            if agg["bounds"] != list(st["bounds"]):
+                notes.append(f"histogram {n}: mismatched bounds across "
+                             "registries — kept the first, skipped "
+                             f"{snap.get('registry')}")
+                continue
+            agg["counts"] = [a + b for a, b in zip(agg["counts"],
+                                                   st["counts"])]
+            agg["count"] += st["count"]
+            agg["sum"] = round(agg["sum"] + st["sum"], 6)
+            for key, pick in (("min", min), ("max", max)):
+                vals = [v for v in (agg[key], st[key]) if v is not None]
+                agg[key] = pick(vals) if vals else None
+    gauges = {n: {"min": min(vs), "max": max(vs),
+                  "mean": round(sum(vs) / len(vs), 6)}
+              for n, vs in gauge_vals.items()}
+    out = {"registry": name, "n_sources": len(snapshots),
+           "counters": dict(sorted(counters.items())),
+           "gauges": dict(sorted(gauges.items())),
+           "histograms": dict(sorted(hists.items()))}
+    if notes:
+        out["notes"] = notes
+    return out

@@ -23,7 +23,12 @@ omitted a `health_bundle.json` sitting next to the trace is picked up
 automatically. `--churn` renders a refresh-loop history (refresh/churn.py
 `ChurnSupervisor.dump_history`) — per-action cycle counts, drift extremes vs
 trips, promoted-version span, and the swap/encode latency rollup — with the
-same next-to-the-trace auto-detection (`churn_history.json`).
+same next-to-the-trace auto-detection (`churn_history.json`). `--fleet`
+renders a serving-fleet observability bundle (fleet/observability.py
+`dump_fleet_observability`) — the per-request join table (request id, status,
+replica, latency and its timing decomposition), the fleet-aggregate
+counter/gauge rollup, SLO alerts, rollout stages, and the outcome-ledger
+cross-check — auto-detecting `fleet_observability.json` next to the trace.
 
 Optional sections degrade gracefully: an unreadable metrics/bench/health
 input becomes a warning note in the report instead of an error, and a trace
@@ -126,6 +131,18 @@ def load_churn(path):
         obj = {"history": obj}
     if not isinstance(obj, dict) or not isinstance(obj.get("history"), list):
         raise ValueError(f"{path}: not a churn history dump")
+    return obj
+
+
+def load_fleet(path):
+    """A fleet observability bundle (fleet/observability.py
+    dump_fleet_observability): per-request router records, registry
+    snapshots + aggregate, SLO summary, rollout history, ledger counts."""
+    with open(path, encoding="utf-8") as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict) or not any(
+            k in obj for k in ("requests", "registries", "aggregate")):
+        raise ValueError(f"{path}: not a fleet observability bundle")
     return obj
 
 
@@ -308,6 +325,89 @@ def churn_summary(dump):
     return out
 
 
+# per-request timing components, in hop order (serve/service.py _timings +
+# the router's remainder) — the decomposition that sums to latency_s
+_TIMING_KEYS = ("admit_s", "queue_s", "batch_form_s", "compute_s",
+                "resolve_s", "router_s")
+
+
+def fleet_summary(bundle, max_rows=12):
+    """Join a fleet observability bundle into the serving story: per-request
+    rows keyed by request id (status, replica, hop counts, latency and its
+    timing decomposition), the fleet-aggregate counter/gauge rollup, SLO
+    alerts, rollout stages, and the outcome-ledger cross-check (table rows
+    vs ledger submissions — the exactly-one-outcome contract, joined)."""
+    if not bundle:
+        return None
+    reqs = bundle.get("requests") or []
+    rows, statuses = [], {}
+    comp_tot = {k: 0.0 for k in _TIMING_KEYS}
+    comp_n = 0
+    for rec in reqs:
+        t = rec.get("timings") or {}
+        status = rec.get("status", "?")
+        statuses[status] = statuses.get(status, 0) + 1
+        if status == "ok" and t:
+            comp_n += 1
+            for k in _TIMING_KEYS:
+                comp_tot[k] += t.get(k, 0.0)
+        rows.append({
+            "request_id": rec.get("request_id") or str(rec.get("id", "?")),
+            "status": status,
+            "replica": rec.get("replica"),
+            "hedged": bool(rec.get("hedged")),
+            "retries": rec.get("retries", 0),
+            "latency_ms": round(1e3 * (rec.get("latency_s") or 0.0), 2),
+            "timings_ms": {k: round(1e3 * t[k], 2)
+                           for k in _TIMING_KEYS if k in t},
+        })
+    out = {"n_requests": len(rows), "statuses": statuses,
+           "requests": rows[:max_rows],
+           "n_rows_omitted": max(0, len(rows) - max_rows)}
+    if comp_n:
+        out["timing_means_ms"] = {
+            k: round(1e3 * comp_tot[k] / comp_n, 3) for k in _TIMING_KEYS}
+        out["timing_n_replied"] = comp_n
+    agg = bundle.get("aggregate")
+    if isinstance(agg, dict):
+        out["registries"] = [s.get("registry", "?")
+                             for s in bundle.get("registries") or []]
+        out["counters"] = agg.get("counters") or {}
+        gauges = {}
+        for name, g in (agg.get("gauges") or {}).items():
+            gauges[name] = (round(g["mean"], 4)
+                            if isinstance(g, dict) and "mean" in g else g)
+        out["gauges"] = gauges
+    slo = bundle.get("slo")
+    if isinstance(slo, dict):
+        out["slo_alerts"] = [
+            {"slo": a.get("slo"), "short_burn": a.get("short_burn"),
+             "long_burn": a.get("long_burn")}
+            for a in slo.get("alerts") or []]
+        out["slo_n_specs"] = len(slo.get("specs") or [])
+    rollout = bundle.get("rollout") or []
+    stages = []
+    for rep in rollout:
+        stage = {"action": rep.get("action", "?")}
+        for k in ("ok", "stage", "note"):
+            if k in rep:
+                stage[k] = rep[k]
+        if rep.get("reverted"):
+            stage["reverted"] = rep["reverted"]
+        stages.append(stage)
+    if stages:
+        out["rollout"] = stages
+    ledger = bundle.get("ledger")
+    if isinstance(ledger, dict):
+        out["ledger"] = {"n_submitted": ledger.get("n_submitted"),
+                         "counts": ledger.get("counts") or {},
+                         "n_problems": len(ledger.get("problems") or [])}
+        # the join check: every router record must be a ledger submission
+        if isinstance(ledger.get("n_submitted"), int):
+            out["ledger"]["join_ok"] = (ledger["n_submitted"] == len(rows))
+    return out
+
+
 def faults_summary(manifest):
     """The manifest's `faults` section (models/estimator.py
     `_write_fault_manifest`): injected chaos faults, recorded I/O retries,
@@ -346,8 +446,78 @@ def _fmt_row(values, widths):
     return "  ".join(cells).rstrip()
 
 
+def _render_fleet(fleet, lines):
+    head = f"serving fleet: {fleet['n_requests']} requests"
+    if fleet.get("statuses"):
+        head += " (" + ", ".join(f"{k} x{v}" for k, v in
+                                 sorted(fleet["statuses"].items())) + ")"
+    lines.append(head)
+    if fleet.get("registries"):
+        lines.append("  registries: " + ", ".join(fleet["registries"]))
+    means = fleet.get("timing_means_ms")
+    if means:
+        parts = [f"{k[:-2]} {means[k]:.3f}" for k in _TIMING_KEYS
+                 if k in means]
+        lines.append(f"  timing means over {fleet['timing_n_replied']} "
+                     "replied (ms): " + "  ".join(parts))
+    reqs = fleet.get("requests") or []
+    if reqs:
+        lines.append("  request join (id / status / replica / lat ms / "
+                     "compute ms / retries / hedged):")
+        for r in reqs:
+            t = r.get("timings_ms") or {}
+            lines.append(
+                f"    {r['request_id']:<12} {r['status']:<8} "
+                f"{str(r.get('replica') or '-'):<6} "
+                f"{r['latency_ms']:>8.2f} "
+                f"{t.get('compute_s', 0.0):>8.2f} "
+                f"{r.get('retries', 0):>3} "
+                f"{'h' if r.get('hedged') else '-'}")
+        if fleet.get("n_rows_omitted"):
+            lines.append(f"    ... {fleet['n_rows_omitted']} more")
+    if fleet.get("counters"):
+        items = ", ".join(f"{k}={v}" for k, v in
+                          sorted(fleet["counters"].items()))
+        lines.append(f"  counters: {items}")
+    if fleet.get("gauges"):
+        items = ", ".join(f"{k}={v}" for k, v in
+                          sorted(fleet["gauges"].items()))
+        lines.append(f"  gauges (fleet mean): {items}")
+    if "slo_alerts" in fleet:
+        alerts = fleet["slo_alerts"]
+        if alerts:
+            names = ", ".join(
+                f"{a['slo']} (burn {a.get('short_burn')})" for a in alerts)
+            lines.append(f"  SLO alerts ({fleet.get('slo_n_specs', '?')} "
+                         f"specs): {names}")
+        else:
+            lines.append(f"  SLO alerts: none "
+                         f"({fleet.get('slo_n_specs', '?')} specs quiet)")
+    for stage in fleet.get("rollout") or ():
+        bits = [stage["action"]]
+        if "note" in stage:
+            bits.append(stage["note"])
+        if "stage" in stage:
+            bits.append(f"stage={stage['stage']}")
+        if "ok" in stage:
+            bits.append(f"ok={stage['ok']}")
+        if "reverted" in stage:
+            bits.append(f"reverted={','.join(stage['reverted'])}")
+        lines.append("  rollout: " + "  ".join(bits))
+    ledger = fleet.get("ledger")
+    if ledger:
+        line = (f"  ledger: {ledger['n_submitted']} submitted, counts "
+                + ", ".join(f"{k} x{v}" for k, v in
+                            sorted(ledger["counts"].items()))
+                + f", problems {ledger['n_problems']}")
+        if "join_ok" in ledger:
+            line += ("  [join ok]" if ledger["join_ok"]
+                     else "  [JOIN MISMATCH vs request table]")
+        lines.append(line)
+
+
 def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
-                health=None, faults=None, churn=None, notes=None):
+                health=None, faults=None, churn=None, fleet=None, notes=None):
     lines = []
     if manifest:
         lines.append("run: git %s  backend=%s  feed=%s  created %s" % (
@@ -466,11 +636,14 @@ def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
                 if k in churn]
         if tail:
             lines.append("  supervisor: " + "  ".join(tail))
+    if fleet:
+        lines.append("")
+        _render_fleet(fleet, lines)
     return "\n".join(lines)
 
 
 def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
-           churn_path=None, as_json=False):
+           churn_path=None, fleet_path=None, as_json=False):
     """Build the report. Returns (text, exit_code).
 
     The trace is the report's backbone — an unreadable trace still raises
@@ -478,7 +651,13 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
     gracefully: a missing/garbled metrics, bench, or health file becomes a
     `note:` line and its section is skipped, and a trace with zero span
     events renders a partial report as long as some other section loaded
-    (empty AND alone stays exit 1)."""
+    (empty AND alone stays exit 1).
+
+    `fleet_path` follows the health/churn contract with one refinement:
+    None auto-detects `fleet_observability.json` next to the trace and stays
+    SILENT when it isn't there (an r12-era run directory renders exactly as
+    before); the sentinel "auto" (the CLI's bare `--fleet`) also auto-detects
+    but notes the absence, since the section was explicitly asked for."""
     trace = load_trace(trace_path)
     rows = span_table(trace)
     meta = trace.get("metadata", {}) or {}
@@ -522,16 +701,29 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
                             "churn_history.json")
         churn_path = cand if os.path.exists(cand) else None
     churn = churn_summary(optional(churn_path, load_churn, "churn history"))
+    if fleet_path in (None, "auto"):
+        cand = os.path.join(os.path.dirname(os.path.abspath(trace_path)),
+                            "fleet_observability.json")
+        if os.path.exists(cand):
+            fleet_path = cand
+        elif fleet_path == "auto":
+            notes.append("fleet bundle unavailable, section skipped "
+                         "(no fleet_observability.json next to trace)")
+            fleet_path = None
+        else:
+            fleet_path = None
+    fleet = fleet_summary(optional(fleet_path, load_fleet, "fleet bundle"))
     faults = faults_summary(manifest)
     if as_json:
         return json.dumps({"spans": rows, "counters": counters,
                            "manifest": manifest, "metrics": metrics,
                            "bench": bench, "health": health,
                            "faults": faults, "churn": churn,
-                           "notes": notes or None},
+                           "fleet": fleet, "notes": notes or None},
                           indent=2, default=str), 0
-    if not rows and not (metrics or bench or health or churn):
+    if not rows and not (metrics or bench or health or churn or fleet):
         return "no span events in trace", 1
     return render_text(rows, counters=counters, manifest=manifest,
                        metrics=metrics, bench=bench, health=health,
-                       faults=faults, churn=churn, notes=notes), 0
+                       faults=faults, churn=churn, fleet=fleet,
+                       notes=notes), 0

@@ -1,0 +1,261 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+An SLO here is a statement about the REGISTRY (telemetry/metrics_registry),
+not about one request: "deadline-miss rate <= 2%", "coverage >= 0.99",
+"p95 <= 250 ms". The monitor samples registry snapshots over time and
+evaluates each spec over TWO rolling windows — the Google-SRE multi-window
+burn-rate discipline:
+
+  * the LONG window proves the burn is sustained (one slow request cannot
+    page anyone);
+  * the SHORT window proves it is STILL happening (an alert stops firing
+    soon after the bleeding stops, instead of dragging the long window's
+    memory around).
+
+An alert fires only when BOTH windows burn past their thresholds
+(`fast_burn` for short, `slow_burn` for long), where burn = observed error
+rate / objective. Zero-objective specs ("this event class must never
+happen": an injected hedge fault, an unplanned replica kill) treat ANY
+occurrence in the window as an infinite burn — the chaos soaks use these
+to pin one alert per injected fault family, and their fault-free reference
+replays to prove the monitor stays silent when nothing is wrong.
+
+Rates are computed from COUNTER DELTAS between snapshots (counters are
+monotonic), never from raw totals — so a long-running fleet's ancient
+errors cannot hold an alert open. Gauges (coverage) and histogram
+percentiles (latency) are evaluated on the freshest snapshot inside each
+window. Alerts are recorded once per breach episode (firing -> resolved ->
+firing again records twice), with the burn numbers that justified them —
+they land in the chaos ledger/manifest, not a pager.
+"""
+
+import dataclasses
+import threading
+import time
+
+from .metrics_registry import histogram_percentile
+
+_RING_MAX = 4096   # bounded observation history, like every other buffer
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One declarative objective.
+
+    :param name: stable alert id ("deadline-miss-rate", "hedge-faults").
+    :param kind: "rate_max" (numerator/denominator counters, objective is
+        the max acceptable ratio; objective 0.0 = the event must never
+        happen), "gauge_min" (gauge must stay >= objective), or
+        "latency_max" (histogram percentile must stay <= objective, in the
+        histogram's own unit).
+    :param objective: the target (ratio / floor / ceiling by kind).
+    :param numerator / denominator: counter names for "rate_max"
+        (denominator "" with objective 0.0 = pure event count).
+    :param gauge: gauge name for "gauge_min".
+    :param histogram: histogram name for "latency_max".
+    :param percentile: which percentile "latency_max" checks.
+    :param short_window_s / long_window_s: the two rolling windows.
+    :param fast_burn / slow_burn: burn-rate thresholds (short AND long must
+        both breach for the alert to fire).
+    """
+
+    name: str
+    kind: str
+    objective: float
+    numerator: str = ""
+    denominator: str = ""
+    gauge: str = ""
+    histogram: str = ""
+    percentile: float = 95.0
+    short_window_s: float = 60.0
+    long_window_s: float = 300.0
+    fast_burn: float = 2.0
+    slow_burn: float = 1.0
+
+    def __post_init__(self):
+        assert self.kind in ("rate_max", "gauge_min", "latency_max"), (
+            f"unknown SLO kind {self.kind!r}")
+        assert self.short_window_s <= self.long_window_s
+
+
+class SLOMonitor:
+    """Evaluates SLOSpecs over a ring of timestamped registry snapshots.
+
+    Feed it with `observe(snapshot)` (typically the fleet aggregate) at
+    whatever cadence the harness likes, then `evaluate()` — every call
+    re-derives each spec's state and records an alert on the inactive ->
+    firing edge. Thread-safe; `alerts` / `summary()` are the outputs the
+    chaos audits and `report --fleet` consume."""
+
+    def __init__(self, specs, clock=time.monotonic):
+        self.specs = tuple(specs)
+        names = [s.name for s in self.specs]
+        assert len(set(names)) == len(names), f"duplicate SLO names: {names}"
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ring = []        # (t, snapshot), append order == time order
+        self._active = set()   # spec names currently firing
+        self.alerts = []       # append-only firing records
+
+    # ---------------------------------------------------------- observation
+    def observe(self, snapshot, t=None):
+        t = self._clock() if t is None else float(t)
+        with self._lock:
+            self._ring.append((t, snapshot))
+            del self._ring[:-_RING_MAX]
+        return t
+
+    # ----------------------------------------------------------- evaluation
+    def evaluate(self, now=None):
+        """Evaluate every spec; returns the list of alerts NEWLY fired by
+        this call (all alerts accumulate on `self.alerts`)."""
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            ring = list(self._ring)
+        if not ring:
+            return []
+        fired = []
+        for spec in self.specs:
+            state = self._evaluate_spec(spec, ring, now)
+            with self._lock:
+                if state["breached"] and spec.name not in self._active:
+                    self._active.add(spec.name)
+                    alert = {"slo": spec.name, "kind": spec.kind,
+                             "objective": spec.objective, "t": round(now, 6),
+                             **state["evidence"]}
+                    self.alerts.append(alert)
+                    fired.append(alert)
+                elif not state["breached"]:
+                    self._active.discard(spec.name)
+        return fired
+
+    def _evaluate_spec(self, spec, ring, now):
+        if spec.kind == "rate_max":
+            return self._eval_rate(spec, ring, now)
+        if spec.kind == "gauge_min":
+            return self._eval_gauge(spec, ring, now)
+        return self._eval_latency(spec, ring, now)
+
+    # one window's (baseline, latest) snapshot pair: the baseline is the
+    # newest sample AT OR BEFORE the window start (so a delta spans the
+    # whole window), falling back to the oldest sample when the monitor is
+    # younger than the window
+    @staticmethod
+    def _window(ring, now, window_s):
+        start = now - window_s
+        baseline = ring[0]
+        for t, snap in ring:
+            if t <= start:
+                baseline = (t, snap)
+            else:
+                break
+        return baseline, ring[-1]
+
+    @staticmethod
+    def _counter(snapshot, name):
+        return int((snapshot.get("counters") or {}).get(name, 0) or 0)
+
+    def _eval_rate(self, spec, ring, now):
+        burns, evidence = [], {}
+        for label, window_s, threshold in (
+                ("short", spec.short_window_s, spec.fast_burn),
+                ("long", spec.long_window_s, spec.slow_burn)):
+            (t0, base), (t1, last) = self._window(ring, now, window_s)
+            num = self._counter(last, spec.numerator) - self._counter(
+                base, spec.numerator)
+            if spec.denominator:
+                den = self._counter(last, spec.denominator) - self._counter(
+                    base, spec.denominator)
+            else:
+                den = None
+            if spec.objective <= 0.0:
+                # zero-tolerance: any occurrence is an infinite burn
+                burn = float("inf") if num > 0 else 0.0
+                rate = num
+            else:
+                rate = (num / den) if den else 0.0
+                burn = rate / spec.objective
+            evidence[f"{label}_burn"] = (round(burn, 4)
+                                         if burn != float("inf") else "inf")
+            evidence[f"{label}_value"] = round(rate, 6) if den else num
+            burns.append(burn >= threshold and (num > 0 or burn > 0))
+        return {"breached": all(burns), "evidence": evidence}
+
+    def _gauge_in(self, snapshot, name):
+        g = (snapshot.get("gauges") or {}).get(name)
+        if isinstance(g, dict):      # fleet aggregate form: {min,max,mean}
+            return g.get("min")
+        return g
+
+    def _eval_gauge(self, spec, ring, now):
+        _, (t1, last) = self._window(ring, now, spec.long_window_s)
+        val = self._gauge_in(last, spec.gauge)
+        breached = val is not None and float(val) < spec.objective
+        return {"breached": breached,
+                "evidence": {"gauge": spec.gauge,
+                             "value": None if val is None else round(
+                                 float(val), 6)}}
+
+    def _eval_latency(self, spec, ring, now):
+        burns, evidence = [], {}
+        for label, window_s, threshold in (
+                ("short", spec.short_window_s, spec.fast_burn),
+                ("long", spec.long_window_s, spec.slow_burn)):
+            (t0, base), (t1, last) = self._window(ring, now, window_s)
+            delta = _histogram_delta(
+                (last.get("histograms") or {}).get(spec.histogram),
+                (base.get("histograms") or {}).get(spec.histogram))
+            p = (histogram_percentile(delta, spec.percentile)
+                 if delta else None)
+            burn = 0.0 if p is None or spec.objective <= 0 else (
+                p / spec.objective)
+            evidence[f"{label}_p{spec.percentile:g}"] = p
+            evidence[f"{label}_burn"] = round(burn, 4)
+            burns.append(burn >= threshold)
+        return {"breached": all(burns), "evidence": evidence}
+
+    # ------------------------------------------------------------ reporting
+    def summary(self):
+        """Manifest/report fragment: the declared specs and every alert."""
+        with self._lock:
+            return {"specs": [dataclasses.asdict(s) for s in self.specs],
+                    "alerts": list(self.alerts),
+                    "active": sorted(self._active),
+                    "n_observations": len(self._ring)}
+
+
+def _histogram_delta(last, base):
+    """Window delta of two histogram states (bucket-wise subtraction).
+    min/max come from the latest state — approximate for the window, exact
+    for the run, and monotonic counts guarantee non-negative buckets."""
+    if not last:
+        return None
+    if not base or base.get("bounds") != last.get("bounds"):
+        return last
+    counts = [a - b for a, b in zip(last["counts"], base["counts"])]
+    return {"bounds": last["bounds"], "counts": counts,
+            "count": last["count"] - base["count"],
+            "sum": last["sum"] - base["sum"],
+            "min": last["min"], "max": last["max"]}
+
+
+def serving_slo_specs(*, deadline_miss_max=0.05, shed_max=0.05,
+                      coverage_floor=0.99, p95_ms_max=2500.0,
+                      short_window_s=60.0, long_window_s=300.0):
+    """The default serving SLO set: the generic health objectives every
+    fleet run carries (fault-family zero-tolerance specs ride alongside —
+    see fleet/chaos_fleet.fleet_fault_slo_specs)."""
+    w = {"short_window_s": short_window_s, "long_window_s": long_window_s}
+    return (
+        SLOSpec("deadline-miss-rate", "rate_max", deadline_miss_max,
+                numerator="deadline_missed", denominator="replied",
+                fast_burn=1.0, slow_burn=1.0, **w),
+        SLOSpec("shed-rate", "rate_max", shed_max,
+                numerator="shed", denominator="submitted",
+                fast_burn=1.0, slow_burn=1.0, **w),
+        SLOSpec("corpus-coverage", "gauge_min", coverage_floor,
+                gauge="corpus_coverage", **w),
+        SLOSpec("reply-p95", "latency_max", p95_ms_max,
+                histogram="request_latency_ms", percentile=95.0,
+                fast_burn=1.0, slow_burn=1.0, **w),
+    )

@@ -61,6 +61,15 @@ class Tracer:
                 self._thread_names.setdefault(tid, name)
 
     def record_span(self, name, ts_us, dur_us, tid, cat="span", args=None):
+        if tid not in self._thread_names and tid == threading.get_ident():
+            # threads born AFTER tracing started (the fleet's hedger, a
+            # rollout worker) reach here without ever passing through
+            # _Span.__enter__'s note_thread — name their track from the
+            # live thread object so Chrome-trace export never shows an
+            # anonymous tid. Only the CALLING thread is nameable this way:
+            # events recorded on behalf of another tid (the XLA track) keep
+            # whatever name was noted for them.
+            self.note_thread(tid, threading.current_thread().name)
         event = {"name": name, "cat": cat, "ph": "X",
                  "ts": round(ts_us, 3), "dur": round(dur_us, 3),
                  "pid": self.pid, "tid": tid}

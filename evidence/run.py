@@ -314,6 +314,11 @@ BENCH_TRAJECTORY_METRICS = ("serve_queries_per_sec",
 BENCH_TRAJECTORY_LOWER_IS_BETTER = ("fleet_p99_ms", "fleet_shed_rate",
                                     "rollout_inflight_p95_ms")
 BENCH_REGRESSION_TOLERANCE = 0.15  # >15% drop vs prior same-platform fails
+# ISSUE 14: the observability layer must be near-free on the serving path —
+# the instrumented leg of the bench's tracing race (span tracing + metric
+# registries on, same trace, same hedged router config) may cost at most
+# this fraction of the bare fleet_qps.
+FLEET_TRACING_OVERHEAD_MAX = 0.03
 
 
 def _bench_history():
@@ -400,6 +405,27 @@ def _bench_trajectory_gate():
         detail += (" [no comparable history for: " + ", ".join(uncovered)
                    + " — pass by absence, not by measurement]")
     return True, detail
+
+
+def _fleet_tracing_overhead_gate():
+    """(ok, detail) for the tracing-overhead check: the LATEST bench record
+    carrying both legs of the race must keep `fleet_qps_traced` within
+    FLEET_TRACING_OVERHEAD_MAX of `fleet_qps`. Pass-by-absence like the
+    trajectory gate: a history without the race (pre-r14 records) is a note,
+    not a failure — the gate fails only on a measured slowdown."""
+    hist = _bench_history()
+    for name, extra in reversed(hist):
+        bare, traced = extra.get("fleet_qps"), extra.get("fleet_qps_traced")
+        if (isinstance(bare, (int, float)) and bare > 0
+                and isinstance(traced, (int, float)) and traced > 0):
+            overhead = 1.0 - float(traced) / float(bare)
+            ok = overhead <= FLEET_TRACING_OVERHEAD_MAX
+            return ok, (f"{name}: fleet_qps_traced {traced} vs fleet_qps "
+                        f"{bare} — tracing overhead {overhead:.2%} "
+                        f"{'<=' if ok else '>'} "
+                        f"{FLEET_TRACING_OVERHEAD_MAX:.0%}")
+    return True, ("no bench record carries the fleet_qps_traced race yet — "
+                  "pass by absence, not by measurement")
 
 
 def main(argv=None):
@@ -1026,6 +1052,11 @@ def main(argv=None):
     # prior records. Runs on every platform: the history is committed JSON.
     traj_ok, traj_detail = _bench_trajectory_gate()
     check("bench_trajectory_no_regression", traj_ok, traj_detail)
+    # ISSUE 14: serving observability must be near-free — the bench races the
+    # same Zipf trace through an instrumented router (span tracing + metric
+    # registries) and the traced qps may trail the bare qps by at most 3%.
+    trace_ok, trace_detail = _fleet_tracing_overhead_gate()
+    check("fleet_tracing_overhead_lt_3pct", trace_ok, trace_detail)
     check("user_category_top1", user["category_top1_accuracy"] > 0.6,
           f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.6 "
           "(chance ~1/8; scored against 5-candidate category means — one "

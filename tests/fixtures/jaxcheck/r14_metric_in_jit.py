@@ -1,0 +1,88 @@
+"""Planted R14: metric/counter mutation inside jit-traced code — the Python
+side effect runs once at trace time, so the counter freezes while the
+compiled function keeps executing. Clean twins: the same metrics recorded on
+the HOST side of the dispatch boundary (around the jitted call, never
+inside), and host-side threading.Event.set() showing the token filter leaves
+non-metric `.set()` alone."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class _FakeRegistry:
+    def counter(self, name):
+        raise NotImplementedError
+
+    def gauge(self, name):
+        raise NotImplementedError
+
+    def histogram(self, name):
+        raise NotImplementedError
+
+
+metrics = _FakeRegistry()
+
+
+@jax.jit
+def encode_and_count(x):
+    metrics.counter("batches").inc()  # planted: R14
+    return jnp.tanh(x)
+
+
+def scored(x, registry):
+    c = registry.counter("scored")
+    y = jnp.dot(x, x)
+    c.inc()  # planted: R14
+    return y
+
+
+scored_jit = jax.jit(scored)
+
+
+@jax.jit
+def observe_latency(x, batch_histogram):
+    y = jnp.sum(x)
+    batch_histogram.observe(0.0)  # planted: R14
+    return y
+
+
+@jax.jit
+def stamp_gauge(x):
+    metrics.gauge("queue_depth").set(0)  # planted: R14
+    return x * 2
+
+
+# ---------------------------------------------------------------- clean twins
+
+def encode_batch_host(x):
+    """Metrics on the host side of the dispatch boundary: increment AROUND
+    the jitted call, never inside it."""
+    y = _encode_compiled(x)
+    metrics.counter("batches").inc()  # host side: runs per call, honestly
+    return y
+
+
+@jax.jit
+def _encode_compiled(x):
+    return jnp.tanh(x)
+
+
+def drain_queue(stop_event):
+    # threading.Event.set() is not a metric mutation: no metric token on the
+    # receiver, nothing bound from a registry factory
+    stop_event.set()
+
+
+class _Worker:
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def shutdown(self):
+        self._stop.set()  # host-side lifecycle, stays clean
+
+    def run_step(self, x):
+        y = _encode_compiled(x)
+        metrics.histogram("batch_ms").observe(1.0)  # host side, after fetch
+        return y

@@ -51,3 +51,54 @@ def test_chaos_fleet_soak_all_families():
     out = chaos_fleet_soak(seeds=(0, 1, 2, 3, 4, 5), n_requests=48)
     assert out["all_ok"], [
         (r.seed, r.detail) for r in out["results"] if not r.ok]
+
+
+# --------------------------------------------- observability (ISSUE 14)
+
+def test_plan_fires_the_matching_slo_alert():
+    """Alert attribution: the zero-tolerance spec wired to seed 2's fault
+    family (a fleet-stage swap failure) must fire — and because that family
+    reverts the whole fleet, the revert spec fires with it. The plan's own
+    audits already require the EXPECTED alert; this pins the mapping at the
+    test layer too."""
+    from dae_rnn_news_recommendation_tpu.fleet import FAMILY_ALERTS
+
+    result = run_fleet_plan(2, n_requests=24)
+    assert result.ok, result.detail
+    assert FAMILY_ALERTS[2 % 6] in result.slo_alerts
+    assert "rollout-aborts" in result.slo_alerts  # the abort precedes it
+
+
+def test_fault_free_reference_replay_is_silent():
+    """The other half of the attribution contract: the same fleet, trace,
+    and mid-trace rollout with NO injector must complete clean with zero
+    SLO alerts — otherwise the chaos assertions above prove nothing."""
+    from dae_rnn_news_recommendation_tpu.fleet import run_fleet_reference
+
+    out = run_fleet_reference(1, n_requests=24)
+    assert out["ok"], out["detail"]
+    assert out["alerts"] == []
+
+
+def test_observability_dump_joins_in_report_fleet(tmp_path):
+    """End-to-end join: a chaos plan dumps fleet_observability.json, the
+    report CLI auto-detects it next to a trace and renders the request
+    table + SLO alerts + ledger cross-check keyed by request id."""
+    import json
+
+    from dae_rnn_news_recommendation_tpu.telemetry.report import report
+
+    dump = tmp_path / "fleet_observability.json"
+    result = run_fleet_plan(2, n_requests=24, dump_path=str(dump))
+    assert result.ok, result.detail
+    assert dump.exists()
+    (tmp_path / "trace.json").write_text('{"traceEvents": []}')
+    text, code = report(str(tmp_path / "trace.json"))
+    assert code == 0
+    assert "serving fleet:" in text
+    assert "flt-" in text                  # request ids in the join table
+    assert "rollout-aborts" in text        # the seed-2 alert rendered
+    as_json, code = report(str(tmp_path / "trace.json"), as_json=True)
+    fleet = json.loads(as_json)["fleet"]
+    assert fleet["ledger"]["join_ok"]      # table rows == ledger submissions
+    assert fleet["counters"]["fleet_reverts"] == 1

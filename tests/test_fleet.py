@@ -391,3 +391,99 @@ def test_failed_probe_reverts_canary(setup):
         assert {r.corpus.version for r in replicas} == {1}
     finally:
         stop_fleet(replicas, router)
+
+
+# --------------------------------------------- observability (ISSUE 14)
+
+def test_fleet_ids_propagate_and_hedge_twin_shares_parent_id(setup):
+    """Router requests get `flt-N` ids; the replica-level attempt carries
+    the hop suffix, so a hedge twin that WINS resolves the caller's future
+    with `flt-N/h` — the winner is attributable from the reply alone."""
+    config, params, articles = setup
+    replicas = [make_replica(setup, name="fast"),
+                make_replica(setup, name="slow", lag_s=0.4)]
+    router = Router(replicas, default_deadline_s=SLA, seed=5,
+                    ledger=OutcomeLedger(), hedge=True,
+                    hedge_delay_floor_s=0.05, hedge_delay_cap_s=0.05)
+    try:
+        fut = router.submit(articles[0], pin="slow")
+        assert fut.result(timeout=10).ok
+        futs = [router.submit(articles[i % N]) for i in range(12)]
+        replies = [f.result(timeout=30) for f in futs]
+        assert all(r.ok for r in replies)
+        time.sleep(0.6)
+        ids = [r.request_id for r in replies]
+        assert all(rid.startswith("flt-") for rid in ids)
+        roots = [rid.split("/")[0] for rid in ids]
+        assert len(set(roots)) == len(roots)  # one root id per request
+        assert router.counts["hedge_wins"] >= 1, router.summary()
+        winners = [r for r in router.records if r.get("hedged")
+                   and str(r.get("request_id", "")).endswith("/h")]
+        assert winners, [r["request_id"] for r in router.records]
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_fleet_timing_decomposition_sums_to_latency(setup):
+    """Fleet-level timing honesty: each record's per-hop components plus
+    the router's own remainder (`router_s`) reconstruct the end-to-end
+    latency the caller observed."""
+    replicas, router, sup = make_fleet(setup)
+    config, params, articles = setup
+    try:
+        futs = [router.submit(articles[i % N]) for i in range(10)]
+        assert all(f.result(timeout=30).ok for f in futs)
+        recs = [r for r in router.records if r["status"] == "ok"]
+        assert len(recs) == 10
+        for rec in recs:
+            t = rec["timings"]
+            assert "router_s" in t and "compute_s" in t
+            assert abs(sum(t.values()) - rec["latency_s"]) < 1e-3, rec
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_fleet_registries_aggregate_without_double_counting(setup):
+    """The router's request-outcome counters are `fleet_`-prefixed exactly
+    so the name-keyed aggregate cannot fold them into the replica-level
+    submitted/replied (each request is ONE fleet outcome but may be 1+
+    replica attempts under hedging/retries)."""
+    from dae_rnn_news_recommendation_tpu.fleet import fleet_registries
+    from dae_rnn_news_recommendation_tpu.telemetry import (MetricsRegistry,
+                                                           aggregate)
+
+    replicas, router, sup = make_fleet(setup)
+    config, params, articles = setup
+    router.attach_registry(MetricsRegistry("router"))
+    for r in replicas:
+        r.attach_registry(MetricsRegistry(r.name))
+    try:
+        futs = [router.submit(articles[i % N]) for i in range(8)]
+        assert all(f.result(timeout=30).ok for f in futs)
+        regs = fleet_registries(router=router, replicas=replicas,
+                                supervisor=sup)
+        assert len(regs) == 4  # router + 3 distinct replica registries
+        agg = aggregate([m.snapshot() for m in regs])
+        assert agg["counters"]["fleet_submitted"] == 8
+        assert agg["counters"]["fleet_replied"] == 8
+        # replica-level attempts can exceed fleet outcomes, never undercut
+        assert agg["counters"]["replied"] >= 8
+        assert "request_latency_ms" in agg["histograms"]
+        assert agg["histograms"]["fleet_latency_ms"]["count"] == 8
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_clean_stop_is_not_a_replica_kill(setup):
+    """stop() is planned teardown; kill() is the crash. Only the crash may
+    increment `replica_kills` — the zero-tolerance SLO fires on any count,
+    so a clean shutdown must leave it at zero."""
+    from dae_rnn_news_recommendation_tpu.telemetry import MetricsRegistry
+
+    rep = make_replica(setup, registry=MetricsRegistry("r0"))
+    rep.stop()
+    assert rep.metrics.counter("replica_kills").value == 0
+
+    rep2 = make_replica(setup, name="r1", registry=MetricsRegistry("r1"))
+    rep2.kill()
+    assert rep2.metrics.counter("replica_kills").value == 1

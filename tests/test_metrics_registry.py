@@ -1,0 +1,162 @@
+"""Metrics registry contracts (ISSUE 14): thread-safe counters/gauges/
+fixed-bucket histograms with no per-observation allocation, per-replica
+registries, and the name-keyed fleet aggregate.
+
+The load-bearing test is the concurrent-writer race: Python `+=` is not
+atomic, so a lockless counter under N threads x M increments loses updates
+nondeterministically — the registry must land on the exact total every time.
+"""
+
+import threading
+
+import pytest
+
+from dae_rnn_news_recommendation_tpu.telemetry import (
+    DEFAULT_LATENCY_BOUNDS_MS, MetricsRegistry, aggregate,
+    histogram_percentile)
+
+
+# ---------------------------------------------------------------- primitives
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry("svc")
+    c = reg.counter("replied")
+    c.inc()
+    c.inc(3)
+    assert c.value == 4
+    g = reg.gauge("queue_depth")
+    assert g.value is None  # unset gauge reads as absent, not 0
+    g.set(7)
+    assert g.value == 7.0
+    h = reg.histogram("latency_ms", bounds=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    state = h.state()
+    assert state["counts"] == [1, 1, 1, 1]  # last bucket is +inf overflow
+    assert state["count"] == 4
+    assert state["min"] == 0.5 and state["max"] == 500.0
+
+
+def test_registry_create_or_get_returns_same_object():
+    reg = MetricsRegistry("svc")
+    assert reg.counter("x") is reg.counter("x")
+    assert reg.gauge("y") is reg.gauge("y")
+    assert reg.histogram("z") is reg.histogram("z")
+
+
+def test_histogram_percentile_interpolates_and_handles_overflow():
+    reg = MetricsRegistry("svc")
+    h = reg.histogram("lat", bounds=list(DEFAULT_LATENCY_BOUNDS_MS))
+    for v in (1.0,) * 50 + (100.0,) * 50:
+        h.observe(v)
+    p50 = histogram_percentile(h.state(), 50.0)
+    assert p50 <= 100.0
+    # everything in the overflow bucket -> the observed max, not infinity
+    h2 = reg.histogram("over", bounds=(1.0,))
+    h2.observe(1e6)
+    assert histogram_percentile(h2.state(), 99.0) == 1e6
+    assert histogram_percentile({"counts": [], "count": 0}, 50.0) is None
+
+
+# ------------------------------------------------------------- concurrency
+
+def test_concurrent_counter_increments_are_exact():
+    """N threads x M increments must land on exactly N*M — the lost-update
+    race a bare `+=` loses."""
+    reg = MetricsRegistry("svc")
+    c = reg.counter("hits")
+    n_threads, n_inc = 8, 2000
+    start = threading.Barrier(n_threads)
+
+    def worker():
+        start.wait()
+        for _ in range(n_inc):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * n_inc
+
+
+def test_concurrent_histogram_observations_are_exact():
+    reg = MetricsRegistry("svc")
+    h = reg.histogram("lat", bounds=(1.0, 10.0))
+    n_threads, n_obs = 8, 1000
+    start = threading.Barrier(n_threads)
+
+    def worker(i):
+        start.wait()
+        for k in range(n_obs):
+            h.observe(float(k % 20))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    state = h.state()
+    assert state["count"] == n_threads * n_obs
+    assert sum(state["counts"]) == n_threads * n_obs
+
+
+def test_concurrent_create_or_get_yields_one_metric_per_name():
+    """Two threads racing counter("same") must converge on ONE counter —
+    a torn dict insert would silently fork the count."""
+    reg = MetricsRegistry("svc")
+    got = []
+    start = threading.Barrier(8)
+
+    def worker():
+        start.wait()
+        c = reg.counter("same")
+        c.inc()
+        got.append(c)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(c is got[0] for c in got)
+    assert reg.counter("same").value == 8
+
+
+# ---------------------------------------------------------------- aggregate
+
+def test_snapshot_and_fleet_aggregate():
+    regs = [MetricsRegistry(f"r{i}") for i in range(3)]
+    for i, reg in enumerate(regs):
+        reg.counter("replied").inc(10 * (i + 1))
+        reg.gauge("corpus_version").set(i + 1)
+        h = reg.histogram("lat", bounds=(1.0, 10.0))
+        h.observe(0.5)
+        h.observe(5.0)
+    agg = aggregate([r.snapshot() for r in regs])
+    assert agg["n_sources"] == 3
+    assert agg["counters"]["replied"] == 60
+    assert agg["gauges"]["corpus_version"] == {
+        "min": 1.0, "max": 3.0, "mean": 2.0}
+    merged = agg["histograms"]["lat"]
+    assert merged["count"] == 6
+    assert merged["counts"][0] == 3 and merged["counts"][1] == 3
+
+
+def test_aggregate_notes_mismatched_histogram_bounds():
+    a, b = MetricsRegistry("a"), MetricsRegistry("b")
+    a.histogram("lat", bounds=(1.0, 10.0)).observe(2.0)
+    b.histogram("lat", bounds=(5.0,)).observe(2.0)
+    agg = aggregate([a.snapshot(), b.snapshot()])
+    # keeps the first source's histogram, skips the mismatch, and says so
+    assert agg["histograms"]["lat"]["count"] == 1
+    assert agg["histograms"]["lat"]["bounds"] == [1.0, 10.0]
+    assert any("lat" in note for note in agg.get("notes", []))
+
+
+def test_histogram_rejects_unsorted_bounds():
+    reg = MetricsRegistry("svc")
+    with pytest.raises((ValueError, AssertionError)):
+        reg.histogram("bad", bounds=(10.0, 1.0))

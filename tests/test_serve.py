@@ -662,3 +662,109 @@ def test_sharded_swap_incremental_promotes_with_uniform_shard_stamps(setup):
         params, ref_slot.emb, ref_slot.valid, ref_slot.scales, queries))
     np.testing.assert_array_equal(np.asarray(idx_sharded),
                                   np.asarray(idx_flat))
+
+
+# --------------------------------------------- observability (ISSUE 14)
+
+def test_request_ids_and_timing_decomposition(setup):
+    """Every reply carries a request id and a per-hop timing record whose
+    components (admit -> queue -> batch formation -> fenced compute ->
+    resolve) sum to the reply's own latency — the timing-honesty contract
+    the fleet soak audits at scale."""
+    from dae_rnn_news_recommendation_tpu.telemetry import MetricsRegistry
+
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    reg = MetricsRegistry("svc")
+    svc = make_service(config, params, corpus, name="svc", registry=reg)
+    try:
+        replies = [svc.submit(articles[i], deadline_s=SLA).result(timeout=SLA)
+                   for i in range(6)]
+        custom = svc.submit(articles[0], deadline_s=SLA,
+                            request_id="caller-7").result(timeout=SLA)
+    finally:
+        svc.stop()
+    ids = [r.request_id for r in replies]
+    assert all(ids) and len(set(ids)) == len(ids)
+    assert all(rid.startswith("svc-") for rid in ids)
+    assert custom.request_id == "caller-7"  # caller-supplied id wins
+    for r in replies + [custom]:
+        assert r.ok
+        t = r.timings
+        assert set(t) <= {"admit_s", "queue_s", "batch_form_s",
+                          "compute_s", "resolve_s"}
+        assert "compute_s" in t
+        assert all(v >= 0.0 for v in t.values())
+        assert abs(sum(t.values()) - r.latency_s) < 1e-3, (t, r.latency_s)
+    snap = reg.snapshot()
+    assert snap["counters"]["submitted"] == 7
+    assert snap["counters"]["replied"] == 7
+    assert snap["histograms"]["request_latency_ms"]["count"] == 7
+
+
+def test_shed_replies_carry_ids_and_timings_too(setup):
+    """An admission shed is still a traced outcome: id + (short) timing
+    record, and the per-reason shed counter increments."""
+    from dae_rnn_news_recommendation_tpu.telemetry import MetricsRegistry
+
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    reg = MetricsRegistry("svc")
+    svc = make_service(config, params, corpus, registry=reg)
+    try:
+        reply = svc.submit(articles[0], deadline_s=1e-9).result(timeout=SLA)
+    finally:
+        svc.stop()
+    assert reply.status == "shed"
+    assert reply.request_id
+    assert sum(reply.timings.values()) >= 0.0
+    snap = reg.snapshot()
+    assert snap["counters"]["shed"] == 1
+    assert any(k.startswith("shed.") and v == 1
+               for k, v in snap["counters"].items()), snap["counters"]
+
+
+def test_trace_sampling_thins_request_spans_not_counters(setup):
+    """trace_sample_rate=0.25 keeps every 4th `serve/request` span (the
+    zero-length per-request event) while counters and histograms still see
+    every request — sampling thins the TRACE, never the metrics."""
+    import dae_rnn_news_recommendation_tpu.telemetry as telemetry
+    from dae_rnn_news_recommendation_tpu.telemetry import MetricsRegistry
+
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    reg = MetricsRegistry("svc")
+    svc = make_service(config, params, corpus, registry=reg,
+                       trace_sample_rate=0.25)
+    telemetry.enable(xla_events=False)
+    try:
+        for i in range(8):
+            assert svc.submit(articles[i],
+                              deadline_s=SLA).result(timeout=SLA).ok
+    finally:
+        svc.stop()
+        tracer = telemetry.disable()
+    req_spans = [e for e in tracer.events() if e["name"] == "serve/request"]
+    assert len(req_spans) == 2  # period 4 -> requests 1 and 5 of 8
+    assert reg.counter("replied").value == 8
+    assert reg.histogram("request_latency_ms").state()["count"] == 8
+
+
+def test_default_sampling_keeps_every_request_span(setup):
+    import dae_rnn_news_recommendation_tpu.telemetry as telemetry
+
+    config, params, articles = setup
+    corpus = make_corpus(config, params, articles)
+    svc = make_service(config, params, corpus)
+    telemetry.enable(xla_events=False)
+    try:
+        for i in range(4):
+            assert svc.submit(articles[i],
+                              deadline_s=SLA).result(timeout=SLA).ok
+    finally:
+        svc.stop()
+        tracer = telemetry.disable()
+    req_spans = [e for e in tracer.events() if e["name"] == "serve/request"]
+    assert len(req_spans) == 4
+    assert all(e["args"]["id"] for e in req_spans)
+    assert all("timings" in e["args"] for e in req_spans)

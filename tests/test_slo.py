@@ -1,0 +1,158 @@
+"""SLO burn-rate monitor contracts (ISSUE 14): counter-delta windowing,
+multi-window AND-gating, zero-tolerance specs, edge-firing (one alert per
+breach episode), and the gauge/latency kinds.
+
+Every test drives the monitor with a FAKE clock and hand-built registry
+snapshots — the monitor's contract is pure arithmetic over (t, snapshot)
+pairs, so nothing here touches a real service.
+"""
+
+import pytest
+
+from dae_rnn_news_recommendation_tpu.telemetry import (SLOMonitor, SLOSpec,
+                                                       serving_slo_specs)
+
+
+def _snap(counters=None, gauges=None, histograms=None):
+    return {"registry": "t", "counters": counters or {},
+            "gauges": gauges or {}, "histograms": histograms or {}}
+
+
+def _clock(holder):
+    return lambda: holder["t"]
+
+
+# ----------------------------------------------------------------- rate_max
+
+def test_rate_uses_window_deltas_not_raw_totals():
+    """A fleet with ancient errors but a CLEAN recent window must not fire:
+    rates come from counter deltas between the window baseline and the
+    latest snapshot, never from lifetime totals."""
+    clk = {"t": 0.0}
+    spec = SLOSpec("errors", "rate_max", 0.05, numerator="errors",
+                   denominator="replied", short_window_s=10.0,
+                   long_window_s=10.0, fast_burn=1.0, slow_burn=1.0)
+    mon = SLOMonitor([spec], clock=_clock(clk))
+    # ancient history: 50% error rate, far outside the window
+    mon.observe(_snap(counters={"errors": 0, "replied": 0}))
+    clk["t"] = 1.0
+    mon.observe(_snap(counters={"errors": 50, "replied": 100}))
+    # window baseline: errors stop, traffic continues
+    clk["t"] = 100.0
+    mon.observe(_snap(counters={"errors": 50, "replied": 200}))
+    clk["t"] = 109.0
+    mon.observe(_snap(counters={"errors": 50, "replied": 300}))
+    assert mon.evaluate() == []
+
+    # and the mirror: a breach INSIDE the window fires
+    clk["t"] = 110.0
+    mon.observe(_snap(counters={"errors": 80, "replied": 400}))
+    fired = mon.evaluate()
+    assert [a["slo"] for a in fired] == ["errors"]
+
+
+def test_zero_objective_spec_fires_on_any_occurrence_and_only_then():
+    clk = {"t": 0.0}
+    spec = SLOSpec("kills", "rate_max", 0.0, numerator="replica_kills",
+                   short_window_s=100.0, long_window_s=100.0,
+                   fast_burn=1.0, slow_burn=1.0)
+    mon = SLOMonitor([spec], clock=_clock(clk))
+    mon.observe(_snap(counters={"replica_kills": 0}))
+    clk["t"] = 1.0
+    mon.observe(_snap(counters={"replica_kills": 0}))
+    assert mon.evaluate() == []
+    clk["t"] = 2.0
+    mon.observe(_snap(counters={"replica_kills": 1}))
+    fired = mon.evaluate()
+    assert len(fired) == 1 and fired[0]["slo"] == "kills"
+    assert fired[0]["short_burn"] == "inf"
+
+
+def test_alert_fires_once_per_breach_episode():
+    """Edge-firing: a sustained breach records ONE alert; recovery then a
+    fresh breach records a second."""
+    clk = {"t": 0.0}
+    spec = SLOSpec("sheds", "rate_max", 0.0, numerator="shed",
+                   short_window_s=5.0, long_window_s=5.0,
+                   fast_burn=1.0, slow_burn=1.0)
+    mon = SLOMonitor([spec], clock=_clock(clk))
+    mon.observe(_snap(counters={"shed": 0}))
+    clk["t"] = 1.0
+    mon.observe(_snap(counters={"shed": 3}))
+    assert len(mon.evaluate()) == 1
+    clk["t"] = 2.0
+    mon.observe(_snap(counters={"shed": 3}))
+    assert mon.evaluate() == []          # still the same episode
+    # recovery: the window rolls past the sheds, the spec goes quiet
+    clk["t"] = 20.0
+    mon.observe(_snap(counters={"shed": 3}))
+    clk["t"] = 24.0
+    mon.observe(_snap(counters={"shed": 3}))
+    assert mon.evaluate() == []
+    # a NEW sheds burst is a new episode -> second alert
+    clk["t"] = 25.0
+    mon.observe(_snap(counters={"shed": 5}))
+    assert len(mon.evaluate()) == 1
+    assert len(mon.alerts) == 2
+
+
+# --------------------------------------------------------- gauge / latency
+
+def test_gauge_min_fires_below_floor_and_reads_aggregate_min():
+    clk = {"t": 0.0}
+    spec = SLOSpec("coverage", "gauge_min", 0.99, gauge="corpus_coverage",
+                   short_window_s=10.0, long_window_s=10.0)
+    mon = SLOMonitor([spec], clock=_clock(clk))
+    # aggregate {min,max,mean} form: the WORST replica is what matters
+    mon.observe(_snap(gauges={"corpus_coverage":
+                              {"min": 1.0, "max": 1.0, "mean": 1.0}}))
+    assert mon.evaluate() == []
+    clk["t"] = 1.0
+    mon.observe(_snap(gauges={"corpus_coverage":
+                              {"min": 0.5, "max": 1.0, "mean": 0.9}}))
+    fired = mon.evaluate()
+    assert [a["slo"] for a in fired] == ["coverage"]
+    assert fired[0]["value"] == 0.5
+
+
+def test_latency_percentile_evaluated_on_window_delta():
+    clk = {"t": 0.0}
+    spec = SLOSpec("p95", "latency_max", 100.0,
+                   histogram="request_latency_ms", percentile=95.0,
+                   short_window_s=10.0, long_window_s=10.0,
+                   fast_burn=1.0, slow_burn=1.0)
+    mon = SLOMonitor([spec], clock=_clock(clk))
+    fast = {"bounds": [50.0, 200.0], "counts": [100, 0, 0], "count": 100,
+            "sum": 1000.0, "min": 5.0, "max": 40.0}
+    mon.observe(_snap(histograms={"request_latency_ms": fast}))
+    assert mon.evaluate() == []
+    # the new window's traffic lands entirely in the 50-200ms bucket
+    slow = {"bounds": [50.0, 200.0], "counts": [100, 50, 0], "count": 150,
+            "sum": 9000.0, "min": 5.0, "max": 180.0}
+    clk["t"] = 1.0
+    mon.observe(_snap(histograms={"request_latency_ms": slow}))
+    fired = mon.evaluate()
+    assert [a["slo"] for a in fired] == ["p95"]
+
+
+# ------------------------------------------------------------ housekeeping
+
+def test_summary_carries_specs_alerts_and_active_state():
+    clk = {"t": 0.0}
+    mon = SLOMonitor(serving_slo_specs(), clock=_clock(clk))
+    mon.observe(_snap(counters={"shed": 0, "submitted": 0}))
+    clk["t"] = 1.0
+    mon.observe(_snap(counters={"shed": 50, "submitted": 100}))
+    mon.evaluate()
+    s = mon.summary()
+    assert {sp["name"] for sp in s["specs"]} == {
+        "deadline-miss-rate", "shed-rate", "corpus-coverage", "reply-p95"}
+    assert [a["slo"] for a in s["alerts"]] == ["shed-rate"]
+    assert s["active"] == ["shed-rate"]
+    assert s["n_observations"] == 2
+
+
+def test_duplicate_spec_names_are_rejected():
+    with pytest.raises(AssertionError):
+        SLOMonitor([SLOSpec("x", "rate_max", 0.0, numerator="a"),
+                    SLOSpec("x", "rate_max", 0.0, numerator="b")])

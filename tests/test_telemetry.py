@@ -323,3 +323,91 @@ def test_manifest_round_trip(tmp_path):
     assert manifest["feed_mode"] == "stream" and manifest["note"] == "test"
     path = telemetry.write_manifest(str(tmp_path / "m.json"), manifest)
     assert telemetry.read_manifest(path) == manifest
+
+
+# --------------------------------------------- observability (ISSUE 14)
+
+def test_threads_born_after_enable_get_named_tracks():
+    """A thread created AFTER tracing starts still gets a named track: its
+    first record_span self-registers the thread name, so its spans don't
+    render as an anonymous tid in Perfetto."""
+    tracer = telemetry.enable(xla_events=False)
+    try:
+        def worker():
+            with telemetry.span("late/span", fence=False):
+                time.sleep(0.001)
+
+        t = threading.Thread(target=worker, name="late-worker")
+        t.start()
+        t.join()
+    finally:
+        telemetry.disable()
+    span = next(e for e in tracer.events() if e["name"] == "late/span")
+    meta = [e for e in tracer.chrome_trace()["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "thread_name"]
+    named = {e["tid"]: e["args"]["name"] for e in meta}
+    assert named.get(span["tid"]) == "late-worker"
+
+
+def test_report_cli_fleet_flag_renders_bundle(tmp_path, capsys):
+    """`report --fleet PATH` renders the serving-fleet section from an
+    explicit bundle path (the auto-detect path is covered end-to-end in
+    tests/test_chaos_fleet.py)."""
+    bundle = {
+        "requests": [{"id": 1, "request_id": "flt-1", "status": "ok",
+                      "replica": "r0", "hedged": False, "retries": 0,
+                      "latency_s": 0.004,
+                      "timings": {"admit_s": 0.001, "queue_s": 0.001,
+                                  "compute_s": 0.001, "router_s": 0.001}}],
+        "registries": [{"registry": "r0", "counters": {"replied": 1},
+                        "gauges": {}, "histograms": {}}],
+        "aggregate": {"registry": "fleet", "n_sources": 1,
+                      "counters": {"replied": 1}, "gauges": {},
+                      "histograms": {}},
+        "slo": {"specs": [], "alerts": [], "active": [],
+                "n_observations": 2},
+        "rollout": [{"action": "bootstrap"}],
+        "ledger": {"n_submitted": 1, "counts": {"ok": 1}, "problems": []},
+    }
+    (tmp_path / "bundle.json").write_text(json.dumps(bundle))
+    trace = tmp_path / "trace.json"
+    trace.write_text('{"traceEvents": []}')
+    rc = cli_main(["report", str(trace), "--fleet",
+                   str(tmp_path / "bundle.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "serving fleet: 1 requests" in out
+    assert "flt-1" in out
+    assert "SLO alerts: none" in out
+    assert "[join ok]" in out
+
+
+def test_report_degrades_gracefully_on_r12_era_layout(tmp_path, capsys):
+    """Regression for pre-fleet run directories (trace + health bundle +
+    churn history, NO fleet_observability.json): the report renders exactly
+    the old sections, no fleet noise, exit 0 — and a bare `--fleet` on the
+    same directory degrades to a note instead of an error."""
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps({"traceEvents": [
+        {"name": "fit/epoch", "ph": "X", "ts": 0, "dur": 1000,
+         "pid": 1, "tid": 1}]}))
+    (tmp_path / "health_bundle.json").write_text(json.dumps(
+        {"status": "healthy", "reason": "", "first_bad_step": None,
+         "last_good_step": 9, "loss_ema": 0.5, "n_steps_recorded": 10,
+         "ring": []}))
+    (tmp_path / "churn_history.json").write_text(json.dumps(
+        {"history": [{"action": "incremental", "version": 2,
+                      "swap_s": 0.01}]}))
+    rc = cli_main(["report", str(trace)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "model health: healthy" in out
+    assert "corpus churn: 1 cycles" in out
+    assert "serving fleet" not in out
+    assert "fleet bundle unavailable" not in out  # silent when not asked
+
+    rc = cli_main(["report", str(trace), "--fleet"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fleet bundle unavailable" in out
+    assert "serving fleet" not in out
