@@ -16,7 +16,19 @@ donation/aliasing verifier). jaxcheck encodes them as review-time rules:
     R4  recompile hazards (per-iteration Python scalars, ragged stacking)
     R5  PRNG key reuse without an intervening split
 
+The serving fleet added a second invisible-in-review bug class — cross-file
+concurrency. The threadcheck family (concurrency.py) rides the same
+registry but consumes a whole-program index (project.py: lock inventories,
+thread spawns, intra-package call graph):
+
+    C1  attribute written under a lock in one method but bare in another
+    C2  lock-order inversion across the acquires-while-holding graph
+    C3  blocking call / device sync while holding a lock
+    C4  started non-daemon thread with no join/stop on any path
+    C5  future resolved / callbacks invoked while holding a lock
+
 CLI:    python -m dae_rnn_news_recommendation_tpu.analysis [paths] [--json]
+        [--select C1,C3] [--list-rules]
         (no paths: the package + bench.py + evidence/; exit 0 = clean)
 Runtime: `compile_guard(max_compiles=N)` — a context manager counting XLA
         backend compiles via `jax.monitoring`, so tests can pin an upper

@@ -6,9 +6,16 @@ evidence/). Exit codes: 0 clean, 1 unsuppressed findings, 2 usage error.
 
 import argparse
 import json
+import re
 import sys
 
 from .core import RULES, analyze_paths, default_targets, repo_root
+
+
+def _rule_sort_key(rule_id):
+    """R2 before R10, rule families grouped (R* then C*)."""
+    m = re.match(r"([A-Za-z]+)(\d+)$", rule_id)
+    return (m.group(1), int(m.group(2))) if m else (rule_id, 0)
 
 
 def main(argv=None):
@@ -22,16 +29,37 @@ def main(argv=None):
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit a JSON report (findings + suppressed with "
                         "reasons) instead of text")
+    parser.add_argument("--select", metavar="RULES", default=None,
+                        help="comma-separated rule ids to run (e.g. "
+                        "'R1,C3'); default: all registered rules")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog (id: title) and exit")
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:
         return 0 if e.code == 0 else 2
 
+    if args.list_rules:
+        for rule_id in sorted(RULES, key=_rule_sort_key):
+            print(f"{rule_id}: {RULES[rule_id][0]}")
+        return 0
+
+    select = None
+    if args.select is not None:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = sorted(select - set(RULES))
+        if not select or unknown:
+            what = ", ".join(unknown) if unknown else "(empty)"
+            print(f"jaxcheck: --select names unknown rule(s): {what} "
+                  f"(try --list-rules)", file=sys.stderr)
+            return 2
+
     if args.paths:
         root, targets = repo_root(), args.paths
     else:
         root, targets = default_targets()
-    findings, suppressed, n_files = analyze_paths(targets, root=root)
+    findings, suppressed, n_files = analyze_paths(targets, root=root,
+                                                  select=select)
     if n_files == 0:
         print("jaxcheck: no Python files found under the given paths",
               file=sys.stderr)

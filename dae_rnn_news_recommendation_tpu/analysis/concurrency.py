@@ -1,0 +1,501 @@
+"""threadcheck rules C1-C5 — cross-file concurrency checkers for the serving
+fleet (see docs/jaxcheck.md for the catalog with in-repo examples).
+
+Where R1-R14 are per-file, these rules consume the whole-program index
+(project.py): per-class lock inventories, thread-spawn sites, and an
+intra-package call graph good enough to follow lock-holding through helper
+methods (`self._resolve(...)` called under `req._lock` analyzes `_resolve`
+with that lock held). Like every jaxcheck rule they are heuristic by
+construction — lock identity is nominal (`ClassName.attr` for `self.X`,
+`receiver.attr` for other objects, `global:name` for module-level locks),
+manual `.acquire()`/`.release()` pairs are out of scope (only `with lock:`
+regions are tracked), and anything the rules cannot see carries a reasoned
+`# jaxcheck: disable=...` at the site.
+"""
+
+import ast
+import os
+
+from .core import rule
+from . import project
+from .project import name_is_lockish
+from .rules import (dotted, call_name, _kw, _const, _r11_bindings,
+                    _r11_has_timeout)
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+# ----------------------------------------------------------- lock tracking
+
+def _make_keyer(owner, mod, index):
+    """expr -> lock key or None. Keys are nominal: `Class.attr` for self
+    attributes, `recv.attr` for other receivers, `global:name` for bare
+    names — the same textual convention across files, so a lock threaded
+    through modules keeps one identity."""
+    known = index.lock_attr_names()
+
+    def keyer(expr):
+        if isinstance(expr, ast.Attribute):
+            recv = dotted(expr.value)
+            attr = expr.attr
+            if recv == "self":
+                if owner is not None and (attr in owner.lock_attrs
+                                          or name_is_lockish(attr)):
+                    return f"{owner.name}.{attr}"
+                if owner is None and name_is_lockish(attr):
+                    return f"self.{attr}"
+                return None
+            if recv is not None and (attr in known or name_is_lockish(attr)):
+                return f"{recv}.{attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in mod.module_locks or name_is_lockish(expr.id):
+                return f"global:{expr.id}"
+        return None
+
+    return keyer
+
+
+def _walk_held(func_node, keyer, entry_held=frozenset()):
+    """Walk one function body tracking `with <lock>:` regions lexically.
+
+    Returns (nodes, acquires): `nodes` is [(node, held)] for every AST node
+    outside nested function defs; `acquires` is [(key, expr, held_before)]
+    for every recognized lock acquisition. `entry_held` seeds locks the
+    caller proved held at every call site (the call-graph propagation)."""
+    nodes, acquires = [], []
+
+    def visit(node, held):
+        if isinstance(node, _FUNC_DEFS + (ast.Lambda,)):
+            return  # nested defs run later, not here — analyzed as own units
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            cur = held
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    nodes.append((sub, cur))
+                key = keyer(item.context_expr)
+                if key is not None:
+                    acquires.append((key, item.context_expr, cur))
+                    cur = cur | {key}
+            for stmt in node.body:
+                visit(stmt, cur)
+            return
+        nodes.append((node, held))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in func_node.body:
+        visit(stmt, frozenset(entry_held))
+    return nodes, acquires
+
+
+def _units(mod):
+    """Every function in the module as (owner_class_or_None, FunctionDef):
+    class methods (and closures inside them — `self` still means the class)
+    first, then module-level functions and their closures."""
+    seen, out = set(), []
+    for ci in mod.classes:
+        for node in ast.walk(ci.node):
+            if isinstance(node, _FUNC_DEFS) and id(node) not in seen:
+                seen.add(id(node))
+                out.append((ci, node))
+    for node in ast.walk(mod.tree):
+        if isinstance(node, _FUNC_DEFS) and id(node) not in seen:
+            seen.add(id(node))
+            out.append((None, node))
+    return out
+
+
+def _resolve_call(call, owner, mod):
+    """Callee FunctionDef for `self.m(...)` (same class) or `f(...)` (same
+    module), else None — the intra-package call graph's resolution step."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self" and owner is not None:
+        return owner.methods.get(f.attr)
+    if isinstance(f, ast.Name):
+        return mod.functions.get(f.id)
+    return None
+
+
+def _module_entries(index, mod):
+    """(units, entry): for each function, the set of locks provably held at
+    EVERY intra-module call site (intersection semantics — a public method
+    reachable without the lock gets the empty set). Two propagation rounds
+    cover helper-calls-helper chains."""
+    cached = index._cache.get(("entries", mod.relpath))
+    if cached is not None:
+        return cached
+    units = _units(mod)
+    entry = {id(node): frozenset() for _, node in units}
+    for _ in range(2):
+        acc = {}
+        for owner, node in units:
+            keyer = _make_keyer(owner, mod, index)
+            nodes, _ = _walk_held(node, keyer, entry[id(node)])
+            for n, held in nodes:
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = _resolve_call(n, owner, mod)
+                if callee is not None and id(callee) in entry:
+                    prev = acc.get(id(callee))
+                    acc[id(callee)] = held if prev is None else (prev & held)
+        entry = {k: frozenset(acc.get(k) or frozenset()) for k in entry}
+    index._cache[("entries", mod.relpath)] = (units, entry)
+    return units, entry
+
+
+def _lock_names(held):
+    return ", ".join(f"`{k}`" for k in sorted(held))
+
+
+# ------------------------------------------------------------------- C1
+
+@rule("C1", "attribute written under a lock in one method but bare in "
+      "another of a thread-shared class")
+def check_c1(ctx):
+    """A class that allocates its own `threading.Lock` has declared itself
+    shared between threads; from then on, an attribute written under `with
+    self._lock:` in one method and bare in another is a data race waiting
+    for the interleaving chaos soaks never hit — the bare write can tear a
+    read-modify-write or publish half-initialized state. `__init__` writes
+    are exempt (construction happens-before the threads), as are attributes
+    never written under a lock at all (the class evidently considers them
+    single-writer). The inference follows the call graph: a helper only
+    ever called under the lock counts as locked."""
+    index = project.index_for(ctx)
+    mod = index.module_for(ctx.path)
+    if mod is None:
+        return []
+    out = []
+    units, entry = _module_entries(index, mod)
+    by_owner = {}
+    for owner, node in units:
+        if owner is not None:
+            by_owner.setdefault(id(owner), []).append(node)
+    for ci in mod.classes:
+        if not ci.lock_attrs:
+            continue
+        keyer = _make_keyer(ci, mod, index)
+        writes = {}   # attr -> {"locked": [...], "bare": [...]}
+        init_funcs = {id(ci.methods.get(m)) for m in
+                      ("__init__", "__new__", "__post_init__")
+                      if ci.methods.get(m) is not None}
+        for node in by_owner.get(id(ci), ()):
+            if id(node) in init_funcs:
+                continue
+            nodes, _ = _walk_held(node, keyer, entry[id(node)])
+            for n, held in nodes:
+                if not isinstance(n, (ast.Assign, ast.AugAssign,
+                                      ast.AnnAssign)):
+                    continue
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for t in targets:
+                    attr = _self_attr_of(t)
+                    if attr is None:
+                        continue
+                    bucket = writes.setdefault(
+                        attr, {"locked": [], "bare": []})
+                    kind = "locked" if held else "bare"
+                    bucket[kind].append((n, node.name, held))
+        for attr, b in sorted(writes.items()):
+            if not b["locked"] or not b["bare"]:
+                continue
+            ln, lmeth, lheld = b["locked"][0]
+            for n, meth, _ in b["bare"]:
+                out.append(ctx.finding(
+                    n, f"`self.{attr}` is written under "
+                    f"{_lock_names(lheld)} in `{ci.name}.{lmeth}` (line "
+                    f"{ln.lineno}) but bare here in `{meth}` — a "
+                    "thread-shared class must guard every write of a "
+                    "lock-protected attribute"))
+    return out
+
+
+def _self_attr_of(target):
+    """'x' for `self.x = ...` and `self.x[k] = ...` targets, else None."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and target.value.id == "self":
+        return target.attr
+    return None
+
+
+# ------------------------------------------------------------------- C2
+
+def _lock_graph(index):
+    """Project-global acquires-while-holding graph: edge A -> B for every
+    site acquiring B with A held (lexically or via the call graph's
+    entry-held propagation). Cached on the index."""
+    cached = index._cache.get("lock_graph")
+    if cached is not None:
+        return cached
+    edges, sites = {}, {}
+    for mod in index.modules.values():
+        units, entry = _module_entries(index, mod)
+        for owner, node in units:
+            keyer = _make_keyer(owner, mod, index)
+            _, acquires = _walk_held(node, keyer, entry[id(node)])
+            for key, expr, held in acquires:
+                for h in held:
+                    if h == key:
+                        continue
+                    edges.setdefault(h, set()).add(key)
+                    sites.setdefault((h, key), []).append(
+                        (os.path.abspath(mod.path), mod.relpath,
+                         expr.lineno))
+    index._cache["lock_graph"] = (edges, sites)
+    return edges, sites
+
+
+def _reaches(edges, src, dst, _seen=None):
+    if _seen is None:
+        _seen = set()
+    if src == dst:
+        return True
+    if src in _seen:
+        return False
+    _seen.add(src)
+    return any(_reaches(edges, nxt, dst, _seen)
+               for nxt in edges.get(src, ()))
+
+
+@rule("C2", "lock-order inversion in the acquires-while-holding graph")
+def check_c2(ctx):
+    """Cycle search over the project-global acquires-while-holding graph:
+    one code path takes A then B while another takes B then ... then A.
+    Two threads, one in each order, deadlock — the classic inversion no
+    single file shows, which is why this rule rides the whole-program index
+    and the call graph (a helper that takes B counts against every caller
+    holding A). Keys are nominal, so `req._lock -> Router._lock` in
+    fleet/router.py and the reverse order in another module still collide."""
+    index = project.index_for(ctx)
+    edges, sites = _lock_graph(index)
+    here = os.path.abspath(ctx.path)
+    out, seen = [], set()
+    for (a, b), locs in sorted(sites.items()):
+        if not _reaches(edges, b, a):
+            continue
+        reverse = sites.get((b, a))
+        via = (f"the opposite order is taken at "
+               f"{reverse[0][1]}:{reverse[0][2]}" if reverse else
+               f"`{b}` reaches `{a}` through intermediate locks")
+        for path, _, line in locs:
+            if path != here or (a, b, line) in seen:
+                continue
+            seen.add((a, b, line))
+            out.append(ctx.finding(
+                line, f"lock-order inversion: `{b}` acquired while holding "
+                f"`{a}`, but {via} — one thread in each order deadlocks; "
+                "pick one global order"))
+    return out
+
+
+# ------------------------------------------------------------------- C3
+
+_DEVICE_SYNC_CALLS = {"jax.block_until_ready", "block_until_ready",
+                      "jax.device_get", "device_get"}
+_FUTURE_PARTS = {"fut", "future", "futures", "promise"}
+
+
+def _parts(name):
+    return set(name.lower().strip("_").split("_"))
+
+
+@rule("C3", "blocking call or device sync while holding a lock")
+def check_c3(ctx):
+    """An untimed `Event.wait` / `Queue.get` / `Thread.join` /
+    `future.result`, or a device sync (`block_until_ready`, `device_get`)
+    inside a `with lock:` body pins the lock for the full wait: every other
+    acquirer stalls behind a wait that may never end, and if the thing being
+    waited on needs the same lock to make progress the wait IS the deadlock.
+    Device syncs are the serving-stack special: a swap that fetches under
+    the corpus lock blocks every reader for the full transfer. Waits on the
+    held condition variable itself are exempt (`cv.wait` releases it), as
+    are timed waits (bounded stall, surfaced by the caller). Queue/thread
+    receivers are binding-aware (R11's tables) so `dict.get` never trips."""
+    index = project.index_for(ctx)
+    mod = index.module_for(ctx.path)
+    if mod is None:
+        return []
+    queues, threads, _ = _r11_bindings(mod.tree)
+    units, entry = _module_entries(index, mod)
+    out = []
+    for owner, node in units:
+        keyer = _make_keyer(owner, mod, index)
+        nodes, _ = _walk_held(node, keyer, entry[id(node)])
+        for n, held in nodes:
+            if not held or not isinstance(n, ast.Call):
+                continue
+            desc = _blocking_desc(n, keyer, held, queues, threads,
+                                  owner)
+            if desc is None:
+                continue
+            out.append(ctx.finding(
+                n, f"{desc} while holding {_lock_names(held)} — the lock "
+                "is pinned for the full wait, stalling every other "
+                "acquirer; move the wait outside the lock or bound it "
+                "with a timeout"))
+    return out
+
+
+def _blocking_desc(call, keyer, held, queues, threads, owner):
+    """Human-readable description when `call` blocks indefinitely or forces
+    a device sync, else None."""
+    name = call_name(call)
+    if name in _DEVICE_SYNC_CALLS:
+        return f"device sync `{name}(...)`"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    recv = dotted(call.func.value)
+    if attr == "block_until_ready":
+        return f"device sync `{recv}.block_until_ready()`"
+    if _r11_has_timeout(call):
+        return None
+    if attr == "wait":
+        # waiting on the held cv itself releases it — the sanctioned shape
+        if keyer(call.func.value) in held:
+            return None
+        return f"untimed `{recv}.wait()`"
+    if attr == "get" and recv in queues:
+        return f"untimed `{recv}.get()`"
+    if attr == "join" and recv in queues | threads:
+        return f"untimed `{recv}.join()`"
+    if attr == "result" and recv is not None and \
+            (_parts(recv.split(".")[-1]) & _FUTURE_PARTS):
+        return f"untimed `{recv}.result()`"
+    return None
+
+
+# ------------------------------------------------------------------- C4
+
+@rule("C4", "started non-daemon thread with no join/stop on any path")
+def check_c4(ctx):
+    """A `threading.Thread` started without `daemon=True` and never joined
+    anywhere in its module leaks: interpreter shutdown blocks on it forever
+    (non-daemon threads are waited on at exit), and in tests the leaked
+    worker outlives its fixture and corrupts the next one. The repo's
+    discipline is daemon threads joined-with-timeout in `stop()`; this rule
+    flags the construction site when neither escape hatch exists. Daemon-ness
+    also counts when assigned post-construction (`t.daemon = True`)."""
+    index = project.index_for(ctx)
+    mod = index.module_for(ctx.path)
+    if mod is None:
+        return []
+    started, joined, daemonized = set(), set(), set()
+    chained_start = set()   # id of ctor Call in Thread(...).start()
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            recv = dotted(n.func.value)
+            if n.func.attr == "start":
+                if recv is not None:
+                    started.add(recv)
+                elif isinstance(n.func.value, ast.Call):
+                    chained_start.add(id(n.func.value))
+            elif n.func.attr == "join" and recv is not None:
+                joined.add(recv)
+        elif isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and _const(n.value) is True:
+                    d = dotted(t.value)
+                    if d is not None:
+                        daemonized.add(d)
+    out = []
+    for spawn in mod.spawns:
+        if spawn.daemon:
+            continue
+        b = spawn.binding
+        if b is not None and b in daemonized:
+            continue
+        is_started = (b in started if b is not None
+                      else id(spawn.call) in chained_start)
+        if not is_started:
+            continue
+        if b is not None and b in joined:
+            continue
+        out.append(ctx.finding(
+            spawn.call, "non-daemon `threading.Thread` is started but "
+            "never joined anywhere in this module — interpreter shutdown "
+            "blocks on it forever; pass daemon=True or join it with a "
+            "timeout on every path"))
+    return out
+
+
+# ------------------------------------------------------------------- C5
+
+_RESOLVE_ATTRS = {"set_result", "set_exception", "_set"}
+_CALLBACK_PARTS = {"callback", "callbacks", "cb", "cbs", "hook", "hooks",
+                   "listener", "listeners"}
+_REGISTRATION_PREFIXES = ("add", "remove", "register", "unregister",
+                          "subscribe")
+
+
+@rule("C5", "future resolved / callbacks invoked while holding a lock")
+def check_c5(ctx):
+    """Resolving a request future (`set_result`, `set_exception`, this
+    repo's `ReplyFuture._set`) or invoking user callbacks while holding a
+    router/corpus lock hands YOUR lock to arbitrary foreign code: a waiter
+    woken by the resolution — or the callback itself — can call straight
+    back into the component and re-acquire the lock (instant deadlock), or
+    simply run slow user code under it. The sanctioned shape is
+    `serve/service.py`'s `ReplyFuture._set`: swap the callback list out
+    under the lock, invoke after releasing it. The check follows the call
+    graph, so a `_resolve_locked` helper only ever called under `req._lock`
+    is analyzed with that lock held."""
+    index = project.index_for(ctx)
+    mod = index.module_for(ctx.path)
+    if mod is None:
+        return []
+    units, entry = _module_entries(index, mod)
+    out = []
+    for owner, node in units:
+        keyer = _make_keyer(owner, mod, index)
+        nodes, _ = _walk_held(node, keyer, entry[id(node)])
+        cb_vars = _callback_loop_vars(node)
+        for n, held in nodes:
+            if not held or not isinstance(n, ast.Call):
+                continue
+            desc = _resolving_desc(n, cb_vars)
+            if desc is None:
+                continue
+            out.append(ctx.finding(
+                n, f"{desc} while holding {_lock_names(held)} — the woken "
+                "waiter or callback can re-enter this component and "
+                "re-acquire the lock; snapshot under the lock, resolve/"
+                "invoke after releasing it"))
+    return out
+
+
+def _callback_loop_vars(func_node):
+    """Loop variables iterating something callback-named (`for cb in
+    callbacks:`) — calling one is a callback invocation."""
+    vars_ = set()
+    for n in ast.walk(func_node):
+        if isinstance(n, ast.For) and isinstance(n.target, ast.Name):
+            it = dotted(n.iter)
+            if it and (_parts(it.split(".")[-1]) & _CALLBACK_PARTS):
+                vars_.add(n.target.id)
+    return vars_
+
+
+def _resolving_desc(call, cb_vars):
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _RESOLVE_ATTRS:
+            return f"future resolution `{dotted(f.value)}.{f.attr}(...)`"
+        parts = _parts(f.attr)
+        if (parts & _CALLBACK_PARTS) and \
+                not f.attr.startswith(_REGISTRATION_PREFIXES):
+            return f"callback invocation `{dotted(f.value)}.{f.attr}(...)`"
+        return None
+    if isinstance(f, ast.Name):
+        if f.id in cb_vars:
+            return f"callback invocation `{f.id}(...)`"
+        if (_parts(f.id) & _CALLBACK_PARTS) and \
+                not f.id.startswith(_REGISTRATION_PREFIXES):
+            return f"callback invocation `{f.id}(...)`"
+    return None

@@ -16,8 +16,10 @@ Design notes
 
 import ast
 import dataclasses
+import io
 import os
 import re
+import tokenize
 
 # rule id -> (title, checker, scope_predicate_or_None); populated by @rule
 RULES = {}
@@ -80,7 +82,7 @@ class FileContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
-        self.suppressions = parse_suppressions(self.lines)
+        self.suppressions = parse_suppressions(source)
         self.current_rule = None  # set by analyze_file around each checker
 
     def finding(self, node_or_line, message, rule_id=None):
@@ -89,9 +91,19 @@ class FileContext:
                        message)
 
 
-def parse_suppressions(lines):
+def parse_suppressions(source):
+    """Token-aware: only real COMMENT tokens register — a disable quoted
+    inside a docstring (this package's own docs show the syntax) is prose,
+    not a suppression. Falls back to line-matching only if tokenization
+    fails (the file already parsed as AST, so it essentially never does)."""
     out = []
-    for i, text in enumerate(lines, start=1):
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        candidates = [(tok.start[0], tok.string) for tok in tokens
+                      if tok.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        candidates = list(enumerate(source.splitlines(), start=1))
+    for i, text in candidates:
         m = _SUPPRESS_RE.search(text)
         if not m:
             continue
@@ -120,11 +132,42 @@ def _suppression_findings(ctx):
     return out
 
 
-def analyze_file(path, root=None):
+def _unused_suppression_findings(ctx, used, select):
+    """Rule SUP: a reasoned disable whose rule did not fire on its lines is
+    stale — the code was fixed (or the disable never matched) and the
+    comment now silences nothing but reviewer attention. Only rules that
+    actually RAN are judged: a rule excluded by `--select` or a scope
+    predicate proves nothing about the disable. `disable=all` is exempt
+    (it documents intent, not one rule's firing)."""
+    out = []
+    for sup in ctx.suppressions:
+        if not sup.reason:
+            continue   # already a SUP finding; unknown-rule ids likewise
+        for rule_id in sup.rules:
+            if rule_id == "all" or rule_id not in RULES:
+                continue
+            if select is not None and rule_id not in select:
+                continue
+            scope = RULES[rule_id][2]
+            if scope is not None and not scope(ctx.relpath):
+                continue
+            if (sup.line, rule_id) in used:
+                continue
+            out.append(ctx.finding(
+                sup.line,
+                f"unused suppression: {rule_id} does not fire here — "
+                "delete the stale disable",
+                rule_id="SUP"))
+    return out
+
+
+def analyze_file(path, root=None, select=None):
     """Run every applicable rule on one file.
 
-    Returns (findings, suppressed) — `findings` are actionable (exit-code
-    relevant), `suppressed` carry their reasons for the JSON report.
+    `select` (a set of rule ids) restricts which registered rules run —
+    None means all. Returns (findings, suppressed) — `findings` are
+    actionable (exit-code relevant), `suppressed` carry their reasons for
+    the JSON report.
     """
     relpath = os.path.relpath(path, root) if root else path
     with open(path, encoding="utf-8") as f:
@@ -138,6 +181,8 @@ def analyze_file(path, root=None):
 
     raw = []
     for rule_id, (_, checker, scope) in RULES.items():
+        if select is not None and rule_id not in select:
+            continue
         if scope is not None and not scope(relpath):
             continue
         ctx.current_rule = rule_id
@@ -148,6 +193,7 @@ def analyze_file(path, root=None):
     sup_findings = _suppression_findings(ctx)
 
     findings, suppressed = [], []
+    used = set()   # (suppression line, rule id) pairs that silenced something
     for f in sorted(raw, key=lambda f: (f.line, f.rule)):
         sup = next((s for s in ctx.suppressions if s.covers(f.line, f.rule)),
                    None)
@@ -155,9 +201,11 @@ def analyze_file(path, root=None):
             f.suppressed = True
             f.suppress_reason = sup.reason
             suppressed.append(f)
+            used.add((sup.line, f.rule))
         else:
             findings.append(f)
     findings.extend(sup_findings)
+    findings.extend(_unused_suppression_findings(ctx, used, select))
     findings.sort(key=lambda f: (f.line, f.rule))
     return findings, suppressed
 
@@ -200,18 +248,20 @@ def default_targets():
     return root, targets
 
 
-def analyze_paths(paths, root=None):
+def analyze_paths(paths, root=None, select=None):
     """Analyze every .py under `paths`. Returns (findings, suppressed,
     n_files)."""
     findings, suppressed = [], []
     n = 0
     for path in iter_python_files(paths):
         n += 1
-        f, s = analyze_file(path, root=root)
+        f, s = analyze_file(path, root=root, select=select)
         findings.extend(f)
         suppressed.extend(s)
     return findings, suppressed, n
 
 
-# importing rules registers them (kept last: rules import helpers from here)
+# importing the rule modules registers them (kept last: both import helpers
+# from here; concurrency additionally imports helpers from rules)
 from . import rules  # noqa: E402,F401
+from . import concurrency  # noqa: E402,F401

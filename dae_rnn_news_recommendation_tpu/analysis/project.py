@@ -1,0 +1,269 @@
+"""threadcheck project index: whole-program facts for the C-rule family.
+
+The R-rules (rules.py) are pure per-file AST and deliberately so — each
+hazard they catch is visible inside one module. The concurrency rules
+(concurrency.py) are not that lucky: a lock acquired in `serve/corpus.py`
+can be re-entered via a callback registered in `fleet/rollout.py`, and
+whether a class is "thread-shared" depends on who spawns threads at it.
+This module builds the cross-file context those rules consume:
+
+  * per-class inventory — which `self.X` attributes hold locks / condition
+    variables / events / queues / threads (assigned from their `threading.*`
+    or `queue.*` constructors anywhere in the class), which methods exist,
+    and whether the class spawns threads;
+  * thread-spawn sites — every `threading.Thread(...)` construction in the
+    project with its daemon-ness, binding, and target; a method named as a
+    `target=` is marked on its owning class;
+  * an intra-package call graph — `self.method()` calls resolved to the
+    same class, bare-name calls resolved to same-module functions — good
+    enough to follow lock-holding across helper methods.
+
+The index is built lazily per "project": for a file inside a package
+(`__init__.py` chain), the whole top-level package is parsed and indexed
+once per process; for a standalone file (fixtures, tmp files), the project
+is just that file. Parsing is `ast` only — like the rest of jaxcheck, the
+index never imports the code it describes.
+"""
+
+import ast
+import os
+
+from .core import iter_python_files
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock",
+              "threading.Condition", "Condition", "threading.Semaphore",
+              "threading.BoundedSemaphore"}
+EVENT_CTORS = {"threading.Event", "Event"}
+QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+               "queue.SimpleQueue", "Queue", "LifoQueue", "PriorityQueue",
+               "SimpleQueue"}
+THREAD_CTORS = {"threading.Thread", "Thread"}
+
+# identifier parts that make a name lock-like even without a visible
+# constructor (a lock received as a parameter keeps its naming convention)
+_LOCKISH_PARTS = {"lock", "mutex", "cv", "cond"}
+
+
+def _call_name(node):
+    if not isinstance(node, ast.Call):
+        return None
+    return _dotted(node.func)
+
+
+def _dotted(node):
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _kw(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def name_is_lockish(name):
+    """'_lock', 'swap_lock', '_cv' — underscore-split part matching, so
+    `blocked`/`clock` never qualify."""
+    parts = set(name.lower().strip("_").split("_"))
+    return bool(parts & _LOCKISH_PARTS)
+
+
+class ThreadSpawn:
+    """One `threading.Thread(...)` construction site."""
+
+    __slots__ = ("module", "line", "daemon", "target", "binding", "call")
+
+    def __init__(self, module, call, binding):
+        self.module = module
+        self.line = call.lineno
+        self.call = call
+        daemon = _kw(call, "daemon")
+        self.daemon = (isinstance(daemon, ast.Constant)
+                       and daemon.value is True)
+        self.target = _dotted(_kw(call, "target")) if _kw(call, "target") \
+            else None
+        self.binding = binding   # dotted assign target ('t', 'self._thread')
+
+
+class ClassIndex:
+    """Lock/attribute/thread inventory for one class."""
+
+    def __init__(self, name, module, node):
+        self.name = name
+        self.module = module
+        self.node = node
+        self.methods = {}        # method name -> FunctionDef
+        self.lock_attrs = set()  # self.X = threading.Lock()/RLock()/Condition()
+        self.event_attrs = set()
+        self.queue_attrs = set()
+        self.thread_attrs = set()
+        self.spawns_thread = False
+        self.thread_targets = set()  # own methods used as Thread target=
+
+    def is_thread_shared(self):
+        """A class that allocates its own lock has declared itself shared
+        between threads; spawning a thread at one of its methods does too."""
+        return bool(self.lock_attrs) or self.spawns_thread \
+            or bool(self.thread_targets)
+
+
+class ModuleIndex:
+    def __init__(self, path, relpath, tree):
+        self.path = path
+        self.relpath = relpath
+        self.tree = tree
+        self.classes = []
+        self.functions = {}      # module-level name -> FunctionDef
+        self.module_locks = set()    # module-level `x = threading.Lock()`
+        self.spawns = []             # [ThreadSpawn]
+        self._scan()
+
+    def _scan(self):
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self.classes.append(self._scan_class(stmt))
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[stmt.name] = stmt
+            elif isinstance(stmt, ast.Assign):
+                if _call_name(stmt.value) in LOCK_CTORS:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks.add(t.id)
+        # thread spawns anywhere in the module (incl. nested functions):
+        # bound constructions keep their assign target, the rest (e.g.
+        # `threading.Thread(...).start()`) are recorded unbound
+        bound = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and \
+                    _call_name(node.value) in THREAD_CTORS:
+                bound[id(node.value)] = _dotted(node.targets[0])
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and _call_name(node) in THREAD_CTORS:
+                self.spawns.append(
+                    ThreadSpawn(self.relpath, node, bound.get(id(node))))
+
+    def _scan_class(self, node):
+        ci = ClassIndex(node.name, self.relpath, node)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[stmt.name] = stmt
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                ctor = _call_name(sub.value)
+                for t in sub.targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    if ctor in LOCK_CTORS:
+                        ci.lock_attrs.add(t.attr)
+                    elif ctor in EVENT_CTORS:
+                        ci.event_attrs.add(t.attr)
+                    elif ctor in QUEUE_CTORS:
+                        ci.queue_attrs.add(t.attr)
+                    elif ctor in THREAD_CTORS:
+                        ci.thread_attrs.add(t.attr)
+            if isinstance(sub, ast.Call) and _call_name(sub) in THREAD_CTORS:
+                ci.spawns_thread = True
+                target = _kw(sub, "target")
+                td = _dotted(target) if target is not None else None
+                if td and td.startswith("self."):
+                    ci.thread_targets.add(td.split(".", 1)[1])
+        return ci
+
+
+class ProjectIndex:
+    """All modules of one project, with cross-file lookup tables."""
+
+    def __init__(self, files, root=None):
+        self.modules = {}            # relpath -> ModuleIndex
+        self.classes = {}            # class name -> [ClassIndex]
+        self.thread_target_names = set()   # every dotted Thread target=
+        for path in files:
+            relpath = os.path.relpath(path, root) if root else \
+                os.path.basename(path)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (OSError, SyntaxError):
+                continue
+            mod = ModuleIndex(path, relpath, tree)
+            self.modules[relpath] = mod
+            for ci in mod.classes:
+                self.classes.setdefault(ci.name, []).append(ci)
+            for spawn in mod.spawns:
+                if spawn.target:
+                    self.thread_target_names.add(spawn.target)
+        # a method named as a thread target from ANOTHER file still marks
+        # its class thread-shared (`Thread(target=corpus.refresh_loop)`)
+        tails = {t.split(".")[-1] for t in self.thread_target_names}
+        for cls_list in self.classes.values():
+            for ci in cls_list:
+                if tails & set(ci.methods):
+                    ci.thread_targets |= tails & set(ci.methods)
+        self._cache = {}             # scratch space for rule-level passes
+
+    def module_for(self, path):
+        """ModuleIndex for an absolute file path (relpaths differ between
+        the analyzer's root and the index's — the path is the stable key)."""
+        ap = os.path.abspath(path)
+        for mod in self.modules.values():
+            if os.path.abspath(mod.path) == ap:
+                return mod
+        return None
+
+    def class_index(self, module_relpath, class_name):
+        for ci in self.classes.get(class_name, ()):
+            if ci.module == module_relpath:
+                return ci
+        lst = self.classes.get(class_name)
+        return lst[0] if lst else None
+
+    def lock_attr_names(self):
+        """Union of every known lock attribute name across the project —
+        lets `req._lock` (receiver of unknown type) be recognized as a lock
+        because SOME indexed class declares `_lock`."""
+        names = set()
+        for lst in self.classes.values():
+            for ci in lst:
+                names |= ci.lock_attrs
+        return names
+
+
+def _project_top(path):
+    """Top-most package directory containing `path`, or None when the file
+    is not inside a package (fixtures, tmp files, bench.py)."""
+    d = os.path.dirname(os.path.abspath(path))
+    top = None
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        top = d
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return top
+
+
+_INDEX_CACHE = {}
+
+
+def index_for(ctx):
+    """ProjectIndex for the project containing `ctx.path` — the whole
+    top-level package when the file lives in one, else the file alone.
+    Cached per process (one CLI/pytest run sees a stable tree)."""
+    top = _project_top(ctx.path)
+    if top is None:
+        key = os.path.abspath(ctx.path)
+        if key not in _INDEX_CACHE:
+            _INDEX_CACHE[key] = ProjectIndex([ctx.path])
+        return _INDEX_CACHE[key]
+    key = os.path.realpath(top)
+    if key not in _INDEX_CACHE:
+        files = list(iter_python_files([top]))
+        _INDEX_CACHE[key] = ProjectIndex(files, root=os.path.dirname(top))
+    return _INDEX_CACHE[key]

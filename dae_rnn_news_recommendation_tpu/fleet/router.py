@@ -66,7 +66,7 @@ class _FleetRequest:
     """Router-side record of one caller request across all its attempts."""
 
     __slots__ = ("id", "rid", "query", "deadline_at", "t_submit", "future",
-                 "lock", "inflight", "resolved", "retries", "hedged",
+                 "_lock", "inflight", "resolved", "retries", "hedged",
                  "parked", "tried")
 
     def __init__(self, req_id, query, deadline_at, t_submit):
@@ -77,7 +77,7 @@ class _FleetRequest:
         self.deadline_at = deadline_at
         self.t_submit = t_submit
         self.future = ReplyFuture()
-        self.lock = threading.Lock()
+        self._lock = threading.Lock()
         self.inflight = 0
         self.resolved = False
         self.retries = 0
@@ -232,7 +232,7 @@ class Router:
         the primary, "/rN" for a cross-replica retry, "/h" for the hedge
         twin) — all attempts share the parent id, so whichever one wins the
         exactly-one-outcome race stays attributable in traces and ledger."""
-        with req.lock:
+        with req._lock:
             if req.resolved:
                 return
             req.inflight += 1
@@ -261,33 +261,37 @@ class Router:
         if self.metrics is not None:
             self.metrics.gauge(f"outstanding.{replica.name}").set(out_now)
         redispatch = None
-        with req.lock:
+        outcome = None
+        with req._lock:
             req.inflight -= 1
             if req.resolved:
                 with self._lock:
                     self.counts["hedge_discarded"] += 1
                 return
             if reply.ok:
-                self._resolve_locked(req, reply, replica.name)
-                return
-            retryable = (reply.status == "error"
-                         or reply.reason in _RETRYABLE_SHEDS)
-            if retryable and req.retries < self.max_retries:
-                remaining = req.deadline_at - time.monotonic()
-                cand = (self._pick(exclude=set(req.tried))
-                        if remaining > 0 else None)
-                if cand is not None:
-                    req.retries += 1
-                    redispatch = cand
-            if redispatch is None:
-                if req.inflight > 0:
-                    # another attempt is still out: park this outcome, the
-                    # race is still winnable
-                    if req.parked is None:
-                        req.parked = (reply, replica.name)
-                else:
-                    parked, name = req.parked or (reply, replica.name)
-                    self._resolve_locked(req, parked, name)
+                outcome = self._mark_resolved(req, reply, replica.name)
+            else:
+                retryable = (reply.status == "error"
+                             or reply.reason in _RETRYABLE_SHEDS)
+                if retryable and req.retries < self.max_retries:
+                    remaining = req.deadline_at - time.monotonic()
+                    cand = (self._pick(exclude=set(req.tried))
+                            if remaining > 0 else None)
+                    if cand is not None:
+                        req.retries += 1
+                        redispatch = cand
+                if redispatch is None:
+                    if req.inflight > 0:
+                        # another attempt is still out: park this outcome,
+                        # the race is still winnable
+                        if req.parked is None:
+                            req.parked = (reply, replica.name)
+                    else:
+                        parked, name = req.parked or (reply, replica.name)
+                        outcome = self._mark_resolved(req, parked, name)
+        if outcome is not None:
+            self._publish(req, *outcome)
+            return
         if redispatch is not None:
             with self._lock:
                 self.counts["retries"] += 1
@@ -361,7 +365,7 @@ class Router:
             with self._lock:
                 self.counts["hedge_suppressed_no_replica"] += 1
             return
-        with req.lock:
+        with req._lock:
             if req.resolved:
                 return
             req.hedged = True
@@ -373,12 +377,20 @@ class Router:
 
     # ------------------------------------------------------------ terminals
     def _resolve_direct(self, req, reply):
-        with req.lock:
-            self._resolve_locked(req, reply, replica=None)
+        with req._lock:
+            outcome = self._mark_resolved(req, reply, replica=None)
+        self._publish(req, *outcome)
         return req.future
 
-    def _resolve_locked(self, req, reply, replica):
-        """The one place a request becomes terminal. Caller holds req.lock."""
+    def _mark_resolved(self, req, reply, replica):
+        """The one place a request becomes terminal. Caller holds req._lock;
+        only the terminal DECISION happens under it — flipping `resolved`
+        and freezing the final reply/record. Publication (resolving the
+        caller's future, counters, ledger) is deferred to `_publish` after
+        the lock is released: `future._set` wakes waiters and runs caller
+        callbacks, and foreign code must never run under a router lock (it
+        can call straight back into submit()/summary() and deadlock —
+        jaxcheck C5)."""
         assert not req.resolved
         req.resolved = True
         now = time.monotonic()
@@ -393,7 +405,6 @@ class Router:
                                     deadline_met=now <= req.deadline_at,
                                     request_id=reply.request_id or req.rid,
                                     timings=timings)
-        req.future._set(final)
         rec = {"id": req.id, "request_id": final.request_id,
                "status": final.status, "reason": final.reason,
                "replica": replica, "corpus_version": final.corpus_version,
@@ -402,6 +413,13 @@ class Router:
                "timings": timings, "t_resolved": now}
         hedge_win = (final.ok and req.hedged and req.tried
                      and replica != req.tried[0])
+        return final, rec, hedge_win
+
+    def _publish(self, req, final, rec, hedge_win):
+        """Surface a terminal decision made by `_mark_resolved` — runs with
+        NO router/request lock held. Late attempts racing in are already
+        turned away by the `resolved` flag, so publication order is safe."""
+        req.future._set(final)
         with self._lock:
             key = {"ok": "replied", "shed": "shed", "error": "errors"}
             self.counts[key[final.status]] += 1
@@ -418,7 +436,7 @@ class Router:
             if hedge_win:
                 m.counter("hedge_wins").inc()
             if final.ok:
-                m.histogram("fleet_latency_ms").observe(latency_s * 1e3)
+                m.histogram("fleet_latency_ms").observe(final.latency_s * 1e3)
                 if not final.deadline_met:
                     m.counter("fleet_deadline_missed").inc()
         if self.ledger is not None:

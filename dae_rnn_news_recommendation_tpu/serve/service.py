@@ -429,7 +429,8 @@ class RecommendationService:
 
                 scores, indices = self.retry.run(call, site="serve.batch")
                 sp.fence_on(scores)
-        # jaxcheck: disable=R9 (nothing is swallowed: every request in the batch gets an explicit error Reply carrying this exception, counted in counts["errors"])
+        # nothing is swallowed: every request in the batch gets an explicit
+        # error Reply carrying this exception, counted in counts["errors"]
         except Exception as exc:
             detail = f"{type(exc).__name__}: {exc}"
             for p in live:
@@ -488,7 +489,8 @@ class RecommendationService:
         try:
             lost = self.corpus.quarantine_lost_shards(
                 note="nonfinite dispatch scores")
-        # jaxcheck: disable=R9 (nothing swallowed: returning None routes every request in the batch to an explicit error Reply)
+        # nothing swallowed: returning None routes every request in the
+        # batch to an explicit error Reply
         except Exception:
             return None
         fresh = self.corpus.active
@@ -502,7 +504,7 @@ class RecommendationService:
         try:
             out = serve_fn(self.params, *self._slot_args(fresh), batch)
             jax.block_until_ready(out)
-        # jaxcheck: disable=R9 (same contract: None -> explicit error Replies for the whole batch)
+        # same contract: None -> explicit error Replies for the whole batch
         except Exception:
             return None
         scores, indices = np.asarray(out[0]), np.asarray(out[1])
@@ -659,7 +661,11 @@ class RecommendationService:
                 self.params, *self._slot_args(slot),
                 np.zeros((self.buckets[0], f), np.float32))
             jax.block_until_ready(out)
-            self._floor_s = time.monotonic() - t0
+            floor = time.monotonic() - t0
+            # the flush thread may already be folding its own min() into
+            # _floor_s under the lock — don't race it with a bare store
+            with self._lock:
+                self._floor_s = floor
         finally:
             self._warmup_compiles = watcher.stop()
         self._post_warm_watcher = CompileWatcher().start()

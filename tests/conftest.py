@@ -24,6 +24,8 @@ import jax
 if not _ON_HW:
     jax.config.update("jax_platforms", "cpu")
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -31,3 +33,41 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# --------------------------------------------------------- thread sanitizer
+# The serve/fleet/chaos tests run real worker threads (batcher flush, hedge
+# scheduler, churn supervisor). An UNCAUGHT exception on one of those threads
+# only prints to stderr — the owning test still passes, and the bug ships.
+# threading.excepthook is process-global, so the recorder is session-scoped;
+# an autouse per-test fixture diffs the log and fails the test that owned
+# the crash. Tests that deliberately crash a thread consume their records
+# (`del log[start:]`) before teardown.
+
+@pytest.fixture(scope="session")
+def _thread_exception_log():
+    log = []
+    prev = threading.excepthook
+
+    def hook(args):
+        log.append(args)
+        prev(args)   # keep the stderr traceback for debugging
+
+    threading.excepthook = hook
+    yield log
+    threading.excepthook = prev
+
+
+@pytest.fixture(autouse=True)
+def _fail_on_background_thread_exception(_thread_exception_log):
+    start = len(_thread_exception_log)
+    yield
+    fresh = _thread_exception_log[start:]
+    if fresh:
+        del _thread_exception_log[start:]   # don't poison the next test
+        detail = "; ".join(
+            f"{a.exc_type.__name__}: {a.exc_value} (thread "
+            f"{getattr(a.thread, 'name', '?')})" for a in fresh)
+        pytest.fail(
+            f"uncaught exception on a background thread during this test: "
+            f"{detail}")

@@ -1,0 +1,42 @@
+"""C1 fixture: a thread-shared class (it allocates its own lock) writes one
+attribute both under `with self._lock:` and bare — the bare write races the
+locked read-modify-write. Clean twin guards every write of the attribute.
+"""
+
+import threading
+
+
+class HitCounter:
+    """Shared between the caller and a flush worker thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._epoch = 0
+
+    def add(self, k):
+        with self._lock:
+            self._n += k
+
+    def flush(self):
+        total = self._n
+        self._n = 0       # planted: C1
+        return total
+
+
+class CleanCounter:
+    """Same shape, every write of the guarded attribute under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def add(self, k):
+        with self._lock:
+            self._n += k
+
+    def flush(self):
+        with self._lock:
+            total = self._n
+            self._n = 0
+        return total

@@ -1,0 +1,39 @@
+"""C2 fixture: two module-level locks taken in opposite nesting order by two
+code paths — one thread in each order deadlocks. Clean twin uses a single
+global order for its own pair of locks.
+"""
+
+import threading
+
+swap_lock = threading.Lock()
+stats_lock = threading.Lock()
+
+
+def publish(version, stats):
+    with swap_lock:
+        with stats_lock:       # planted: C2
+            stats["version"] = version
+
+
+def snapshot(stats):
+    with stats_lock:
+        with swap_lock:        # planted: C2
+            return dict(stats)
+
+
+# ---- clean twin: same nesting depth, one consistent order ----
+
+order_lock = threading.Lock()
+inner_lock = threading.Lock()
+
+
+def update(d, k, v):
+    with order_lock:
+        with inner_lock:
+            d[k] = v
+
+
+def read(d, k):
+    with order_lock:
+        with inner_lock:
+            return d.get(k)

@@ -1,0 +1,48 @@
+"""C3 fixture: untimed queue get / event wait / device sync inside a lock
+body pins the lock for the full wait. Clean twins: timed waits outside the
+lock, and the sanctioned `cv.wait()` shape (waiting on the held condition
+variable releases it).
+"""
+
+import queue
+import threading
+
+import jax
+
+
+class ResultMailbox:
+    """A worker fills the queue; readers drain it under the state lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._q = queue.Queue(maxsize=8)
+        self._ready = threading.Event()
+
+    def take(self):
+        with self._lock:
+            return self._q.get()          # planted: C3
+
+    def await_ready(self):
+        with self._lock:
+            self._ready.wait()            # planted: C3
+
+    def score_sync(self, fn, batch):
+        with self._lock:
+            out = fn(batch)
+            jax.block_until_ready(out)    # planted: C3
+            return out
+
+    # ---- clean twins ----
+
+    def take_clean(self):
+        if not self._ready.is_set():
+            self._ready.wait(timeout=0.5)
+        return self._q.get(timeout=0.5)
+
+    def wait_for(self, pred):
+        # untimed wait on the HELD condition variable is the sanctioned
+        # shape: cv.wait releases the lock for the duration
+        with self._cv:
+            while not pred():
+                self._cv.wait()

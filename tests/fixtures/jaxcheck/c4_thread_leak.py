@@ -1,0 +1,35 @@
+"""C4 fixture: a started non-daemon Thread with no join anywhere in the
+module leaks — interpreter shutdown blocks on it forever. Clean twins:
+daemon=True at construction, daemon-ness assigned post-construction, and a
+non-daemon worker joined with a timeout.
+"""
+
+import threading
+
+
+def start_collector(sink):
+    worker = threading.Thread(target=sink.drain)   # planted: C4
+    worker.start()
+    return worker
+
+
+# ---- clean twins ----
+
+def start_collector_daemon(sink):
+    t = threading.Thread(target=sink.drain, daemon=True)
+    t.start()
+    return t
+
+
+def start_collector_flagged(sink):
+    helper = threading.Thread(target=sink.drain)
+    helper.daemon = True
+    helper.start()
+    return helper
+
+
+def run_bounded(sink):
+    t = threading.Thread(target=sink.drain)
+    t.start()
+    sink.close()
+    t.join(timeout=5.0)

@@ -1,0 +1,63 @@
+"""C5 fixture: resolving waiter futures / invoking subscriber callbacks
+while holding the component's lock hands the lock to foreign code — a woken
+waiter or callback that calls back in deadlocks instantly. Clean twin:
+snapshot under the lock, resolve/invoke after releasing it (the
+ReplyFuture._set shape).
+"""
+
+import threading
+
+
+class Broadcast:
+    """Fans one published value out to futures and callbacks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._futures = []
+        self._callbacks = []
+        self._value = None
+
+    def add_future(self, fut):
+        with self._lock:
+            self._futures.append(fut)
+
+    def add_callback(self, cb):
+        with self._lock:
+            self._callbacks.append(cb)
+
+    def publish(self, value):
+        with self._lock:
+            self._value = value
+            for fut in self._futures:
+                fut.set_result(value)      # planted: C5
+            for cb in self._callbacks:
+                cb(value)                  # planted: C5
+
+
+class BroadcastClean:
+    """Same fan-out, foreign code only ever runs with the lock released."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._futures = []
+        self._callbacks = []
+        self._value = None
+
+    def add_future(self, fut):
+        with self._lock:
+            self._futures.append(fut)
+
+    def add_callback(self, cb):
+        with self._lock:
+            self._callbacks.append(cb)
+
+    def publish(self, value):
+        with self._lock:
+            self._value = value
+            futures = list(self._futures)
+            callbacks = list(self._callbacks)
+            self._futures.clear()
+        for fut in futures:
+            fut.set_result(value)
+        for cb in callbacks:
+            cb(value)

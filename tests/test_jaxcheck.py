@@ -54,7 +54,8 @@ def test_every_rule_has_a_fixture():
         planted |= {r for _, r in
                     planted_markers(os.path.join(FIXTURE_DIR, name))}
     assert {"R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
-            "R10", "R11", "R12", "R13", "R14"} <= planted
+            "R10", "R11", "R12", "R13", "R14",
+            "C1", "C2", "C3", "C4", "C5"} <= planted
 
 
 @pytest.mark.parametrize("name", fixture_files())

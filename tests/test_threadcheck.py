@@ -1,0 +1,287 @@
+"""threadcheck (C1-C5) wiring into tier-1.
+
+Mirrors test_jaxcheck.py for the concurrency rule family:
+  * seeded   — the c*_ fixtures' planted violations fire and their clean
+               twins stay silent (the parametrized fixture tests in
+               test_jaxcheck.py already sweep them; here we pin the
+               CROSS-FILE and call-graph behaviors those can't show);
+  * self-clean — the repo's contract set has zero unsuppressed C findings;
+  * CLI      — --select / --list-rules ergonomics;
+  * suppressions — multi-rule one-line disables, standalone disable above a
+               decorated def, unused-suppression reporting, and the
+               SUP-cannot-be-suppressed laundering guard.
+"""
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from dae_rnn_news_recommendation_tpu.analysis import (
+    RULES, analyze_file, analyze_paths, default_targets)
+from dae_rnn_news_recommendation_tpu.analysis.__main__ import main as cli_main
+from dae_rnn_news_recommendation_tpu.analysis.core import parse_suppressions
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "jaxcheck")
+C_RULES = {"C1", "C2", "C3", "C4", "C5"}
+
+
+def _write(path, src):
+    path.write_text(textwrap.dedent(src))
+    return str(path)
+
+
+# ---------------------------------------------------------------- registry
+
+def test_c_rules_registered():
+    assert C_RULES <= set(RULES)
+
+
+# -------------------------------------------------- cross-file / call graph
+
+def test_c2_inversion_across_modules(tmp_path):
+    """The tentpole case per-file analysis cannot see: module A orders
+    a_lock -> b_lock, module B (importing both) orders b_lock -> a_lock.
+    The whole-package index keys module-level locks globally, so each file
+    gets its own finding at its inner acquisition."""
+    pkg = tmp_path / "lockpkg"
+    pkg.mkdir()
+    _write(pkg / "__init__.py", "")
+    mod_a = _write(pkg / "mod_a.py", """\
+        import threading
+
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+
+        def forward(d):
+            with a_lock:
+                with b_lock:
+                    d["fwd"] = True
+        """)
+    mod_b = _write(pkg / "mod_b.py", """\
+        from .mod_a import a_lock, b_lock
+
+
+        def backward(d):
+            with b_lock:
+                with a_lock:
+                    d["bwd"] = True
+        """)
+    fa, _ = analyze_file(mod_a, root=str(tmp_path))
+    fb, _ = analyze_file(mod_b, root=str(tmp_path))
+    assert [f.rule for f in fa] == ["C2"]
+    assert [f.rule for f in fb] == ["C2"]
+    # each finding names the opposite order's location in the OTHER module
+    assert "mod_b.py" in fa[0].message
+    assert "mod_a.py" in fb[0].message
+
+
+def test_c5_through_call_graph(tmp_path):
+    """A helper only ever called under the lock is analyzed with the lock
+    held — the resolution inside it is flagged even though no `with` is
+    lexically visible there."""
+    p = _write(tmp_path / "helper_resolve.py", """\
+        import threading
+
+
+        class Resolver:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def finish(self, fut, value):
+                with self._lock:
+                    self._n += 1
+                    self._mark(fut, value)
+
+            def _mark(self, fut, value):
+                fut.set_result(value)
+        """)
+    findings, _ = analyze_file(p, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["C5"]
+    assert findings[0].line == 15   # inside _mark, not at the call site
+
+
+def test_c1_tolerates_helper_called_under_lock(tmp_path):
+    """The inverse of the C5 case: a write inside a helper counts as locked
+    when every call site holds the lock — no false positive."""
+    p = _write(tmp_path / "helper_write.py", """\
+        import threading
+
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._v = None
+
+            def put(self, v):
+                with self._lock:
+                    self._store(v)
+
+            def swap(self, v):
+                with self._lock:
+                    old = self._v
+                    self._store(v)
+                    return old
+
+            def _store(self, v):
+                self._v = v
+        """)
+    findings, _ = analyze_file(p, root=str(tmp_path))
+    assert findings == []
+
+
+# -------------------------------------------------------------- self-clean
+
+def test_repo_is_self_clean_for_c_rules():
+    """The acceptance criterion, scoped to the new family: zero unsuppressed
+    C findings on the package + bench.py + evidence/."""
+    root, targets = default_targets()
+    findings, _, n_files = analyze_paths(targets, root=root, select=C_RULES)
+    assert n_files > 30
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_cli_list_rules(capsys):
+    rc = cli_main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    listed = {line.split(":")[0] for line in out.splitlines() if ":" in line}
+    assert C_RULES <= listed
+    assert {"R1", "R14"} <= listed
+
+
+def test_cli_select_runs_only_named_rules(capsys):
+    path = os.path.join(FIXTURE_DIR, "c4_thread_leak.py")
+    rc = cli_main(["--select", "C4", path])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "C4" in out
+    # same file, disjoint selection: nothing fires
+    rc = cli_main(["--select", "C1,C2", path])
+    assert rc == 0
+
+
+def test_cli_select_unknown_rule_is_usage_error(capsys):
+    rc = cli_main(["--select", "C9", os.path.join(FIXTURE_DIR,
+                                                  "c4_thread_leak.py")])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "C9" in err
+
+
+# ------------------------------------------------------------- suppressions
+
+def test_multi_rule_one_line_disable(tmp_path):
+    """`disable=C3,C5` silences two rules firing on the same line, and both
+    count as used (no stale-disable report)."""
+    p = _write(tmp_path / "multi.py", """\
+        import queue
+        import threading
+
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(maxsize=2)
+
+            def pump(self, fut):
+                with self._lock:
+                    # jaxcheck: disable=C3,C5 (producer is bound and lock-free; fut carries no callbacks)
+                    fut.set_result(self._q.get())
+        """)
+    findings, suppressed = analyze_file(p, root=str(tmp_path))
+    assert findings == []
+    assert sorted(s.rule for s in suppressed) == ["C3", "C5"]
+
+
+def test_standalone_disable_above_decorated_def():
+    """A comment between the decorator and the `def` is legal Python; the
+    tokenizer must surface it and it covers the def line below — the
+    documented placement for suppressing a def-anchored finding. It does
+    NOT stretch into the body."""
+    src = ("import functools\n"
+           "@functools.lru_cache\n"
+           "# jaxcheck: disable=C4 (demo placement)\n"
+           "def f():\n"
+           "    return 1\n")
+    sups = parse_suppressions(src)
+    assert len(sups) == 1
+    assert sups[0].line == 3
+    assert sups[0].rules == ("C4",)
+    assert sups[0].covers(4, "C4")        # the def line directly below
+    assert not sups[0].covers(5, "C4")    # never the body
+
+
+def test_docstring_disable_is_prose_not_suppression():
+    """The token-aware parser ignores disables quoted inside strings — a
+    docstring SHOWING the syntax must neither suppress nor be reported as
+    an unused disable."""
+    src = ('"""Example:\n'
+           '    x = y  # jaxcheck: disable=R3 (docs only)\n'
+           '"""\n')
+    assert parse_suppressions(src) == []
+
+
+def test_unused_suppression_is_reported(tmp_path):
+    p = _write(tmp_path / "stale.py", """\
+        import threading
+
+
+        def tidy():
+            # jaxcheck: disable=C4 (was a leak once, fixed since)
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+        """)
+    findings, _ = analyze_file(p, root=str(tmp_path))
+    assert [f.rule for f in findings] == ["SUP"]
+    assert "unused suppression" in findings[0].message
+    # ...but not when the named rule was excluded from the run: a rule that
+    # didn't execute proves nothing about the disable
+    findings, _ = analyze_file(p, root=str(tmp_path), select={"C1"})
+    assert findings == []
+
+
+def test_sup_not_launderable_via_reasoned_disable(tmp_path):
+    """Even a REASONED `disable=SUP` cannot silence SUP: SUP findings are
+    generated after suppression matching, and naming SUP is itself an
+    unknown-rule finding."""
+    p = _write(tmp_path / "launder.py", """\
+        import threading
+
+
+        def tidy():
+            # jaxcheck: disable=SUP (attempting to launder)
+            # jaxcheck: disable=C4
+            t = threading.Thread(target=print)
+            t.start()
+        """)
+    findings, _ = analyze_file(p, root=str(tmp_path))
+    rules = [f.rule for f in findings]
+    assert rules.count("SUP") >= 2   # unknown-rule SUP + reasonless disable
+    assert "C4" in rules             # the reasonless disable silenced nothing
+
+
+# ------------------------------------------------- thread-exception fixture
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_thread_excepthook_records_and_is_consumable(_thread_exception_log):
+    """The conftest session fixture sees uncaught background-thread
+    exceptions; a test that EXPECTS one consumes the record so the autouse
+    teardown check doesn't fail it."""
+    start = len(_thread_exception_log)
+
+    def boom():
+        raise ZeroDivisionError("deliberate")
+
+    t = threading.Thread(target=boom, daemon=True)
+    t.start()
+    t.join(timeout=5.0)
+    assert len(_thread_exception_log) == start + 1
+    assert _thread_exception_log[-1].exc_type is ZeroDivisionError
+    del _thread_exception_log[start:]   # consumed: this crash was the point
