@@ -1130,8 +1130,58 @@ def _bench_serve_ivf(jax, params, config, sz):
         (e["imbalance"] for e in reversed(corpus.events)
          if e["event"] == "ivf_index"), None)
 
+    # ---- sharded corner (r16 default config). The memory figure is
+    # platform-independent arithmetic: a fleet of n_replicas fronting ONE
+    # mesh-sharded corpus holds private_bytes/n per replica, where
+    # private-copy replicas each hold the whole corpus + index.
+    n_replicas = sz.get("fleet_replicas", 3)
+    private = slot.resident_bytes() + slot.ivf.resident_bytes()
+    out["serve_corpus_bytes_private_copy"] = int(private)
+    out["serve_corpus_bytes_per_replica"] = int(
+        (private + n_replicas - 1) // n_replicas)
+    n_dev = jax.local_device_count()
+    if n_dev > 1:
+        from dae_rnn_news_recommendation_tpu.index import build_sharded_cells
+        from dae_rnn_news_recommendation_tpu.parallel.mesh import (get_mesh,
+                                                                   shard_rows)
+        from dae_rnn_news_recommendation_tpu.serve import (
+            make_sharded_ivf_serve_fn)
+
+        _phase(f"serve-ivf: sharded parity over {n_dev} shards")
+        mesh = get_mesh()
+        put = lambda x: shard_rows(x, mesh)
+        cells_s = build_sharded_cells(slot.emb, slot.valid, slot.scales,
+                                      slot.ivf.centroids, slot.ivf.assign,
+                                      n_shards=n_dev, device_put=put)
+        s_s, i_s = make_sharded_ivf_serve_fn(config, k_rec, best, mesh)(
+            params, put(slot.emb), put(slot.valid),
+            None if slot.scales is None else put(slot.scales),
+            cells_s, queries)
+        s_u, i_u = make_ivf_serve_fn(config, k_rec, best)(
+            params, slot.emb, slot.valid, slot.scales, slot.ivf, queries)
+        s_s, i_s, s_u, i_u = map(
+            lambda a: np.asarray(jax.device_get(a)), (s_s, i_s, s_u, i_u))
+        finite = np.isfinite(s_u)
+        # index-exact contract: same finiteness, same ids, bitwise scores
+        out["serve_ivf_sharded_parity"] = bool(
+            np.array_equal(finite, np.isfinite(s_s))
+            and np.array_equal(i_u[finite], i_s[finite])
+            and np.array_equal(s_u[finite].view(np.int32),
+                               s_s[finite].view(np.int32)))
+        out["serve_ivf_sharded_n_shards"] = int(n_dev)
+        # the cross-shard merge re-ranks n_shards*k per-shard candidates on
+        # top of the per-query shortlist read — its row-count overhead over
+        # the whole IVF read set (the bandwidth model of the merge cost)
+        out["serve_ivf_sharded_merge_overhead_frac"] = round(
+            n_dev * k_rec / (n_cells + best * cap + n_dev * k_rec), 4)
+    else:
+        out["serve_ivf_sharded"] = (
+            "skipped (single-device host: the sharded layout needs a mesh; "
+            "parity is tier-1-tested on the 8-device CPU mesh in "
+            "tests/test_ivf_sharded.py)")
+
     if jax.default_backend() == "tpu":
-        def run_service(**retrieval_kw):
+        def run_service(corpus=corpus, **retrieval_kw):
             svc = RecommendationService(
                 params, config, corpus, top_k=10, max_batch=64,
                 max_inflight=max(256, n_requests), flush_slack_s=0.05,
@@ -1152,12 +1202,27 @@ def _bench_serve_ivf(jax, params, config, sz):
 
         _phase(f"serve-ivf: qps race at probes {best} vs exact")
         qps_ivf = run_service(retrieval="ivf", probes=best)
-        qps_exact = run_service()
+        # the corpus is retrieval="ivf", so a kwarg-less service would
+        # DERIVE ivf (the r16 default) — the exact leg must say so
+        qps_exact = run_service(retrieval="exact")
         out["serve_ivf_queries_per_sec"] = round(qps_ivf, 1)
         out["serve_ivf_speedup"] = round(qps_ivf / max(qps_exact, 1e-9), 3)
         out["serve_ivf_shape"] = (
             f"{n_requests} reqs, top-10 of {n_corpus}, probes {best}/"
             f"{n_cells}, recall@10 {recall_curve[best]}, {F}->{D}")
+        if n_dev > 1:
+            # the default multi-device configuration end to end: a sharded
+            # IVF corpus and a kwarg-less (derived) service over it
+            from dae_rnn_news_recommendation_tpu.parallel.mesh import get_mesh
+
+            _phase(f"serve-ivf: sharded qps over {n_dev} shards")
+            scorpus = ServingCorpus(config, block=512, retrieval="ivf",
+                                    n_cells=n_cells, mesh=get_mesh())
+            scorpus.swap(params, articles, note="bench-ivf-sharded")
+            qps_sharded = run_service(corpus=scorpus, probes=best)
+            out["serve_ivf_sharded_qps"] = round(qps_sharded, 1)
+            out["serve_ivf_sharded_vs_flat"] = round(
+                qps_sharded / max(qps_ivf, 1e-9), 3)
     else:
         out["serve_ivf"] = (
             "skipped (TPU-only corner: off-TPU both retrieval modes lower "
@@ -1263,14 +1328,22 @@ def _bench_fleet(jax, params, config, sz):
     articles = sp.random(n_corpus, F, density=0.005, format="csr",
                          random_state=17, dtype=np.float32)
     dense = np.asarray(articles.todense(), np.float32)
+    # r16 topology: every replica fronts the SAME corpus (the rollout
+    # supervisor promotes it exactly once). Deliberately UNSHARDED here: a
+    # mesh-sharded corpus serializes every replica's dispatch through the
+    # process-wide mesh lock, which would make the hedge race measure lock
+    # contention instead of the hedging discipline — the sharded serving
+    # figures live in the serve-ivf corner (serve_ivf_sharded_*).
+    from dae_rnn_news_recommendation_tpu.serve import ServingCorpus
+    corpus = ServingCorpus(config, block=512)
     replicas = [
         ServiceReplica(
-            f"r{i}", params, config,
+            f"r{i}", params, config, corpus=corpus,
             lag_s=lag_s if i == n_replicas - 1 else 0.0,
             top_k=10, max_batch=32, max_inflight=max(256, n_requests),
             flush_slack_s=0.05, linger_s=0.001, default_deadline_s=sla_s)
         for i in range(n_replicas)]
-    out = {}
+    out = {"fleet_corpus_shared": True}
     try:
         probe_router = Router(replicas, hedge=False, seed=17)
         sup = FleetSupervisor(

@@ -1,8 +1,19 @@
 """One fleet member: a RecommendationService plus health, kill, and lag.
 
-A `ServiceReplica` owns one in-process `RecommendationService` and its own
-`ServingCorpus` — the fleet is data-parallel, every replica holds a full
-corpus copy, so any replica can answer any query and the router is free to
+A `ServiceReplica` owns one in-process `RecommendationService` fronting a
+`ServingCorpus`. Two corpus topologies compose with the router/rollout
+machinery unchanged:
+
+  * PRIVATE (pass `corpus=` per replica, or single-device hosts): the fleet
+    is data-parallel, every replica holds a full corpus copy.
+  * SHARED (pass the SAME `ServingCorpus` to every replica — the r16
+    default on multi-device hosts, where `serve.corpus.default_corpus`
+    builds one mesh-sharded IVF corpus): replicas front one sharded corpus,
+    so per-replica corpus memory is total/n_replicas instead of a full
+    copy, and the rollout supervisor promotes the shared corpus ONCE
+    instead of once per replica.
+
+Either way any replica can answer any query and the router is free to
 hedge. The wrapper adds the three things a router needs that a bare service
 does not expose:
 
@@ -36,7 +47,7 @@ import threading
 import time
 
 from ..reliability import faults as _faults
-from ..serve.corpus import ServingCorpus
+from ..serve.corpus import default_corpus
 from ..serve.service import RecommendationService, Reply, ReplyFuture
 
 HEALTH_STATES = ("warm", "degraded", "draining", "dead")
@@ -48,8 +59,11 @@ class ServiceReplica:
     :param name: stable replica id (router ledger + rollout reports use it).
     :param params: encoder params shared across the fleet.
     :param config: the model's DAEConfig.
-    :param corpus: this replica's OWN ServingCorpus (data-parallel full
-        copy). Built here when None.
+    :param corpus: the ServingCorpus this replica fronts. Pass the same
+        instance to several replicas to share one (sharded) corpus across
+        the fleet. None builds this host's default
+        (`serve.corpus.default_corpus`: mesh-sharded IVF on multi-device
+        hosts, single-device exact otherwise) privately for this replica.
     :param lag_s: fixed extra delay added to every reply's resolution — the
         deterministic straggler knob (0 = none).
     :param registry: optional telemetry.MetricsRegistry shared with the
@@ -65,7 +79,7 @@ class ServiceReplica:
                  registry=None, **service_kw):
         self.name = str(name)
         self.metrics = registry
-        self.corpus = corpus if corpus is not None else ServingCorpus(config)
+        self.corpus = corpus if corpus is not None else default_corpus(config)
         service_kw.setdefault("name", self.name)
         service_kw.setdefault("registry", registry)
         self.service = RecommendationService(params, config, self.corpus,

@@ -29,6 +29,13 @@ The staged protocol, per `rollout(batch)`:
 `stage_hook(stage)` fires at each stage boundary ("canary", "probe",
 "fleet:<name>", "done"/"aborted") — the chaos harness uses it to kill a
 replica mid-rollout at a deterministic point.
+
+SHARED-CORPUS fleets (r16: every replica fronts the SAME mesh-sharded
+`ServingCorpus`) ride the identical protocol with the fleet stage
+collapsing: the canary's churn ingest IS the fleet promote — there is one
+corpus, promoted exactly once — so stage 3 records the sharing replicas
+under `report["shared"]` instead of re-applying, version skew is zero by
+construction, and a rollback is a single `revert()` on the one corpus.
 """
 
 import time
@@ -68,15 +75,27 @@ class FleetSupervisor:
                                      **churn_kw)
         self.history = []   # one report per bootstrap/rollout
 
+    def _shares_canary_corpus(self, replica):
+        """True when `replica` fronts the SAME corpus object as the canary —
+        the shared-corpus fleet topology, where the canary's promote IS the
+        fleet promote for that replica."""
+        return replica.corpus is self.canary.corpus
+
     # ----------------------------------------------------------- bootstrap
     def bootstrap(self, articles, note="bootstrap"):
         """Seed EVERY replica's corpus with the same full build (all at
         version 1); the canary's goes through the churn supervisor so its
-        host-side row mirror starts correct."""
+        host-side row mirror starts correct. Replicas sharing the canary's
+        corpus are already seeded by that one bootstrap — swapping again
+        would double-promote the single corpus."""
         self.churn.bootstrap(articles, note=note)
+        shared = []
         for r in self.replicas[1:]:
+            if self._shares_canary_corpus(r):
+                shared.append(r.name)
+                continue
             r.corpus.swap(self.params, articles, note=note)
-        report = {"action": "bootstrap",
+        report = {"action": "bootstrap", "shared": shared,
                   "versions": {r.name: r.corpus.version
                                for r in self.replicas}}
         self.history.append(report)
@@ -93,7 +112,7 @@ class FleetSupervisor:
         hook = stage_hook or (lambda stage: None)
         pre = {r.name: r.corpus.version for r in self.replicas}
         report = {"action": "rollout", "note": note, "pre_versions": dict(pre),
-                  "skipped": [], "reverted": [], "ok": False,
+                  "skipped": [], "shared": [], "reverted": [], "ok": False,
                   "stage": "canary"}
 
         def close(ok, detail):
@@ -138,6 +157,13 @@ class FleetSupervisor:
         # 3. fleet, one replica at a time: live versions stay in {v, v+1}
         for r in self.replicas[1:]:
             hook(f"fleet:{r.name}")
+            if self._shares_canary_corpus(r):
+                # shared corpus: the canary ingest already promoted the one
+                # corpus this replica serves from — applying again would
+                # double-swap it. NOT added to `promoted`: a rollback must
+                # revert the shared corpus exactly once (the canary entry).
+                report["shared"].append(r.name)
+                continue
             if r.health() == "dead":
                 report["skipped"].append(r.name)
                 continue
@@ -150,8 +176,11 @@ class FleetSupervisor:
                                     "pre-canary")
             promoted.append(r)
         report["stage"] = "fleet"
+        covered = len(promoted) + len(report["shared"])
         return close(True, "rolled out to "
-                           f"{len(promoted)}/{len(self.replicas)} replicas"
+                           f"{covered}/{len(self.replicas)} replicas"
+                           + (f" ({len(report['shared'])} via shared corpus)"
+                              if report["shared"] else "")
                            + (f" (skipped dead: {report['skipped']})"
                               if report["skipped"] else ""))
 
@@ -222,4 +251,6 @@ class FleetSupervisor:
                 "versions": {r.name: r.corpus.version
                              for r in self.replicas},
                 "canary": self.canary.name,
+                "shared_corpus": [r.name for r in self.replicas[1:]
+                                  if self._shares_canary_corpus(r)],
                 "churn": self.churn.summary()}

@@ -8,14 +8,18 @@ composition hook: appended rows route to existing cells without a refit.
 """
 
 from .kmeans import KMeansResult, assign_cells, kmeans_fit
-from .layout import CAP_ROUND, IVFCells, build_cells, cell_stats
+from .layout import (CAP_ROUND, IVFCells, ShardedIVFCells, build_cells,
+                     build_sharded_cells, cell_shard_owner, cell_stats)
 
 __all__ = [
     "CAP_ROUND",
     "IVFCells",
     "KMeansResult",
+    "ShardedIVFCells",
     "assign_cells",
     "build_cells",
+    "build_sharded_cells",
+    "cell_shard_owner",
     "cell_stats",
     "kmeans_fit",
 ]

@@ -25,10 +25,27 @@ Cell `C` (one extra) is an all-padding dummy: shortlist dedup and query
 padding point at it, so every shortlist entry is always a readable panel.
 Rows within a cell keep ascending original order (stable sort), though the
 scorer does not rely on it.
+
+SHARDED layout (`ShardedIVFCells`, built by `build_sharded_cells`): the same
+permutation view partitioned across a 1-D device mesh so a corpus can
+outgrow one device. Cells are partitioned BY CENTROID — shard `s` owns whole
+cells `[s*cps, (s+1)*cps)` with `cps = ceil(C / n_shards)` — and the slab
+array is SHARD-MAJOR: shard `s`'s region starts at per-shard row offset
+`s * (cps+1) * cap` and holds its `cps` owned cells plus its OWN local dummy
+slab (shortlist entries a shard does not own point at its local dummy, so
+every shard's gather stays a readable panel). Cells past `C` (when
+`n_shards` does not divide `C`) are empty padding cells on the last shards —
+never probed, because the replicated centroid scan only knows `C` real
+centroids. Every shard's region is the same `(cps+1)*cap` rows, so
+`parallel.mesh.shard_rows` places the slab arrays with each shard's cells
+exactly on its own device; `row_ids` keep ORIGINAL (global) slot row
+numbers, which is what makes the cross-shard merge index-exact.
 """
 
+import dataclasses
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -67,7 +84,112 @@ class IVFCells(NamedTuple):
                         self.cell_scales, self.row_ids, self.assign)))
 
 
-def build_cells(emb, valid, scales, centroids, assign):
+@dataclasses.dataclass(frozen=True)
+class ShardedIVFCells:
+    """Shard-major IVF index over a row-sharded corpus.
+
+    The slab arrays hold `n_shards * (cells_per_shard + 1)` cell slabs in
+    shard-major order (each shard's owned cells, then its local dummy) and
+    are placed row-sharded so shard `s`'s slabs live on device `s`.
+    `centroids` and `assign` are replicated — the centroid scan runs on
+    every device. The int fields are pytree AUX DATA (static at trace
+    time), so the per-shard gather can derive its shapes and ownership
+    arithmetic without tracing them."""
+
+    centroids: object      # [C, D] f32 unit rows, replicated
+    cell_emb: object       # [n_shards*(cps+1)*cap, D] slot dtype, row-sharded
+    cell_valid: object     # [n_shards*(cps+1)*cap] f32, row-sharded
+    cell_scales: object    # [n_shards*(cps+1)*cap] f32, row-sharded
+    row_ids: object        # [n_shards*(cps+1)*cap] int32 GLOBAL slot rows
+    assign: object         # [N] int32, replicated
+    n_shards: int
+    cells_per_shard: int   # cps: ceil(C / n_shards), whole cells per shard
+    cell_cap: int          # uniform rows per cell slab
+
+    @property
+    def n_cells(self):
+        return self.centroids.shape[0]
+
+    @property
+    def n_rows(self):
+        return self.assign.shape[0]
+
+    @property
+    def shard_rows(self):
+        """Per-shard row stride: shard s's slabs start at s * shard_rows."""
+        return (self.cells_per_shard + 1) * self.cell_cap
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+    def resident_bytes(self):
+        return int(sum(np.prod(a.shape) * a.dtype.itemsize for a in
+                       (self.centroids, self.cell_emb, self.cell_valid,
+                        self.cell_scales, self.row_ids, self.assign)))
+
+
+jax.tree_util.register_pytree_node(
+    ShardedIVFCells,
+    lambda c: ((c.centroids, c.cell_emb, c.cell_valid, c.cell_scales,
+                c.row_ids, c.assign),
+               (c.n_shards, c.cells_per_shard, c.cell_cap)),
+    lambda aux, ch: ShardedIVFCells(*ch, *aux))
+
+
+def cell_shard_owner(cells):
+    """[C] int: which shard owns each real cell (cell // cells_per_shard)."""
+    return np.arange(cells.n_cells) // int(cells.cells_per_shard)
+
+
+def _cell_positions(assign_np, counts, cap, n_slabs, slab_of_cell):
+    """[n_slabs, cap] original-row positions (-1 = padding): stable sort
+    keeps ascending original order within each cell; the vectorized fill
+    places sorted row r at (its cell's slab, its rank in the cell)."""
+    n = assign_np.shape[0]
+    pos = np.full((n_slabs, cap), -1, np.int64)
+    order = np.argsort(assign_np, kind="stable")
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    in_cell = np.arange(n, dtype=np.int64) - starts[assign_np[order]]
+    pos[slab_of_cell[assign_np[order]], in_cell] = order
+    return pos
+
+
+def _cell_cap(counts, cap_min):
+    need = max(int(counts.max(initial=0)), int(cap_min or 0))
+    return int(max(CAP_ROUND, -(-need // CAP_ROUND) * CAP_ROUND))
+
+
+def _gathered_slabs(emb, valid, scales, pos):
+    """Gather the slot arrays into the slab order `pos` describes; returns
+    (cell_emb, cell_valid, cell_scales, row_ids) with padding slots masked
+    (valid 0, scale 1, sentinel row id)."""
+    n = emb.shape[0]
+    flat = pos.reshape(-1)
+    present = flat >= 0
+    gather = jnp.asarray(np.where(present, flat, 0).astype(np.int32))
+    mask = jnp.asarray(present)
+    scales_j = (jnp.ones((n,), jnp.float32) if scales is None
+                else jnp.asarray(scales, jnp.float32))
+    return (
+        jnp.take(emb, gather, axis=0),
+        jnp.where(mask, jnp.take(
+            jnp.asarray(valid).astype(jnp.float32), gather), 0.0),
+        jnp.where(mask, jnp.take(scales_j, gather), 1.0),
+        jnp.asarray(np.where(present, flat, _IDX_SENTINEL).astype(np.int32)),
+    )
+
+
+def _check_assign(assign, centroids, n):
+    assign_np = np.asarray(assign).astype(np.int64)
+    c = int(np.asarray(centroids).shape[0])
+    if assign_np.shape[0] != n:
+        raise ValueError(f"assign covers {assign_np.shape[0]} rows, corpus {n}")
+    counts = (np.bincount(assign_np, minlength=c) if n
+              else np.zeros(c, np.int64))
+    return assign_np, c, counts
+
+
+def build_cells(emb, valid, scales, centroids, assign, *, cap_min=None):
     """Permute a (quantized) corpus into cell-major slabs.
 
     :param emb: [N, D] slot embeddings, any corpus dtype — gathered as-is
@@ -75,47 +197,69 @@ def build_cells(emb, valid, scales, centroids, assign):
     :param scales: [N] f32 per-row dequant scales, or None for ones
     :param centroids: [C, D] f32 (host or device)
     :param assign: [N] int32 cell id per row (host)
+    :param cap_min: optional floor on the uniform cell capacity — pins the
+        layout shapes across swaps whose occupancy skews, so the serving
+        variants compiled at warmup keep dispatching (zero-recompile soaks)
     :returns: IVFCells with all large arrays on device
     """
     emb = jnp.asarray(emb)
-    n = emb.shape[0]
-    assign_np = np.asarray(assign).astype(np.int64)
-    c = int(np.asarray(centroids).shape[0])
-    if assign_np.shape[0] != n:
-        raise ValueError(f"assign covers {assign_np.shape[0]} rows, corpus {n}")
-    counts = np.bincount(assign_np, minlength=c) if n else np.zeros(c, np.int64)
-    cap = int(max(CAP_ROUND, -(-int(counts.max(initial=0)) // CAP_ROUND) * CAP_ROUND))
-
-    # stable sort keeps ascending original order within each cell; the
-    # vectorized fill places sorted row r at (its cell, its rank in the cell)
-    pos = np.full((c + 1, cap), -1, np.int64)
-    order = np.argsort(assign_np, kind="stable")
-    starts = np.concatenate([[0], np.cumsum(counts)])
-    in_cell = np.arange(n, dtype=np.int64) - starts[assign_np[order]]
-    pos[assign_np[order], in_cell] = order
-
-    flat = pos.reshape(-1)
-    present = flat >= 0
-    gather = jnp.asarray(np.where(present, flat, 0).astype(np.int32))
-    mask = jnp.asarray(present)
-    scales_j = (jnp.ones((n,), jnp.float32) if scales is None
-                else jnp.asarray(scales, jnp.float32))
+    assign_np, c, counts = _check_assign(assign, centroids, emb.shape[0])
+    cap = _cell_cap(counts, cap_min)
+    pos = _cell_positions(assign_np, counts, cap, c + 1,
+                          np.arange(c, dtype=np.int64))
+    cell_emb, cell_valid, cell_scales, row_ids = _gathered_slabs(
+        emb, valid, scales, pos)
     return IVFCells(
         centroids=jnp.asarray(centroids, jnp.float32),
-        cell_emb=jnp.take(emb, gather, axis=0),
-        cell_valid=jnp.where(mask, jnp.take(
-            jnp.asarray(valid).astype(jnp.float32), gather), 0.0),
-        cell_scales=jnp.where(mask, jnp.take(scales_j, gather), 1.0),
-        row_ids=jnp.asarray(
-            np.where(present, flat, _IDX_SENTINEL).astype(np.int32)),
+        cell_emb=cell_emb, cell_valid=cell_valid, cell_scales=cell_scales,
+        row_ids=row_ids, assign=jnp.asarray(assign_np.astype(np.int32)))
+
+
+def build_sharded_cells(emb, valid, scales, centroids, assign, *, n_shards,
+                        cap_min=None, device_put=None):
+    """Permute a (quantized) corpus into SHARD-MAJOR cell slabs (see module
+    docstring): shard s owns whole cells [s*cps, (s+1)*cps) plus a local
+    dummy, every shard's region is (cps+1)*cap rows.
+
+    :param n_shards: mesh size; each shard's region must land on one device
+    :param device_put: placement closure for the slab arrays (typically the
+        corpus's row-sharder); centroids/assign are placed plain (replicated
+        into the compiled programs by the partitioner)
+    :returns: ShardedIVFCells
+    """
+    emb = jnp.asarray(emb)
+    n_shards = int(n_shards)
+    assert n_shards >= 1
+    assign_np, c, counts = _check_assign(assign, centroids, emb.shape[0])
+    cap = _cell_cap(counts, cap_min)
+    cps = -(-c // n_shards)                      # whole cells per shard
+    cells = np.arange(c, dtype=np.int64)
+    slab_of_cell = (cells // cps) * (cps + 1) + cells % cps
+    pos = _cell_positions(assign_np, counts, cap, n_shards * (cps + 1),
+                          slab_of_cell)
+    cell_emb, cell_valid, cell_scales, row_ids = _gathered_slabs(
+        emb, valid, scales, pos)
+    put = device_put if device_put is not None else (lambda x: x)
+    return ShardedIVFCells(
+        centroids=jnp.asarray(centroids, jnp.float32),
+        cell_emb=put(cell_emb), cell_valid=put(cell_valid),
+        cell_scales=put(cell_scales), row_ids=put(row_ids),
         assign=jnp.asarray(assign_np.astype(np.int32)),
-    )
+        n_shards=n_shards, cells_per_shard=int(cps), cell_cap=cap)
 
 
 def cell_stats(cells):
-    """Host-side occupancy stats driving the staleness/rebuild decision."""
+    """Host-side occupancy stats driving the staleness/rebuild decision.
+    Works on both layouts — the sharded one maps real cells back out of the
+    shard-major slab order (dummies and padding cells excluded)."""
     c, cap = cells.n_cells, cells.cell_cap
-    ids = np.asarray(cells.row_ids).reshape(c + 1, cap)[:c]
+    ids_all = np.asarray(cells.row_ids).reshape(-1, cap)
+    if isinstance(cells, ShardedIVFCells):
+        cps = int(cells.cells_per_shard)
+        cell = np.arange(c)
+        ids = ids_all[(cell // cps) * (cps + 1) + cell % cps]
+    else:
+        ids = ids_all[:c]
     counts = (ids != _IDX_SENTINEL).sum(axis=1).astype(np.int64)
     total = int(counts.sum())
     mean = total / c if c else 0.0

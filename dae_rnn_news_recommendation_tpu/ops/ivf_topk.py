@@ -46,8 +46,15 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
-from .topk_fused import _ACC_LANES, _IDX_SENTINEL, _on_tpu, topk_fused
+from .topk_fused import (_ACC_LANES, _IDX_SENTINEL, _on_tpu, topk_fused,
+                         topk_sharded)
+
+try:  # jax >= 0.6 re-homed shard_map; 0.4.x only has the experimental name
+    from jax.experimental.shard_map import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    _shard_map = jax.shard_map
 
 # queries per block: the f32 min sublane tile. Shortlists are per-block
 # unions, so a bigger bq widens every query's scanned set — keep it minimal.
@@ -230,3 +237,111 @@ def ivf_topk(queries, emb, valid, k, *, cells, probes, scales=None,
     return _ivf_pallas(h, cell_ids, cells.cell_emb, cells.cell_valid,
                        cell_scales, cells.row_ids, k=k, cap=cap, bq=bq,
                        interpret=interpret)
+
+
+def _ivf_local_reference(queries, cell_emb, cell_valid, cell_scales,
+                         row_ids, local_ids, k, cap):
+    """Shard-local jnp fallback over one shard's slab arrays.
+
+    Rows are sorted ascending by GLOBAL slot row id before `lax.top_k`, so
+    finite ties break exactly like the unsharded fallback (and the kernel's
+    min-global-id selection); sentinel padding rows sort last and score
+    -inf. Scores are the same bytes as the unsharded scorer's — each row's
+    dot reduces the same D values in the same order, and the ×1.0 scale on
+    fp32 corpora is an IEEE identity.
+    """
+    b = queries.shape[0]
+    total = row_ids.shape[0]
+    probed = jnp.zeros((b, total // cap), bool)
+    probed = probed.at[jnp.arange(b)[:, None], local_ids].set(True)
+    row_probed = probed[:, jnp.arange(total, dtype=jnp.int32) // cap]
+    scores = jax.lax.dot_general(queries.astype(jnp.float32),
+                                 cell_emb.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    scores = scores * cell_scales[None, :].astype(jnp.float32)
+    scores = jnp.where((cell_valid[None, :] > 0) & row_probed, scores,
+                       -jnp.inf)
+    order = jnp.argsort(row_ids)
+    s_top, pos = jax.lax.top_k(scores[:, order], k)
+    return s_top, row_ids[order][pos]
+
+
+def sharded_ivf_topk(queries, emb, valid, k, *, cells, probes, mesh,
+                     axis_name="data", scales=None, impl=None,
+                     interpret=None, bq=None):
+    """`ivf_topk` over a mesh-sharded cell layout (`ShardedIVFCells`).
+
+    Stage 1 (centroid scan) runs replicated — centroids are [C, D] on every
+    device and only [B, probes] cell ids come out. Stage 2 runs under
+    `shard_map`: each shard maps the probed GLOBAL cell ids to local slots
+    (non-owned probes point at its local all-padding dummy), then runs the
+    same scalar-prefetch gather kernel / jnp fallback as the unsharded path
+    over ONLY its own (cps+1) slabs. Because the layout's `row_ids` carry
+    global slot rows, the per-shard [B, k] results merge with the same
+    axis-offset index-exact k-way merge the sharded exact scorer uses:
+    concatenate along the shard axis, sort candidates ascending by global
+    id, and let `lax.top_k`'s positional tie-break reproduce the unsharded
+    (score desc, id asc) order bitwise for all finite entries. The -inf
+    tail's indices remain unspecified unless `probes = n_cells`.
+
+    Degrades like `ivf_topk`, but to the sharded exact scorer
+    (`topk_sharded`) over the flat slot arrays.
+
+    :param emb: [N_pad, D] row-sharded flat slots (degrade path only)
+    :param valid: [N_pad] row-sharded flat mask (degrade path only)
+    :param cells: ShardedIVFCells with `n_shards == mesh.shape[axis_name]`
+    :param mesh: the mesh the corpus (and `cells`) are sharded over
+    """
+    k = int(k)
+    n = emb.shape[0]
+    if not 1 <= k <= n:
+        raise ValueError(f"k={k} outside [1, N={n}]")
+    n_dev = int(mesh.shape[axis_name])
+    if n_dev != cells.n_shards:
+        raise ValueError(
+            f"index built for {cells.n_shards} shards, mesh has {n_dev}")
+    n_cells, cap = cells.n_cells, cells.cell_cap
+    cps = int(cells.cells_per_shard)
+    probes = int(min(max(int(probes), 1), n_cells))
+    if impl is None:
+        impl = "pallas" if _on_tpu() else "jnp"
+    if k > min(probes * cap, _ACC_LANES):
+        return topk_sharded(queries, emb, valid, k, mesh=mesh,
+                            axis_name=axis_name, scales=scales, impl=impl,
+                            interpret=interpret)
+    h = queries.astype(jnp.float32)
+    cent_valid = jnp.ones((n_cells,), jnp.float32)
+    _, cell_ids = topk_fused(h, cells.centroids, cent_valid, probes,
+                             impl=impl, interpret=interpret)
+    if interpret is None:
+        interpret = not _on_tpu()
+    if bq is None:
+        bq = DEFAULT_BQ
+    cell_scales = (cells.cell_scales if scales is not None else
+                   jnp.ones(cells.row_ids.shape, jnp.float32))
+
+    def local(e_l, v_l, sc_l, r_l, h_l, ids_l):
+        s = jax.lax.axis_index(axis_name)
+        gid = ids_l.astype(jnp.int32)
+        owned = (gid >= s * cps) & (gid < s * cps + cps)
+        local_ids = jnp.where(owned, gid - s * cps, cps).astype(jnp.int32)
+        if impl == "jnp":
+            return _ivf_local_reference(h_l, e_l, v_l, sc_l, r_l, local_ids,
+                                        k, cap)
+        return _ivf_pallas(h_l, local_ids, e_l, v_l, sc_l, r_l, k=k, cap=cap,
+                           bq=bq, interpret=interpret)
+
+    s_cat, i_cat = _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis_name, None), P(axis_name), P(axis_name),
+                  P(axis_name), P(None, None), P(None, None)),
+        out_specs=(P(None, axis_name), P(None, axis_name)),
+        check_rep=False)(  # pallas_call has no replication rule
+            cells.cell_emb, cells.cell_valid, cell_scales, cells.row_ids,
+            h, cell_ids)
+    order = jnp.argsort(i_cat, axis=1)          # ascending global id
+    s_srt = jnp.take_along_axis(s_cat, order, axis=1)
+    i_srt = jnp.take_along_axis(i_cat, order, axis=1)
+    s_top, pos = jax.lax.top_k(s_srt, k)
+    return s_top, jnp.take_along_axis(i_srt, pos, axis=1)

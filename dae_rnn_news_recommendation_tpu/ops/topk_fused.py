@@ -40,6 +40,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.6 re-homed shard_map; 0.4.x only has the experimental name
+    from jax.experimental.shard_map import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    _shard_map = jax.shard_map
 
 # corpus rows per VMEM panel: 512 x 128 lanes of f32 panel + [bq, block]
 # scores stay ~1 MB per step, far under the ~16 MB VMEM budget, and 512 is a
@@ -192,3 +198,43 @@ def topk_fused(queries, emb, valid, k, *, scales=None, block=DEFAULT_PANEL,
         scales = jnp.ones((n,), jnp.float32)
     return _topk_pallas(queries, emb, valid, scales, k=k, block=block, bq=bq,
                         interpret=interpret)
+
+
+def topk_sharded(queries, emb, valid, k, *, mesh, axis_name="data",
+                 scales=None, impl=None, interpret=None):
+    """`topk_fused` over a ROW-SHARDED corpus: shard-local fused top-k, then
+    one axis-offset k-way merge.
+
+    Each device runs the fused kernel over its local rows, local indices are
+    offset by `axis_index * shard_rows` to global, and the gathered
+    [B, n_dev*k] candidates collapse through one final `lax.top_k` whose
+    positional tie-break — device-major, slot-minor — IS ascending global
+    index order (shard i holds the contiguous row span [i*rows, (i+1)*rows)),
+    so scores and indices match the single-device call (scores to fp32 merge
+    roundoff, indices exactly).
+
+    :param emb/valid/scales: placed with `parallel.mesh.shard_rows` (N_pad
+        divisible by the mesh size; shard rows must stay >= k)
+    """
+    k = int(k)
+    n_pad = emb.shape[0]
+    n_dev = int(mesh.shape[axis_name])
+    assert n_pad % n_dev == 0, f"N_pad={n_pad} not divisible by {n_dev}"
+    assert n_pad // n_dev >= k, f"shard rows {n_pad // n_dev} < k={k}"
+    if scales is None:
+        scales = jnp.ones((n_pad,), jnp.float32)
+
+    def local(emb_l, valid_l, scales_l, h_l):
+        s, i = topk_fused(h_l, emb_l, valid_l, k, scales=scales_l, impl=impl,
+                          interpret=interpret)
+        return s, i + jax.lax.axis_index(axis_name) * emb_l.shape[0]
+
+    s_cat, i_cat = _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis_name, None), P(axis_name), P(axis_name),
+                  P(None, None)),
+        out_specs=(P(None, axis_name), P(None, axis_name)),
+        check_rep=False)(  # pallas_call has no replication rule
+            emb, valid, scales, queries)
+    s_top, pos = jax.lax.top_k(s_cat, k)         # [B, n_dev*k] -> [B, k]
+    return s_top, jnp.take_along_axis(i_cat, pos, axis=1)

@@ -163,6 +163,10 @@ class ChurnSupervisor:
                 None, reason=f"periodic (every {self.churn.finetune_every})"))
             report["action"] = "incremental+finetune_rebuild"
         report["cycle_s"] = round(time.monotonic() - t0, 4)
+        # honest reachable-row fraction after the cycle: 1.0 on a healthy
+        # corpus, < 1.0 while quarantined shard losses mask rows/cells (r16:
+        # on a sharded IVF corpus this is the index's cell-level coverage)
+        report["coverage"] = float(getattr(self.corpus, "coverage", 1.0))
         self.history.append(report)
         m = self.metrics
         if m is not None:
@@ -174,6 +178,7 @@ class ChurnSupervisor:
             m.gauge("corpus_version").set(self.corpus.version)
             m.gauge("corpus_staleness").set(
                 getattr(self.corpus, "ivf_stale_cycles", 0) or 0)
+            m.gauge("corpus_coverage").set(report["coverage"])
         return report
 
     def finetune(self, reason="requested"):
@@ -321,6 +326,8 @@ class ChurnSupervisor:
         return {"n_cycles": self.n_cycles,
                 "resident_rows": self.resident_rows(),
                 "corpus_version": self.corpus.version,
+                "corpus_coverage": float(getattr(self.corpus, "coverage",
+                                                 1.0)),
                 "drift_trips": list(self.drift_trips),
                 "finetunes": list(self.finetunes),
                 "retries": list(self.retry.events),

@@ -20,9 +20,10 @@ from .chaos_serve import (ServePlanResult, ShardPlanResult, chaos_serve_soak,
                           run_shard_plan, serve_fault_plan, shard_fault_plan)
 from .corpus import (CORPUS_DTYPES, CorpusSlot, ServingCorpus,
                      ShardedUnsupported, SwapInProgress, SwapRejected,
-                     dequantize_rows, quantize_corpus)
+                     default_corpus, dequantize_rows, quantize_corpus)
 from .graph import (block_indices, make_corpus_encode_fn, make_ivf_serve_fn,
-                    make_serve_fn, make_sharded_serve_fn)
+                    make_serve_fn, make_sharded_ivf_serve_fn,
+                    make_sharded_serve_fn)
 from .service import RecommendationService, Reply, ReplyFuture
 
 __all__ = [
@@ -40,10 +41,12 @@ __all__ = [
     "block_indices",
     "chaos_serve_soak",
     "chaos_shard_soak",
+    "default_corpus",
     "dequantize_rows",
     "make_corpus_encode_fn",
     "make_ivf_serve_fn",
     "make_serve_fn",
+    "make_sharded_ivf_serve_fn",
     "make_sharded_serve_fn",
     "overload_trace",
     "quantize_corpus",

@@ -33,8 +33,12 @@ mesh-sharded sibling: each seeded plan runs a row-sharded service over every
 local device and exercises one shard-fault family — shard lost under load
 (detect -> quarantine -> partial_corpus replies -> swaps blocked -> recover),
 shard lost mid-swap (the loss lands inside the prepare phase and the commit
-heals it), and prepare-phase crashes on both swap flavors (whole-slot
-rollback, no shard advances). A concurrent reader thread samples the active
+heals it), prepare-phase crashes on both swap flavors (whole-slot
+rollback, no shard advances), and — r16 — the same under-load loss against
+the DEFAULT sharded+IVF configuration: the lost shard takes its owned index
+cells with it, quarantine masks those cells, replies carry the index's
+honest reachable-row coverage, and `recover_shards()` restores the slabs
+bitwise. A concurrent reader thread samples the active
 slot's per-shard version stamps the whole time, and a plan passes only when:
 exactly-one-outcome holds; `audit_shard_reads` finds zero torn cross-shard
 reads; `audit_version_ledger` accepts the promote/degrade/recover records
@@ -294,7 +298,17 @@ _SHARD_FAMILIES = (
     # rollback, retry promotes
     "prepare-crash-rebuild",   # injected serve.swap fatal on a full
     # rebuild: same rollback contract
+    "ivf-shard-lost-under-load",  # ISSUE 16: the default sharded+IVF
+    # configuration loses a cell-owning shard under load — quarantine masks
+    # the lost CELLS, partial_corpus coverage is the index's reachable-row
+    # fraction, recovery restores the slabs bitwise
 )
+
+# the IVF family's corpus: few cells at a pinned capacity floor so append
+# skew can never move the slab shapes (zero-recompile), probed exhaustively
+# so every dispatch provably touches the lost shard's cells
+_IVF_CORPUS_KW = {"retrieval": "ivf", "n_cells": 4, "cell_cap": 96}
+_IVF_PROBES = 4
 
 
 @dataclasses.dataclass
@@ -322,12 +336,12 @@ class ShardPlanResult:
 
 
 def shard_fault_plan(seed):
-    """Seeded shard-fault plan: four families, round-robin on the seed (any
-    4 consecutive seeds cover every family), alternating float32/int8
+    """Seeded shard-fault plan: five families, round-robin on the seed (any
+    5 consecutive seeds cover every family), alternating float32/int8
     corpora (any 2 consecutive seeds cover both quantization poisons —
     float32 loses an embedding shard, int8 loses its f32 scales shard).
 
-    The two loss families plan the `serve.shard` HARNESS directive (a dead
+    The loss families plan the `serve.shard` HARNESS directive (a dead
     device never raises in-line — `run_shard_plan` applies it via
     `ServingCorpus.inject_shard_loss`); the two crash families plan in-line
     fatals at the prepare phase of each swap flavor."""
@@ -344,6 +358,9 @@ def shard_fault_plan(seed):
         "prepare-crash-rebuild": (FaultSpec(
             "serve.swap", 1, "fatal",
             note="rebuild prepare dies -> whole-slot rollback"),),
+        "ivf-shard-lost-under-load": (FaultSpec(
+            "serve.shard", 1, "fatal",
+            note="shard + its owned IVF cells lost under load"),),
     }[family]
     return FaultPlan(seed=int(seed), specs=specs)
 
@@ -383,22 +400,32 @@ def _encode_rows(corpus, params, X):
 
 
 def _slot_fingerprint(slot):
-    """Host copy of every byte that defines the slot's serving behavior."""
+    """Host copy of every byte that defines the slot's serving behavior —
+    including the IVF index slabs when the slot carries one, so "recovery is
+    bitwise" covers the clustered scorer's entire read set too."""
     import jax
 
-    return {"n": slot.n, "version": slot.version,
-            "emb": np.asarray(jax.device_get(slot.emb)),
-            "valid": np.asarray(jax.device_get(slot.valid)),
-            "scales": (None if slot.scales is None
-                       else np.asarray(jax.device_get(slot.scales))),
-            "ages": (None if slot.ages is None
-                     else np.asarray(slot.ages))}
+    out = {"n": slot.n, "version": slot.version,
+           "emb": np.asarray(jax.device_get(slot.emb)),
+           "valid": np.asarray(jax.device_get(slot.valid)),
+           "scales": (None if slot.scales is None
+                      else np.asarray(jax.device_get(slot.scales))),
+           "ages": (None if slot.ages is None
+                    else np.asarray(slot.ages))}
+    ivf = getattr(slot, "ivf", None)
+    for key in ("centroids", "cell_emb", "cell_valid", "cell_scales",
+                "row_ids", "assign"):
+        out[f"ivf_{key}"] = (None if ivf is None else
+                             np.asarray(jax.device_get(getattr(ivf, key))))
+    return out
 
 
 def _fingerprints_equal(a, b):
     if a["n"] != b["n"] or a["version"] != b["version"]:
         return False
-    for key in ("emb", "valid", "scales", "ages"):
+    for key in a:
+        if key in ("n", "version"):
+            continue
         x, y = a[key], b[key]
         if (x is None) != (y is None):
             return False
@@ -408,12 +435,15 @@ def _fingerprints_equal(a, b):
     return True
 
 
-def _make_sharded_service(seed, dtype):
+def _make_sharded_service(seed, dtype, corpus_kw=None, derive_service=False):
     """Row-sharded service over every local device, fully warmed: serve
     variants (warmup), the append path (one fault-free incremental swap, so
     encode/dequantize/requantize/gate programs for the plan's exact shapes
     are all cached) — everything the plan dispatches after this point must
-    be a cache hit."""
+    be a cache hit. `corpus_kw` adds corpus build knobs (the IVF family's
+    clustered index at a pinned cell capacity); `derive_service=True` builds
+    the service WITHOUT explicit sharded=/mesh= kwargs, exercising the r16
+    default-derivation path under chaos."""
     from ..parallel.mesh import get_mesh
     import jax
 
@@ -424,13 +454,16 @@ def _make_sharded_service(seed, dtype):
     rng = np.random.default_rng(2000 + seed)
     articles = rng.random((_N_ARTICLES, _N_FEATURES), dtype=np.float32)
     mesh = get_mesh()
-    corpus = ServingCorpus(config, block=32, mesh=mesh, corpus_dtype=dtype)
+    corpus = ServingCorpus(config, block=32, mesh=mesh, corpus_dtype=dtype,
+                           **(corpus_kw or {}))
     corpus.swap(params, articles, note="initial")
+    service_kw = ({"probes": _IVF_PROBES} if derive_service
+                  else {"sharded": True, "mesh": mesh})
     service = RecommendationService(
         params, config, corpus, top_k=5, max_batch=8, max_inflight=16,
         flush_slack_s=0.02, linger_s=0.002, default_deadline_s=_SLA_S,
         retry=RetryPolicy(max_attempts=3, backoff_s=0.001, max_elapsed_s=0.5),
-        sharded=True, mesh=mesh)
+        **service_kw)
     service.warmup()
     batch1 = rng.random((_APPEND_ROWS, _N_FEATURES), dtype=np.float32)
     corpus.swap_incremental(params, batch1,
@@ -440,14 +473,14 @@ def _make_sharded_service(seed, dtype):
 
 
 def _replay_reference(seed, dtype, family, params, config, articles, batch1,
-                      batch2, fresh):
+                      batch2, fresh, corpus_kw=None):
     """The fault-free twin: the exact data operations the faulted plan
     performed, on a fresh corpus over the same mesh — its final slot is the
     bitwise target the recovered corpus must hit."""
     from ..parallel.mesh import get_mesh
 
     corpus = ServingCorpus(config, block=32, mesh=get_mesh(),
-                           corpus_dtype=dtype)
+                           corpus_dtype=dtype, **(corpus_kw or {}))
     corpus.swap(params, articles, note="initial")
     corpus.swap_incremental(params, batch1,
                             emb=_encode_rows(corpus, params, batch1),
@@ -470,11 +503,24 @@ def run_shard_plan(seed, n_requests=24, log=None):
     family = _SHARD_FAMILIES[seed % len(_SHARD_FAMILIES)]
     dtype = ("float32", "int8")[seed % 2]
     plan = shard_fault_plan(seed)
+    ivf_family = family.startswith("ivf")
+    corpus_kw = dict(_IVF_CORPUS_KW) if ivf_family else None
     service, params, config, articles, batch1 = _make_sharded_service(
-        seed, dtype)
+        seed, dtype, corpus_kw=corpus_kw, derive_service=ivf_family)
     corpus = service.corpus
     n_shards = len(corpus.active.shard_versions)
-    shard_id = seed % n_shards
+    if ivf_family:
+        from ..index import cell_shard_owner
+
+        # only a CELL-OWNING shard's loss is visible to the clustered
+        # scorer — the index slabs hold their own copy of every row, so a
+        # dummy-only shard dying changes no byte the scorer reads. Poison
+        # an owner, seed-rotated.
+        owners = sorted({int(s) for s in
+                         cell_shard_owner(corpus.active.ivf)})
+        shard_id = owners[seed % len(owners)]
+    else:
+        shard_id = seed % n_shards
     if family == "shard-lost-mid-swap":
         injector = _ShardLossAtPrepare(plan, corpus, shard_id)
     else:
@@ -521,10 +567,13 @@ def run_shard_plan(seed, n_requests=24, log=None):
                                      name="shard-read-probe")
     reader_thread.start()
     per_burst = max(1, n_requests // 3)
+    if ivf_family and not (service.sharded and service.retrieval == "ivf"):
+        problems.append("kwarg-less service did not derive the sharded+IVF "
+                        "default configuration")
     try:
         with compile_guard() as guard, _faults.install(injector):
             replies_a = burst(per_burst, f"s{seed}-pre")
-            if family == "shard-lost-under-load":
+            if family.endswith("shard-lost-under-load"):
                 corpus.inject_shard_loss(shard_id, note="lost under load")
                 replies_b = burst(per_burst, f"s{seed}-degraded")
                 if not corpus.degraded_shards:
@@ -615,7 +664,8 @@ def run_shard_plan(seed, n_requests=24, log=None):
     # the fault-free twin runs OUTSIDE the guard (its fresh corpus compiles
     # its own encoder); bitwise equality is the recovery contract
     reference = _replay_reference(seed, dtype, family, params, config,
-                                  articles, batch1, batch2, fresh)
+                                  articles, batch1, batch2, fresh,
+                                  corpus_kw=corpus_kw)
     bitwise = _fingerprints_equal(_slot_fingerprint(corpus.active),
                                   _slot_fingerprint(reference))
     if not bitwise:
@@ -644,8 +694,8 @@ def run_shard_plan(seed, n_requests=24, log=None):
     return result
 
 
-def chaos_shard_soak(n_plans=4, n_requests=24, log=None):
-    """Replay `n_plans` seeded chaos-shard plans (seeds 0..n-1; any 4
+def chaos_shard_soak(n_plans=5, n_requests=24, log=None):
+    """Replay `n_plans` seeded chaos-shard plans (seeds 0..n-1; any 5
     consecutive seeds cover every shard family, any 2 both corpus dtypes).
     Returns {"results", "all_ok", ...}."""
     results = [run_shard_plan(seed, n_requests=n_requests, log=log)

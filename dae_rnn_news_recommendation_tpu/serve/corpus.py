@@ -37,6 +37,16 @@ imbalance_max` for `reindex_after` consecutive incremental swaps marks
 `reindex_due`, and `reindex()` refits the centroids on the active slot's
 rows, riding the same health-gate -> promote -> ledger path as any swap.
 
+On a MESH-SHARDED corpus the index itself shards: cells partition by
+centroid across shards (`index.ShardedIVFCells`, shard-major slabs placed
+through the corpus's sharder), the centroid scan stays replicated, and the
+clustered scorer gathers per shard over only locally-owned probed cells
+(`ops.ivf_topk.sharded_ivf_topk`). `default_corpus` makes sharded+IVF the
+default configuration on multi-device hosts. Shard loss takes the lost
+shard's CELLS with it: quarantine masks those slabs' valid lanes, coverage
+reports the row fraction the index still reaches, and recovery restores the
+slabs bitwise from the same host mirror as the slot arrays.
+
 MESH-SHARDED slots (rows placed over a 1-D device mesh, pass `mesh=` or a
 `device_put=shard_rows` closure) ride the same protocol with a TWO-PHASE
 commit: the build/gate/index work is the PREPARE phase — every shard's new
@@ -188,12 +198,12 @@ class SwapInProgress(RuntimeError):
 
 
 class ShardedUnsupported(ValueError):
-    """A requested feature does not compose with mesh-sharded slots (yet).
+    """A requested feature does not compose with mesh-sharded slots.
 
-    Raised by configuration-time guards BEFORE any device allocation or
-    corpus access — the caller gets a taxonomy error at construction, never
-    an opaque placement failure mid-request. Subclasses ValueError so
-    pre-taxonomy callers that caught ValueError keep working."""
+    Retained in the exception taxonomy for callers that guard on it; the
+    former configuration-time uses (retrieval='ivf' with a mesh) composed in
+    r16 and no longer raise. Subclasses ValueError so pre-taxonomy callers
+    that caught ValueError keep working."""
 
 
 def _slot_is_sharded(slot):
@@ -215,18 +225,13 @@ class ServingCorpus:
                  collapse_ceiling=COLLAPSE_CEILING, device_put=None,
                  mesh=None, corpus_dtype="float32", retrieval="exact",
                  n_cells=None, index_seed=0, index_iters=8, imbalance_max=4.0,
-                 reindex_after=3):
+                 reindex_after=3, cell_cap=None):
         if corpus_dtype not in CORPUS_DTYPES:
             raise ValueError(
                 f"corpus_dtype must be one of {CORPUS_DTYPES}: {corpus_dtype!r}")
         if retrieval not in ("exact", "ivf"):
             raise ValueError(
                 f"retrieval must be 'exact' or 'ivf': {retrieval!r}")
-        if retrieval == "ivf" and mesh is not None:
-            raise ShardedUnsupported(
-                "retrieval='ivf' does not compose with a mesh-sharded corpus:"
-                " the IVF cell layout is single-device (sharded IVF is future"
-                " work) — refused before any device allocation")
         self.mesh = mesh
         self._row_mult = None
         if mesh is not None:
@@ -252,6 +257,10 @@ class ServingCorpus:
         self.corpus_dtype = corpus_dtype
         self.retrieval = retrieval
         self.n_cells = None if n_cells is None else int(n_cells)
+        self.cell_cap = None if cell_cap is None else int(cell_cap)
+        # floor on the uniform IVF cell capacity: pins the index shapes
+        # across swaps whose occupancy skews, so the serving variants
+        # compiled at warmup keep dispatching (zero-recompile soaks)
         self.index_seed = int(index_seed)
         self.index_iters = int(index_iters)
         self.imbalance_max = float(imbalance_max)
@@ -406,13 +415,25 @@ class ServingCorpus:
         spans = shard_spans(standby.emb)
         if (base is not None and standby.emb is base.emb
                 and base.mirror is not None):
-            standby.mirror = base.mirror  # reindex: the exact same bytes
+            # reindex: the slot bytes are the exact same buffers — copy the
+            # mirror dict (never mutate the base's) and refresh only the
+            # index entry below (the clustering DID change)
+            standby.mirror = dict(base.mirror)
         else:
             standby.mirror = {
                 "emb": np.asarray(jax.device_get(standby.emb)),
                 "valid": np.asarray(jax.device_get(standby.valid)),
                 "scales": (None if standby.scales is None else
                            np.asarray(jax.device_get(standby.scales)))}
+        if standby.ivf is not None and hasattr(standby.ivf, "n_shards"):
+            # shard-recovery source for the index slabs: centroids/assign
+            # are replicated (survive any single shard) and excluded
+            standby.mirror["ivf"] = {
+                "cell_emb": np.asarray(jax.device_get(standby.ivf.cell_emb)),
+                "cell_valid": np.asarray(
+                    jax.device_get(standby.ivf.cell_valid)),
+                "cell_scales": np.asarray(
+                    jax.device_get(standby.ivf.cell_scales))}
         standby.shard_versions = np.full(len(spans), _STAGED, np.int32)
         with self._lock:
             self.events.append({
@@ -705,10 +726,19 @@ class ServingCorpus:
 
         Padding rows (valid=0) are assigned like real rows so the IVF
         scorer sees the exact row population the flat scorer sees — the
-        bitwise-parity contract at probes = n_cells depends on it."""
+        bitwise-parity contract at probes = n_cells depends on it.
+
+        On a mesh-sharded slot the index is a shard-major
+        `index.ShardedIVFCells`: cells partition by centroid across shards,
+        the slab arrays go back through the corpus's own sharder so each
+        shard's cells land on its device, and `_stage_shards` mirrors the
+        slabs for shard recovery. Attaching runs in the PREPARE phase like
+        every other staged array — a failed gate discards the index with
+        the slot."""
         if self.retrieval != "ivf":
             return
-        from ..index import assign_cells, build_cells, cell_stats, kmeans_fit
+        from ..index import (assign_cells, build_cells, build_sharded_cells,
+                             cell_stats, kmeans_fit)
 
         n_cells = self.n_cells
         if n_cells is None:  # sqrt(N): the classic IVF scan-balance point
@@ -724,8 +754,17 @@ class ServingCorpus:
         else:
             centroids = base.ivf.centroids
             assign = assign_cells(x, centroids)
-        slot.ivf = build_cells(slot.emb, slot.valid, slot.scales,
-                               centroids, assign)
+        n_shards = self._row_mult
+        if n_shards is None and _slot_is_sharded(slot):
+            n_shards = len(slot.emb.sharding.device_set)
+        if n_shards is not None and n_shards > 1:
+            slot.ivf = build_sharded_cells(
+                slot.emb, slot.valid, slot.scales, centroids, assign,
+                n_shards=n_shards, cap_min=self.cell_cap,
+                device_put=self._device_put)
+        else:
+            slot.ivf = build_cells(slot.emb, slot.valid, slot.scales,
+                                   centroids, assign, cap_min=self.cell_cap)
         st = cell_stats(slot.ivf)
         with self._lock:
             if refit:
@@ -811,8 +850,10 @@ class ServingCorpus:
         like a real device dropping its HBM. float32/bfloat16 corpora poison
         the embedding shard; int8 corpora poison the f32 scales shard (int8
         has no NaN, and the scorer multiplies scales back in, so every score
-        against the shard goes NaN either way). Returns the poisoned shard
-        id."""
+        against the shard goes NaN either way). A sharded IVF index loses
+        the same device's slabs with it — the cells the shard owns — so the
+        clustered scorer sees the loss exactly like the flat one. Returns
+        the poisoned shard id."""
         from ..parallel.mesh import rebuild_shards, shard_spans
 
         with self._lock:
@@ -830,7 +871,18 @@ class ServingCorpus:
             poison = np.full((hi - lo, int(slot.emb.shape[1])), np.nan,
                              np.float32)
             emb, scales = rebuild_shards(slot.emb, {i: poison}), slot.scales
-        poisoned = self._clone_slot(slot, emb=emb, scales=scales)
+        ivf = slot.ivf
+        if ivf is not None and hasattr(ivf, "n_shards"):
+            rows = int(ivf.shard_rows)  # the device's slab rows die with it
+            if slot.scales is not None:
+                ivf = ivf.replace(cell_scales=rebuild_shards(
+                    ivf.cell_scales, {i: np.full(rows, np.nan, np.float32)}))
+            else:
+                ivf = ivf.replace(cell_emb=rebuild_shards(
+                    ivf.cell_emb,
+                    {i: np.full((rows, int(ivf.cell_emb.shape[1])), np.nan,
+                                np.float32)}))
+        poisoned = self._clone_slot(slot, emb=emb, scales=scales, ivf=ivf)
         with self._lock:
             self._active = poisoned
             self.events.append({"event": "shard_lost", "shard": i,
@@ -858,11 +910,22 @@ class ServingCorpus:
         scale_shards = (shard_host_copies(slot.scales)
                         if slot.scales is not None
                         else [None] * len(emb_shards))
+        ivf = slot.ivf
+        sharded_ivf = ivf is not None and hasattr(ivf, "n_shards")
+        cell_emb_shards = (shard_host_copies(ivf.cell_emb) if sharded_ivf
+                           else [None] * len(emb_shards))
+        cell_scale_shards = (shard_host_copies(ivf.cell_scales)
+                             if sharded_ivf else [None] * len(emb_shards))
         lost = []
-        for i, (e, s) in enumerate(zip(emb_shards, scale_shards)):
+        for i, (e, s, ce, cs) in enumerate(zip(emb_shards, scale_shards,
+                                               cell_emb_shards,
+                                               cell_scale_shards)):
             ok = bool(np.all(np.isfinite(np.asarray(e, np.float32))))
             if ok and s is not None:
                 ok = bool(np.all(np.isfinite(s)))
+            if ok and ce is not None:  # the device's index slabs die with it
+                ok = bool(np.all(np.isfinite(np.asarray(ce, np.float32)))
+                          and np.all(np.isfinite(cs)))
             if not ok:
                 lost.append(i)
         return {"sharded": True, "ok": not lost, "lost": lost,
@@ -897,8 +960,25 @@ class ServingCorpus:
         total = float(np.asarray(mirror["valid"], np.float32).sum())
         coverage = float(valid_host.sum()) / max(total, 1.0)
         put = self._device_put or jax.device_put
+        ivf = slot.ivf
+        if ivf is not None and hasattr(ivf, "n_shards"):
+            # a lost shard takes its owned CELLS with it: zero those slabs'
+            # valid lanes so the clustered scorer's -inf mask keeps the
+            # surviving cells answering, and report coverage as the row
+            # fraction the index can still reach (each valid row lives in
+            # exactly one cell, so this is the honest serving fraction)
+            cv_host = np.asarray(mirror["ivf"]["cell_valid"],
+                                 np.float32).copy()
+            rows = int(ivf.shard_rows)
+            for i in lost:
+                cv_host[i * rows:(i + 1) * rows] = 0.0
+            cv_total = float(
+                np.asarray(mirror["ivf"]["cell_valid"], np.float32).sum())
+            coverage = float(cv_host.sum()) / max(cv_total, 1.0)
+            ivf = ivf.replace(cell_valid=put(jnp.asarray(cv_host)))
         degraded = self._clone_slot(slot, valid=put(jnp.asarray(valid_host)),
-                                    lost=frozenset(lost), coverage=coverage)
+                                    ivf=ivf, lost=frozenset(lost),
+                                    coverage=coverage)
         with self._lock:
             self._active = degraded
             self._lost = set(lost)
@@ -949,8 +1029,26 @@ class ServingCorpus:
                     for i in lost})
             put = self._device_put or jax.device_put
             valid = put(jnp.asarray(np.asarray(mirror["valid"], np.float32)))
+            ivf = slot.ivf
+            if ivf is not None and hasattr(ivf, "n_shards"):
+                # the index heals the same way the slot does: lost slabs
+                # re-materialize from the mirror's exact bytes, surviving
+                # shards keep their live buffers — bitwise (the chaos-shard
+                # soak fingerprints the slabs to prove it)
+                m = mirror["ivf"]
+                rows = int(ivf.shard_rows)
+                lost_slabs = lambda a: {i: a[i * rows:(i + 1) * rows]
+                                        for i in lost}
+                cell_emb = rebuild_shards(ivf.cell_emb,
+                                          lost_slabs(m["cell_emb"]))
+                cell_scales = rebuild_shards(ivf.cell_scales,
+                                             lost_slabs(m["cell_scales"]))
+                ivf = ivf.replace(
+                    cell_emb=cell_emb, cell_scales=cell_scales,
+                    cell_valid=put(jnp.asarray(
+                        np.asarray(m["cell_valid"], np.float32))))
             healed = self._clone_slot(slot, emb=emb, scales=scales,
-                                      valid=valid, lost=frozenset(),
+                                      valid=valid, ivf=ivf, lost=frozenset(),
                                       coverage=1.0)
             with self._lock:
                 self._active = healed
@@ -968,3 +1066,20 @@ class ServingCorpus:
             return healed
         finally:
             self._swap_busy.release()
+
+
+def default_corpus(config, **kw):
+    """The default serving corpus for this host: mesh-sharded clustered
+    retrieval (`mesh=get_mesh(), retrieval="ivf"`) when more than one device
+    is visible, single-device exact otherwise. This is the configuration
+    `RecommendationService` and `fleet.ServiceReplica` reach for when the
+    caller does not choose — the r16 default flip: on multi-device hosts the
+    corpus rows AND the cell index shard across the mesh, so memory per
+    device shrinks with the mesh instead of every host holding a full copy.
+    Any explicit keyword wins over the derived defaults."""
+    if len(jax.devices()) > 1 and "mesh" not in kw and "device_put" not in kw:
+        from ..parallel.mesh import get_mesh
+
+        kw["mesh"] = get_mesh()
+        kw.setdefault("retrieval", "ivf")
+    return ServingCorpus(config, **kw)

@@ -38,6 +38,11 @@ training side already engineered around (bench.py:_hard_sync measures
     `lax.top_k` over the gathered [B, n_dev*k] candidates merges the shards.
     Device order equals global row order, so the merge's positional tie-break
     reproduces single-device index ordering exactly.
+
+  * `make_sharded_ivf_serve_fn` — sharded AND clustered, the default serving
+    configuration on multi-device hosts: replicated centroid scan, per-shard
+    scalar-prefetch gather over locally-owned probed cells, and the same
+    index-exact k-way merge as the sharded exact path.
 """
 
 import jax
@@ -175,29 +180,40 @@ def make_sharded_serve_fn(config, k, mesh, axis_name="data"):
     merge roundoff, indices exactly)."""
     k = int(k)
     assert k >= 1
-    n_dev = int(mesh.shape[axis_name])
 
     def run(params, emb, valid, scales, queries):
-        n_pad = emb.shape[0]
-        assert n_pad % n_dev == 0, f"N_pad={n_pad} not divisible by {n_dev}"
-        assert n_pad // n_dev >= k, f"shard rows {n_pad // n_dev} < k={k}"
         h = l2_normalize(dae_core.encode(params, queries, config))
-        if scales is None:
-            scales = jnp.ones((n_pad,), jnp.float32)
+        # trace-time import: pallas loads only when a fused graph is built
+        from ..ops.topk_fused import topk_sharded
 
-        def local(emb_l, valid_l, scales_l, h_l):
-            from ..ops.topk_fused import topk_fused
-
-            s, i = topk_fused(h_l, emb_l, valid_l, k, scales=scales_l)
-            return s, i + jax.lax.axis_index(axis_name) * emb_l.shape[0]
-
-        s_cat, i_cat = _shard_map(
-            local, mesh=mesh,
-            in_specs=(P(axis_name, None), P(axis_name), P(axis_name),
-                      P(None, None)),
-            out_specs=(P(None, axis_name), P(None, axis_name)))(
-                emb, valid, scales, h)
-        s_top, pos = jax.lax.top_k(s_cat, k)     # [B, n_dev*k] -> [B, k]
-        return s_top, jnp.take_along_axis(i_cat, pos, axis=1)
+        return topk_sharded(h, emb, valid, k, mesh=mesh,
+                            axis_name=axis_name, scales=scales)
 
     return telemetry.instrument(jax.jit(run), f"serve/topk{k}_sharded")
+
+
+def make_sharded_ivf_serve_fn(config, k, probes, mesh, axis_name="data"):
+    """The clustered scorer over a mesh-sharded corpus: `make_ivf_serve_fn`'s
+    contract (operands end `..., cells, queries`) with `cells` a
+    `index.ShardedIVFCells` whose slab arrays are row-sharded over `mesh`.
+
+    The centroid scan runs replicated; the scalar-prefetch shortlist gather
+    runs per shard over only locally-owned probed cells; per-shard local
+    top-k merges with the same axis-offset index-exact k-way merge as
+    `make_sharded_serve_fn` (`ops.ivf_topk.sharded_ivf_topk`). Indices are
+    ORIGINAL slot row numbers — index-exact vs the unsharded IVF graph at
+    matched probes, and vs the exact scorer at `probes = n_cells`."""
+    k = int(k)
+    probes = int(probes)
+    assert k >= 1 and probes >= 1
+
+    def run(params, emb, valid, scales, cells, queries):
+        h = l2_normalize(dae_core.encode(params, queries, config))
+        # trace-time import: pallas loads only when a fused graph is built
+        from ..ops.ivf_topk import sharded_ivf_topk
+
+        return sharded_ivf_topk(h, emb, valid, k, cells=cells, probes=probes,
+                                mesh=mesh, axis_name=axis_name, scales=scales)
+
+    return telemetry.instrument(
+        jax.jit(run), f"serve/ivf_topk{k}_p{probes}_sharded")

@@ -37,6 +37,7 @@ so dispatches amortize better). Each degraded episode is recorded in
 first-class, never silent.
 """
 
+import contextlib
 import dataclasses
 import queue
 import threading
@@ -51,10 +52,18 @@ from ..analysis.runtime import CompileWatcher
 from ..reliability import faults as _faults
 from ..reliability.retry import RetryPolicy
 from ..train.pipeline import bucket_sizes
-from .corpus import ShardedUnsupported
 from .graph import make_serve_fn
 
 _LATENCY_WINDOW = 4096  # replies kept for p50/p95 (bounded, like the queue)
+
+_MESH_LOCK = threading.Lock()
+# Process-wide serialization of SHARDED serve dispatches. A shard_map program
+# is a collective: all mesh devices must rendezvous on the SAME program. Two
+# service threads (fleet replicas share this host's one device mesh)
+# dispatching concurrently can interleave their programs' per-device
+# participant arrivals and deadlock the rendezvous — so every sharded
+# serve-fn call in this process takes this lock. Single-device dispatches
+# never touch it.
 
 
 @dataclasses.dataclass
@@ -68,8 +77,8 @@ class Reply:
     latency_s: float = 0.0    # submit -> resolve wall clock
     deadline_met: bool = False
     degraded: tuple = ()      # subset of ("topk_truncated", "coarse_batching",
-    #                           "stale_corpus", "partial_corpus") that shaped
-    #                           this reply
+    #                           "stale_corpus", "partial_corpus",
+    #                           "ivf_unavailable") that shaped this reply
     corpus_version: int = 0
     coverage: float = 1.0     # valid-row fraction the answering slot served;
     # < 1.0 exactly when "partial_corpus" is in `degraded` (a shard is lost
@@ -169,18 +178,25 @@ class RecommendationService:
     :param retry: RetryPolicy for transient device faults on the batch path
         (default: 3 attempts, full jitter, 0.25 s cumulative cap).
     :param sharded: score against a ROW-SHARDED corpus: the serve graphs are
-        built with `make_sharded_serve_fn` over `mesh`, so corpus capacity
+        built with the sharded variants over `mesh`, so corpus capacity
         scales with device count. Build the corpus with
         `ServingCorpus(mesh=mesh)` (same mesh; builds pad N_pad to divide it
         and swaps ride the two-phase shard commit) — or pass an explicit
         `device_put=lambda x: parallel.mesh.shard_rows(x, mesh)` with
-        divisible shapes. Shard rows must stay >= top_k.
-    :param mesh: the 1-D mesh for `sharded=True` (default: all devices via
-        `parallel.mesh.get_mesh()`).
+        divisible shapes. Shard rows must stay >= top_k. The default (None)
+        DERIVES from the corpus: True iff the corpus was built over a mesh
+        with more than one device.
+    :param mesh: the 1-D mesh for sharded serving (default: the corpus's
+        mesh, else all devices via `parallel.mesh.get_mesh()`).
     :param retrieval: "exact" (scan every corpus row) or "ivf" (probe the
-        slot's clustered index via `make_ivf_serve_fn`; the corpus must be
-        built with `retrieval="ivf"` so every promoted slot carries one).
-        Mutually exclusive with `sharded` until sharded IVF lands.
+        slot's clustered index; the corpus must be built with
+        `retrieval="ivf"` so every promoted slot carries one). The default
+        (None) follows the corpus's own `retrieval`. Composed with sharded
+        serving the graphs route through `make_sharded_ivf_serve_fn` —
+        sharded+IVF IS the default configuration on multi-device hosts
+        (`serve.corpus.default_corpus`). A slot promoted without an index
+        serves through a recorded exact-scoring fallback
+        (degraded="ivf_unavailable") instead of erroring.
     :param probes: cells scanned per query under `retrieval="ivf"` — baked
         into the compiled variants, so `warmup()` precompiles one program
         per (bucket, k, probes) and probing depth never recompiles live.
@@ -200,21 +216,23 @@ class RecommendationService:
                  degraded_top_k=None, max_batch=32, max_inflight=64,
                  flush_slack_s=0.02, linger_s=0.005, default_deadline_s=1.0,
                  overload_watermark=0.75, retry=None, fused=True,
-                 sharded=False, mesh=None, retrieval="exact", probes=8,
+                 sharded=None, mesh=None, retrieval=None, probes=8,
                  name="svc", registry=None, trace_sample_rate=1.0):
         assert int(top_k) >= 1 and int(max_batch) >= 1
+        if retrieval is None:
+            # follow the corpus: its slots carry an index iff it was built
+            # with retrieval="ivf", and the serve graphs must match
+            retrieval = getattr(corpus, "retrieval", "exact")
         if retrieval not in ("exact", "ivf"):
             raise ValueError(
                 f"retrieval must be 'exact' or 'ivf': {retrieval!r}")
-        if retrieval == "ivf" and sharded:
-            # configuration-time taxonomy error, raised BEFORE any device
-            # allocation or corpus access: the IVF cell layout is
-            # single-device, so composing it with a row-sharded corpus can
-            # only fail later with an opaque placement error
-            raise ShardedUnsupported(
-                "retrieval='ivf' does not compose with sharded=True: the "
-                "IVF cell layout is single-device (sharded IVF is future "
-                "work)")
+        corpus_mesh = getattr(corpus, "mesh", None)
+        if sharded is None:
+            # derive from the corpus: a mesh with more than one device means
+            # the slot arrays land row-sharded, so the serve graphs must be
+            # the sharded variants — sharded+IVF is the multi-device default
+            sharded = (corpus_mesh is not None
+                       and int(np.prod(list(corpus_mesh.shape.values()))) > 1)
         self.params = params
         self.config = config
         self.corpus = corpus
@@ -239,11 +257,20 @@ class RecommendationService:
         assert self.probes >= 1
         if self.sharded:
             from ..parallel.mesh import get_mesh
-            from .graph import make_sharded_serve_fn
-            self.mesh = mesh if mesh is not None else get_mesh()
-            self._serve_fns = {
-                k: make_sharded_serve_fn(config, k, self.mesh)
-                for k in {self.top_k, self.degraded_top_k}}
+            if mesh is None:
+                mesh = corpus_mesh if corpus_mesh is not None else get_mesh()
+            self.mesh = mesh
+            if self.retrieval == "ivf":
+                from .graph import make_sharded_ivf_serve_fn
+                self._serve_fns = {
+                    k: make_sharded_ivf_serve_fn(config, k, self.probes,
+                                                 self.mesh)
+                    for k in {self.top_k, self.degraded_top_k}}
+            else:
+                from .graph import make_sharded_serve_fn
+                self._serve_fns = {
+                    k: make_sharded_serve_fn(config, k, self.mesh)
+                    for k in {self.top_k, self.degraded_top_k}}
         elif self.retrieval == "ivf":
             from .graph import make_ivf_serve_fn
             self.mesh = None
@@ -254,6 +281,10 @@ class RecommendationService:
             self.mesh = None
             self._serve_fns = {k: make_serve_fn(config, k, fused=self.fused)
                                for k in {self.top_k, self.degraded_top_k}}
+        self._fallback_fns = {}  # lazy exact-scoring variants: the recorded
+        # ivf_unavailable fallback when a slot promoted without an index
+        self._ivf_unavail_version = None  # last version the fallback event
+        # was recorded for (one event per index-less slot, not per dispatch)
         self._warmup_compiles = None   # set by warmup()
         self._post_warm_watcher = None  # counts compiles after warmup() —
         # the serving SLO assumes zero (every (bucket, k) variant is warm)
@@ -389,13 +420,18 @@ class RecommendationService:
             for p in live:
                 self._error(p, "no_corpus")
             return
-        if self.retrieval == "ivf" and slot.ivf is None:
-            # explicit terminal, never a cryptic trace error: the corpus was
-            # not built with retrieval="ivf", so no slot carries an index
-            for p in live:
-                self._error(p, "no_ivf_index")
-            return
+        ivf_missing = self.retrieval == "ivf" and slot.ivf is None
         tags = []
+        if ivf_missing:
+            # a slot promoted without an index (e.g. a corpus seeded with
+            # retrieval="exact" then fronted by an ivf service) SERVES via
+            # the exact-scoring fallback instead of erroring — a recorded
+            # first-class degraded mode, one event per index-less version
+            tags.append("ivf_unavailable")
+            if self._ivf_unavail_version != slot.version:
+                self._ivf_unavail_version = slot.version
+                self._record_event("ivf_unavailable",
+                                   corpus_version=slot.version)
         if degraded:
             tags.append("coarse_batching")
             if k < self.top_k:
@@ -411,7 +447,8 @@ class RecommendationService:
         batch = np.zeros((max(target, b), live[0].query.shape[0]), np.float32)
         for i, p in enumerate(live):
             batch[i] = p.query
-        serve_fn = self._serve_fns[k]
+        serve_fn = (self._fallback_fn(k) if ivf_missing
+                    else self._serve_fns[k])
         t0 = time.monotonic()
         for p in live:
             # batch formation ends / fenced compute begins for every rider
@@ -423,8 +460,12 @@ class RecommendationService:
                                       "corpus_version": slot.version}) as sp:
                 def call():
                     _faults.fire("serve.batch", n=b)
-                    out = serve_fn(self.params, *self._slot_args(slot), batch)
-                    jax.block_until_ready(out)
+                    with self._mesh_guard():
+                        out = serve_fn(self.params,
+                                       *self._slot_args(slot,
+                                                        fallback=ivf_missing),
+                                       batch)
+                        jax.block_until_ready(out)
                     return out
 
                 scores, indices = self.retry.run(call, site="serve.batch")
@@ -450,7 +491,8 @@ class RecommendationService:
             # the shard-loss detection path: NaN sorts above every finite
             # cosine in the top-k merge, so a poisoned shard provably shows
             # up here on the first post-loss dispatch
-            redo = self._quarantine_and_redispatch(serve_fn, batch, b, slot)
+            redo = self._quarantine_and_redispatch(serve_fn, batch, b, slot,
+                                                   fallback=ivf_missing)
             if redo is None:
                 for p in live:
                     self._error(p, "nonfinite_scores")
@@ -472,7 +514,8 @@ class RecommendationService:
             self._reply(p, indices[i], scores[i], tags, slot.version,
                         coverage)
 
-    def _quarantine_and_redispatch(self, serve_fn, batch, n, slot):
+    def _quarantine_and_redispatch(self, serve_fn, batch, n, slot,
+                                   fallback=False):
         """Nonfinite scores from a sharded corpus mean a shard's buffers
         died under us (the `serve.shard` fault class): quarantine the lost
         shards (`corpus.quarantine_lost_shards` masks their rows invalid,
@@ -502,8 +545,11 @@ class RecommendationService:
                 coverage=round(float(getattr(fresh, "coverage", 1.0)), 4),
                 corpus_version=fresh.version)
         try:
-            out = serve_fn(self.params, *self._slot_args(fresh), batch)
-            jax.block_until_ready(out)
+            with self._mesh_guard():
+                out = serve_fn(self.params,
+                               *self._slot_args(fresh, fallback=fallback),
+                               batch)
+                jax.block_until_ready(out)
         # same contract: None -> explicit error Replies for the whole batch
         except Exception:
             return None
@@ -624,12 +670,35 @@ class RecommendationService:
             status="error", reason=detail, latency_s=now - p.t_submit,
             request_id=p.rid, timings=self._timings(p, now)))
 
-    def _slot_args(self, slot):
+    def _mesh_guard(self):
+        """The collective-dispatch guard: sharded services serialize their
+        device calls through the process-wide `_MESH_LOCK` (see its comment);
+        single-device services pay nothing."""
+        return _MESH_LOCK if self.sharded else contextlib.nullcontext()
+
+    def _slot_args(self, slot, fallback=False):
         """Positional slot operands for the compiled serve variants — the
-        IVF variants take the slot's cell index as one extra pytree operand."""
-        if self.retrieval == "ivf":
+        IVF variants take the slot's cell index as one extra pytree operand;
+        `fallback=True` (the ivf_unavailable path) omits it because the
+        exact-scoring fallback variants don't take one."""
+        if self.retrieval == "ivf" and not fallback:
             return (slot.emb, slot.valid, slot.scales, slot.ivf)
         return (slot.emb, slot.valid, slot.scales)
+
+    def _fallback_fn(self, k):
+        """The exact-scoring variant the ivf_unavailable path dispatches to —
+        sharded iff the service is, compiled lazily on first use and cached
+        (an index-less slot is the exception, not the steady state; warmup()
+        pre-warms these instead of the IVF variants when it sees one)."""
+        fn = self._fallback_fns.get(k)
+        if fn is None:
+            if self.sharded:
+                from .graph import make_sharded_serve_fn
+                fn = make_sharded_serve_fn(self.config, k, self.mesh)
+            else:
+                fn = make_serve_fn(self.config, k, fused=self.fused)
+            self._fallback_fns[k] = fn
+        return fn
 
     # ------------------------------------------------------------ lifecycle
     def warmup(self):
@@ -643,25 +712,30 @@ class RecommendationService:
         a recompile (they dispatch to variants warmed here)."""
         slot = self.corpus.active
         assert slot is not None, "swap a corpus in before warmup()"
-        if self.retrieval == "ivf":
-            assert slot.ivf is not None, (
-                "active slot carries no IVF index — build the ServingCorpus "
-                "with retrieval='ivf'")
+        # an ivf service fronting a slot with no index warms the
+        # exact-scoring fallback variants instead — requests serve degraded
+        # (ivf_unavailable) rather than erroring, and still without
+        # post-warmup compiles
+        ivf_missing = self.retrieval == "ivf" and slot.ivf is None
+        fns = ({k: self._fallback_fn(k) for k in self._serve_fns}
+               if ivf_missing else self._serve_fns)
+        args = self._slot_args(slot, fallback=ivf_missing)
         f = int(self.config.n_features)
         watcher = CompileWatcher().start()
         try:
-            for k, fn in sorted(self._serve_fns.items()):
-                for b in self.buckets:
-                    out = fn(self.params, *self._slot_args(slot),
-                             np.zeros((b, f), np.float32))
-                    jax.block_until_ready(out)
-            # floor := fastest warm repeat of the smallest variant
-            t0 = time.monotonic()
-            out = self._serve_fns[self.top_k](
-                self.params, *self._slot_args(slot),
-                np.zeros((self.buckets[0], f), np.float32))
-            jax.block_until_ready(out)
-            floor = time.monotonic() - t0
+            with self._mesh_guard():
+                for k, fn in sorted(fns.items()):
+                    for b in self.buckets:
+                        out = fn(self.params, *args,
+                                 np.zeros((b, f), np.float32))
+                        jax.block_until_ready(out)
+                # floor := fastest warm repeat of the smallest variant
+                t0 = time.monotonic()
+                out = fns[self.top_k](
+                    self.params, *args,
+                    np.zeros((self.buckets[0], f), np.float32))
+                jax.block_until_ready(out)
+                floor = time.monotonic() - t0
             # the flush thread may already be folding its own min() into
             # _floor_s under the lock — don't race it with a bare store
             with self._lock:

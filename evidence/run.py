@@ -312,7 +312,13 @@ BENCH_TRAJECTORY_METRICS = ("serve_queries_per_sec",
 # the regression. Zero-valued bases (e.g. a 0.0 shed rate) never form a
 # ratio: the base search below requires base > 0, so those pass by absence.
 BENCH_TRAJECTORY_LOWER_IS_BETTER = ("fleet_p99_ms", "fleet_shed_rate",
-                                    "rollout_inflight_p95_ms")
+                                    "rollout_inflight_p95_ms",
+                                    # r16 sharded-IVF figures: per-replica
+                                    # bytes of the shared corpus, and the
+                                    # cross-shard merge's row-count overhead
+                                    # — both regress by GROWING
+                                    "serve_corpus_bytes_per_replica",
+                                    "serve_ivf_sharded_merge_overhead_frac")
 BENCH_REGRESSION_TOLERANCE = 0.15  # >15% drop vs prior same-platform fails
 # ISSUE 14: the observability layer must be near-free on the serving path —
 # the instrumented leg of the bench's tracing race (span tracing + metric

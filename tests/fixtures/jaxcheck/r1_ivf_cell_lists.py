@@ -1,0 +1,57 @@
+"""Planted R1: per-shard IVF cell-list re-materialization inside a jit.
+
+The sharded cell layout (index/layout.build_sharded_cells) gathers each
+shard's owned cell rows into fixed-capacity slabs — a host-side surgery over
+the kmeans assignment (np.flatnonzero per cell, python loop over shards).
+Dragging that under a jitted scorer "to fuse the layout with the scan" pulls
+jax.device_get / np.asarray into trace, where the data-dependent flatnonzero
+either breaks tracing or pins a host sync into every dispatch. The clean
+twin does what the real builder does: host layout OUTSIDE any trace, then a
+jitted scorer over finished device slabs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_score_with_inline_layout(q, emb, assign, cell):
+    owned = np.flatnonzero(np.asarray(assign) == cell)  # planted: R1
+    rows = jax.device_get(emb)[owned]  # planted: R1
+    return jnp.asarray(rows) @ q
+
+
+def _gather_cell_rows(emb, assign, cell):
+    # reachable from the jitted caller below: the host materialization is a
+    # bug anywhere trace can reach, not only under the decorator itself
+    owned = np.flatnonzero(np.asarray(assign) == cell)  # planted: R1
+    return owned
+
+
+@jax.jit
+def bad_score_via_helper(q, emb, assign, cell):
+    owned = _gather_cell_rows(emb, assign, cell)
+    return emb[jnp.asarray(owned)] @ q
+
+
+# -------------------------------------------------------------- clean twin
+
+def build_cell_slab(emb, assign, cell, cap):
+    """Host-side layout OUTSIDE any trace — the shape build_sharded_cells
+    actually uses: materialize the owned rows on the host, pad to the fixed
+    cell capacity, and hand the jitted scorer a finished device slab."""
+    owned = np.flatnonzero(np.asarray(assign) == cell)[:cap]
+    slab = np.zeros((cap, emb.shape[1]), np.float32)
+    slab[: owned.size] = np.asarray(emb)[owned]
+    return _score_slab(jnp.asarray(slab), owned.size)
+
+
+def _score_slab(slab, n_owned):
+    return _scorer(slab, jnp.asarray(n_owned))
+
+
+@jax.jit
+def _scorer(slab, n_owned):
+    mask = jnp.arange(slab.shape[0]) < n_owned
+    return jnp.where(mask[:, None], slab, 0.0)

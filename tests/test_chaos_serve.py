@@ -5,9 +5,11 @@ serve/chaos_serve.py): zero unresolved requests, exactly-one-outcome per
 submission, injected swap faults roll back with the old corpus still serving,
 and p95 stays bounded even in degraded mode.
 
-The chaos-SHARD plans (ISSUE 13) run the mesh-sharded sibling over the 8
-virtual CPU devices conftest pins: tier-1 smokes the two shard-loss families
-(seeds 0-1, one per corpus dtype); the full 4-family soak is `slow`.
+The chaos-SHARD plans (ISSUE 13; IVF family ISSUE 16) run the mesh-sharded
+sibling over the 8 virtual CPU devices conftest pins: tier-1 smokes the two
+shard-loss families (seeds 0-1, one per corpus dtype) plus the sharded-IVF
+loss family (seed 4, the r16 default configuration); the full 5-family soak
+is `slow`.
 """
 
 import pytest
@@ -64,26 +66,29 @@ def test_shard_fault_plans_are_seeded_and_cover_all_families():
     b = shard_fault_plan(2)
     assert [s.__dict__ for s in a.specs] == [s.__dict__ for s in b.specs]
     sites = set()
-    for seed in range(4):
+    for seed in range(5):
         plan = shard_fault_plan(seed)
         assert plan.specs
         sites |= {s.site for s in plan.specs}
-    # two loss families plan the harness directive, two crash families plan
-    # in-line prepare fatals — one per swap flavor
+    # three loss families plan the harness directive, two crash families
+    # plan in-line prepare fatals — one per swap flavor
     assert sites == {"serve.shard", "refresh.swap", "serve.swap"}
     # the serve.shard directive is harness-applied, never fired in-line
-    for seed in (0, 1):
+    for seed in (0, 1, 4):
         plan = shard_fault_plan(seed)
         assert plan.harness_specs and not plan.inline_specs
 
 
-@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("seed", [0, 1, 4])
 def test_chaos_shard_smoke_plan(seed):
     """Tier-1 shard-loss smoke: seed 0 loses a float32 embedding shard under
     load (quarantine -> partial_corpus -> blocked swaps -> recover); seed 1
     loses an int8 corpus's scales shard inside an append's prepare phase
-    (the commit heals it). Both must end bitwise-equal to the fault-free
-    reference with zero torn reads and zero post-warmup compiles."""
+    (the commit heals it); seed 4 runs the r16 DEFAULT sharded+IVF
+    configuration and loses a cell-owning shard under load — quarantine
+    masks the lost cells and recovery restores the index slabs. All must
+    end bitwise-equal to the fault-free reference with zero torn reads and
+    zero post-warmup compiles."""
     result = run_shard_plan(seed, n_requests=24)
     assert result.ok, result.detail
     assert result.n_replied + result.n_shed + result.n_errors \
@@ -93,7 +98,7 @@ def test_chaos_shard_smoke_plan(seed):
     assert result.n_read_samples > 0
     assert result.n_post_warm_compiles == 0
     assert any(e.get("site") == "serve.shard" for e in result.injected)
-    if result.family == "shard-lost-under-load":
+    if result.family.endswith("shard-lost-under-load"):
         assert result.n_partial > 0
         assert 0.0 < result.min_coverage < 1.0
     else:
@@ -102,12 +107,12 @@ def test_chaos_shard_smoke_plan(seed):
 
 @pytest.mark.slow
 def test_chaos_shard_full_soak():
-    out = chaos_shard_soak(n_plans=4, n_requests=24)
+    out = chaos_shard_soak(n_plans=5, n_requests=24)
     failing = [f"{r.seed}[{r.family}]: {r.detail}"
                for r in out["results"] if not r.ok]
     assert out["all_ok"], failing
-    assert out["n_ok"] == out["n_plans"] == 4
+    assert out["n_ok"] == out["n_plans"] == 5
     families = {r.family for r in out["results"]}
-    assert len(families) == 4
+    assert len(families) == 5
     dtypes = {r.dtype for r in out["results"]}
     assert dtypes == {"float32", "int8"}

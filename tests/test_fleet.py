@@ -393,6 +393,84 @@ def test_failed_probe_reverts_canary(setup):
         stop_fleet(replicas, router)
 
 
+def test_shared_corpus_fleet_promotes_once(setup):
+    """ISSUE 16: replicas fronting ONE shared (sharded) corpus ride the
+    rollout protocol with the fleet stage collapsing — the canary's churn
+    ingest IS the fleet promote. Exactly one ledger promote per rollout,
+    shared replicas recorded (never silently skipped), zero version skew."""
+    from dae_rnn_news_recommendation_tpu.serve import default_corpus
+
+    config, params, articles = setup
+    corpus = default_corpus(config)
+    replicas = [make_replica(setup, name=f"r{i}", warm=False,
+                             seed_corpus=False, corpus=corpus)
+                for i in range(3)]
+    router = Router(replicas, default_deadline_s=SLA, seed=5,
+                    ledger=OutcomeLedger())
+    sup = FleetSupervisor(params, config, replicas, router,
+                          churn=ChurnConfig(microbatch=16,
+                                            drift_centroid_max=1.0,
+                                            drift_collapse_max=1.0))
+    try:
+        boot = sup.bootstrap(articles)
+        assert boot["shared"] == ["r1", "r2"]
+        assert corpus.version == 1  # seeded once, not once per replica
+        for r in replicas:
+            r.warmup()
+        batch = np.random.default_rng(9).random((16, F), dtype=np.float32)
+        report = sup.rollout(batch, note="t", probe_query=articles[0])
+        assert report["ok"], report
+        assert report["shared"] == ["r1", "r2"]
+        assert {r.corpus.version for r in replicas} == {2}
+        promotes = [rec for rec in corpus.ledger
+                    if rec.get("ok") and rec["version"] == 2]
+        assert len(promotes) == 1, corpus.ledger  # promoted exactly once
+        assert sup.summary()["shared_corpus"] == ["r1", "r2"]
+        # every replica answers from the one shared slot
+        reply = router.submit(articles[0]).result(timeout=30)
+        assert reply.ok and reply.corpus_version == 2
+    finally:
+        stop_fleet(replicas, router)
+
+
+def test_shared_corpus_fleet_failure_reverts_once(setup):
+    """A failed probe after a shared-corpus canary promote reverts the ONE
+    corpus exactly once — shared replicas are not in the promoted list, so
+    the rollback path cannot double-revert the object they all front."""
+    from dae_rnn_news_recommendation_tpu.serve import default_corpus
+
+    config, params, articles = setup
+    corpus = default_corpus(config)
+    replicas = [make_replica(setup, name=f"r{i}", warm=False,
+                             seed_corpus=False, corpus=corpus)
+                for i in range(3)]
+    router = Router(replicas, default_deadline_s=SLA, seed=5,
+                    ledger=OutcomeLedger())
+    sup = FleetSupervisor(params, config, replicas, router,
+                          churn=ChurnConfig(microbatch=16,
+                                            drift_centroid_max=1.0,
+                                            drift_collapse_max=1.0))
+    try:
+        sup.bootstrap(articles)
+        for r in replicas:
+            r.warmup()
+        batch = np.random.default_rng(9).random((16, F), dtype=np.float32)
+
+        def kill_canary_before_probe(stage):
+            if stage == "probe":
+                replicas[0].kill()
+
+        report = sup.rollout(batch, stage_hook=kill_canary_before_probe,
+                             probe_query=articles[0])
+        assert not report["ok"] and "probe" in report["detail"]
+        assert report["reverted"] == ["r0"]  # one revert on the one corpus
+        assert corpus.version == 1
+        reverts = [rec for rec in corpus.ledger if rec.get("revert")]
+        assert len(reverts) == 1
+    finally:
+        stop_fleet(replicas, router)
+
+
 # --------------------------------------------- observability (ISSUE 14)
 
 def test_fleet_ids_propagate_and_hedge_twin_shares_parent_id(setup):

@@ -15,8 +15,10 @@ invariants, corpus/service wiring (`retrieval="ivf"`), churn composition
 (appends route into existing cells WITHOUT refitting; sustained imbalance
 trips a background reindex), and the sharded-composition contracts: a
 mesh-sharded slot built from a bare `device_put` closure now APPENDS
-through the two-phase protocol (ISSUE 13 replaced the r11 refusal), while
-ivf + sharded still refuses with the typed `ShardedUnsupported`.
+through the two-phase protocol (ISSUE 13 replaced the r11 refusal), and
+ivf + sharded COMPOSES (r16): a mesh corpus builds the shard-major index
+and the service derives sharded+ivf as its default configuration. The full
+sharded-IVF parity suite lives in tests/test_ivf_sharded.py.
 """
 
 import numpy as np
@@ -341,25 +343,60 @@ def test_service_full_probes_matches_exact_scorer(setup):
         svc.stop()
 
 
-def test_service_without_index_errors_cleanly(setup):
+def test_service_without_index_serves_degraded_fallback(setup):
+    """r16 satellite: a slot promoted without an index SERVES through the
+    recorded exact-scoring fallback (degraded="ivf_unavailable") instead of
+    erroring — and the answer matches the exact scorer exactly."""
     config, params, articles = setup
     corpus = ServingCorpus(config, block=16)       # exact corpus: no slot.ivf
     corpus.swap(params, articles, note="initial")
     svc = RecommendationService(params, config, corpus, top_k=5, max_batch=8,
                                 retrieval="ivf", probes=4)
+    svc.warmup()                       # warms the fallback variants instead
     try:
         reply = svc.submit(articles[0], deadline_s=10.0).result(timeout=10.0)
-        assert reply.status == "error" and "no_ivf_index" in reply.reason
+        assert reply.ok
+        assert "ivf_unavailable" in reply.degraded
+        slot = corpus.active
+        exact = make_serve_fn(config, 5)
+        _, ei = jax.device_get(exact(params, slot.emb, slot.valid,
+                                     slot.scales, articles[0][None]))
+        np.testing.assert_array_equal(reply.indices, np.asarray(ei)[0])
+        ev = [e for e in svc.events if e["event"] == "ivf_unavailable"]
+        assert len(ev) == 1 and ev[0]["corpus_version"] == slot.version
     finally:
         svc.stop()
 
 
-def test_ivf_does_not_compose_with_sharded_yet(setup):
+def test_ivf_composes_with_sharded(setup):
+    """r16 tentpole smoke: retrieval='ivf' + a mesh-sharded corpus builds a
+    shard-major index, the service DERIVES sharded=True + retrieval='ivf'
+    from the corpus (the multi-device default configuration), and a served
+    reply matches the unsharded exact scorer at probes=n_cells."""
     config, params, articles = setup
-    corpus = _ivf_corpus(config, params, articles)
-    with pytest.raises(ValueError, match="sharded"):
-        RecommendationService(params, config, corpus, retrieval="ivf",
-                              sharded=True)
+    mesh = get_mesh()
+    corpus = ServingCorpus(config, block=16, mesh=mesh, retrieval="ivf",
+                           n_cells=4)
+    corpus.swap(params, articles, note="initial")
+    slot = corpus.active
+    assert hasattr(slot.ivf, "n_shards")           # shard-major layout
+    svc = RecommendationService(params, config, corpus, top_k=5, max_batch=8,
+                                probes=4)          # sharded/retrieval derived
+    svc.warmup()
+    try:
+        s = svc.summary()
+        assert s["sharded"] is True and s["retrieval"] == "ivf"
+        reply = svc.submit(articles[0], deadline_s=10.0).result(timeout=10.0)
+        assert reply.ok
+        exact = make_serve_fn(config, 5)
+        flat = ServingCorpus(config, block=16)
+        flat.swap(params, articles, note="flat")
+        fs = flat.active
+        _, ei = jax.device_get(exact(params, fs.emb, fs.valid, fs.scales,
+                                     articles[0][None]))
+        np.testing.assert_array_equal(reply.indices, np.asarray(ei)[0])
+    finally:
+        svc.stop()
 
 
 def test_reindex_requires_ivf_retrieval(setup):

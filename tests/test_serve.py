@@ -594,21 +594,19 @@ def test_revert_racing_concurrent_readers_never_tears(setup):
 
 # ------------------------------------- sharded swap/append (ISSUE 13)
 
-def test_ivf_sharded_composition_refused_before_any_device_work():
-    """Satellite regression: retrieval='ivf' + sharded raises the typed
-    ShardedUnsupported (a ValueError subclass) from BOTH constructors
-    BEFORE touching params, corpus, mesh or any device — proven by passing
-    sentinels that would explode on first attribute access."""
+def test_ivf_sharded_composition_accepted_and_taxonomy_kept():
+    """r16 flipped the r11 refusal: retrieval='ivf' + a mesh composes (the
+    corpus constructor accepts it without touching a device), and the typed
+    `ShardedUnsupported` stays importable in the exception taxonomy for
+    callers that guard on it."""
+    from dae_rnn_news_recommendation_tpu.parallel.mesh import get_mesh
     from dae_rnn_news_recommendation_tpu.serve import ShardedUnsupported
 
     assert issubclass(ShardedUnsupported, ValueError)
     config = DAEConfig(n_features=F, n_components=D,
                        triplet_strategy="none", corr_frac=0.0)
-    with pytest.raises(ShardedUnsupported, match="sharded IVF is future"):
-        ServingCorpus(config, retrieval="ivf", mesh=object())
-    with pytest.raises(ShardedUnsupported, match="sharded IVF is future"):
-        RecommendationService(object(), object(), object(),
-                              retrieval="ivf", sharded=True)
+    corpus = ServingCorpus(config, retrieval="ivf", mesh=get_mesh())
+    assert corpus.retrieval == "ivf" and corpus.mesh is not None
 
 
 def test_sharded_swap_incremental_promotes_with_uniform_shard_stamps(setup):
