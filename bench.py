@@ -1142,8 +1142,8 @@ def _bench_serve_ivf(jax, params, config, sz):
     n_dev = jax.local_device_count()
     if n_dev > 1:
         from dae_rnn_news_recommendation_tpu.index import build_sharded_cells
-        from dae_rnn_news_recommendation_tpu.parallel.mesh import (get_mesh,
-                                                                   shard_rows)
+        from dae_rnn_news_recommendation_tpu.parallel.mesh import (
+            dispatch_lock, get_mesh, shard_rows)
         from dae_rnn_news_recommendation_tpu.serve import (
             make_sharded_ivf_serve_fn)
 
@@ -1153,10 +1153,14 @@ def _bench_serve_ivf(jax, params, config, sz):
         cells_s = build_sharded_cells(slot.emb, slot.valid, slot.scales,
                                       slot.ivf.centroids, slot.ivf.assign,
                                       n_shards=n_dev, device_put=put)
-        s_s, i_s = make_sharded_ivf_serve_fn(config, k_rec, best, mesh)(
-            params, put(slot.emb), put(slot.valid),
-            None if slot.scales is None else put(slot.scales),
-            cells_s, queries)
+        # bench phases overlap fleet soaks in the full run: every direct
+        # shard_map dispatch serializes through the process-wide mesh lock
+        with dispatch_lock():
+            s_s, i_s = make_sharded_ivf_serve_fn(config, k_rec, best, mesh)(
+                params, put(slot.emb), put(slot.valid),
+                None if slot.scales is None else put(slot.scales),
+                cells_s, queries)
+            jax.block_until_ready((s_s, i_s))
         s_u, i_u = make_ivf_serve_fn(config, k_rec, best)(
             params, slot.emb, slot.valid, slot.scales, slot.ivf, queries)
         s_s, i_s, s_u, i_u = map(

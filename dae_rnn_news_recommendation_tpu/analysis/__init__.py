@@ -27,8 +27,28 @@ thread spawns, intra-package call graph):
     C4  started non-daemon thread with no join/stop on any path
     C5  future resolved / callbacks invoked while holding a lock
 
+Making sharded+IVF the serving default (r16) surfaced a third class neither
+family could see: mesh/SPMD invariants. A shard_map program is a collective
+— every device must rendezvous on the same program — so concurrent
+dispatches from threads deadlock; collectives under per-shard control flow
+hang; replicated out_specs on unreduced values silently serve one shard's
+partial answer. The meshcheck family (meshcheck.py) extends the project
+index with shard_map construction sites, the sharded-callable closure, and
+collective/axis inventories:
+
+    S1  shard_map dispatch from a thread-reachable site without the mesh
+        dispatch lock (parallel/mesh.dispatch_lock — the r16 deadlock class)
+    S2  collective under control flow divergent across shards
+    S3  collective axis unbound by the enclosing shard_map / outside the
+        mesh axis vocabulary (parallel/mesh.MESH_AXIS_NAMES)
+    S4  host-side work (device transfers, np. materialization, host lists)
+        captured in a shard_map body
+    S5  out_specs claiming P() (replicated) for an output the body never
+        collectively reduces — the static twin of check_rep, which the
+        Pallas paths must disable
+
 CLI:    python -m dae_rnn_news_recommendation_tpu.analysis [paths] [--json]
-        [--select C1,C3] [--list-rules]
+        [--select C1,C3] [--select S] [--select R,C,S] [--list-rules]
         (no paths: the package + bench.py + evidence/; exit 0 = clean)
 Runtime: `compile_guard(max_compiles=N)` — a context manager counting XLA
         backend compiles via `jax.monitoring`, so tests can pin an upper

@@ -30,8 +30,10 @@ def main(argv=None):
                         help="emit a JSON report (findings + suppressed with "
                         "reasons) instead of text")
     parser.add_argument("--select", metavar="RULES", default=None,
-                        help="comma-separated rule ids to run (e.g. "
-                        "'R1,C3'); default: all registered rules")
+                        help="comma-separated rule ids or family letters to "
+                        "run (e.g. 'R1,C3', 'S', 'R,C,S'); a bare family "
+                        "letter selects every rule with that prefix; "
+                        "default: all registered rules")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog (id: title) and exit")
     try:
@@ -46,10 +48,20 @@ def main(argv=None):
 
     select = None
     if args.select is not None:
-        select = {s.strip() for s in args.select.split(",") if s.strip()}
-        unknown = sorted(select - set(RULES))
-        if not select or unknown:
-            what = ", ".join(unknown) if unknown else "(empty)"
+        tokens = {s.strip() for s in args.select.split(",") if s.strip()}
+        families = {re.match(r"([A-Za-z]+)\d+$", r).group(1) for r in RULES}
+        select, unknown = set(), []
+        for tok in tokens:
+            if tok in RULES:
+                select.add(tok)
+            elif tok in families:   # family letter: every rule it prefixes
+                select.update(r for r in RULES
+                              if re.match(r"([A-Za-z]+)\d+$", r).group(1)
+                              == tok)
+            else:
+                unknown.append(tok)
+        if not tokens or unknown:
+            what = ", ".join(sorted(unknown)) if unknown else "(empty)"
             print(f"jaxcheck: --select names unknown rule(s): {what} "
                   f"(try --list-rules)", file=sys.stderr)
             return 2

@@ -261,7 +261,8 @@ def analyze_paths(paths, root=None, select=None):
     return findings, suppressed, n
 
 
-# importing the rule modules registers them (kept last: both import helpers
-# from here; concurrency additionally imports helpers from rules)
+# importing the rule modules registers them (kept last: all import helpers
+# from here; concurrency and meshcheck additionally import from rules)
 from . import rules  # noqa: E402,F401
 from . import concurrency  # noqa: E402,F401
+from . import meshcheck  # noqa: E402,F401

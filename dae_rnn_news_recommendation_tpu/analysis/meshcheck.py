@@ -1,0 +1,925 @@
+"""meshcheck rules S1-S5 — whole-program mesh/SPMD collective checkers (see
+docs/jaxcheck.md for the catalog with in-repo examples).
+
+r16 made sharded+IVF the default serving configuration and paid for it with a
+deadlock class neither the R rules (per-file tracing hygiene) nor the C rules
+(threading) could see: a `shard_map` program is a COLLECTIVE — every mesh
+device must rendezvous on the same program — so two threads dispatching
+concurrently can interleave their per-device participant arrivals and hang
+the process. The fix was the process-wide mesh dispatch lock
+(`parallel/mesh.MESH_DISPATCH_LOCK`); this module is the lint family that
+keeps that invariant (and four more SPMD invariants) enforced ahead of
+execution.
+
+The rules ride the threadcheck `ProjectIndex` (project.py) extended here
+with a mesh/SPMD index built lazily per project:
+
+  * shard_map construction sites — `jax.shard_map` and the canonical
+    `_shard_map` compat alias (parallel/mesh.py) — with the mapped callable
+    resolved to its def, the in/out specs, and the axis names they bind;
+  * the sharded-callable closure: functions that DISPATCH a shard_map
+    program when called (`topk_sharded`, `sharded_ivf_topk`, training step
+    closures), functions that FACTORY one (`make_sharded_serve_fn` returns
+    `jit(run)` where `run` dispatches), and the names/attributes bound from
+    factory calls (`self._serve_fns = {k: make_sharded_ivf_serve_fn(...)}`);
+  * collective calls (`psum/pmean/all_gather/ppermute/axis_index/...`) with
+    their axis-name operand;
+  * `NamedSharding`/`PartitionSpec` constructions and the project's mesh
+    axis vocabulary (the `MESH_AXIS_NAMES` tuple in parallel/mesh.py).
+
+Like every jaxcheck rule these are heuristic by construction: callable
+identity is nominal (bare-name and `self.attr` resolution, the same
+convention as the C rules' lock keys), bodies are analyzed lexically, and
+anything a rule cannot see carries a reasoned `# jaxcheck: disable=...`.
+"""
+
+import ast
+
+from .core import rule
+from . import project
+from .concurrency import (_FUNC_DEFS, _make_keyer, _resolve_call, _units,
+                          _walk_held)
+from .rules import call_name, dotted, names_in
+
+_SHARD_MAP_TAILS = {"shard_map", "_shard_map"}
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+                "pshuffle", "all_to_all", "psum_scatter", "axis_index",
+                "axis_size", "pcast"}
+# collectives whose output is identical on every shard — the only producers
+# that justify a replicated P() out_spec (S5)
+_REDUCING = {"psum", "pmean", "pmax", "pmin", "all_gather"}
+# axis-name operand position (default 1: psum(x, axis_name), ppermute(x,
+# axis_name, perm), pcast(v, (axis,), to=...))
+_AXIS_ARG_POS = {"axis_index": 0, "axis_size": 0}
+_SPEC_TAILS = {"P", "PartitionSpec"}
+_JIT_TAILS = {"jit", "pjit"}
+# the sanctioned guard idioms S1 recognizes as holding the mesh dispatch
+# lock when used as a `with` context: parallel/mesh.dispatch_lock() and the
+# service/corpus wrappers that delegate to it
+_GUARD_CALL_TAILS = {"dispatch_lock", "mesh_guard", "_mesh_guard",
+                     "dispatch_guard", "_dispatch_guard"}
+_HOST_NP_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                  "onp.asarray", "onp.array", "np.flatnonzero",
+                  "numpy.flatnonzero", "np.nonzero", "numpy.nonzero",
+                  "np.concatenate", "numpy.concatenate", "np.stack",
+                  "numpy.stack"}
+_DEVICE_MOVERS = {"jax.device_put", "device_put", "jax.device_get",
+                  "device_get"}
+
+MESH_KEY = "mesh:dispatch"
+
+
+def _tail(name):
+    return name.split(".")[-1] if name else None
+
+
+def _is_shard_map_call(node):
+    return isinstance(node, ast.Call) and \
+        _tail(call_name(node)) in _SHARD_MAP_TAILS
+
+
+def _own_nodes(fn):
+    """All AST nodes of `fn`'s body outside nested function defs/lambdas —
+    the unit-exclusive view (nested defs are their own units)."""
+    out = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_DEFS + (ast.Lambda,)):
+                continue
+            out.append(child)
+            visit(child)
+
+    visit(fn)
+    return out
+
+
+def _kwarg(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _mesh_keyer(owner, mod, index):
+    """The C-family lock keyer extended with the mesh dispatch guard: a
+    `with dispatch_lock():` / `with self._mesh_guard():` context — or a
+    mesh-named lock (`_MESH_LOCK`, `MESH_DISPATCH_LOCK`) — all collapse to
+    the one `mesh:dispatch` key, because they ARE one process-wide lock."""
+    base = _make_keyer(owner, mod, index)
+
+    def keyer(expr):
+        if isinstance(expr, ast.Call):
+            if _tail(call_name(expr)) in _GUARD_CALL_TAILS:
+                return MESH_KEY
+            return None
+        key = base(expr)
+        if key is not None:
+            parts = set(key.split(".")[-1].split(":")[-1].lower()
+                        .strip("_").split("_"))
+            if "mesh" in parts:
+                return MESH_KEY
+        return key
+
+    return keyer
+
+
+def _mesh_entries(index, mod):
+    """Per-function entry-held sets under the mesh keyer (the C-family
+    `_module_entries` with mesh-guard awareness): a helper only ever called
+    under `with self._mesh_guard():` is analyzed with the guard held."""
+    cached = index._cache.get(("mesh_entries", mod.relpath))
+    if cached is not None:
+        return cached
+    units = _units(mod)
+    entry = {id(node): frozenset() for _, node in units}
+    for _ in range(2):
+        acc = {}
+        for owner, node in units:
+            keyer = _mesh_keyer(owner, mod, index)
+            nodes, _ = _walk_held(node, keyer, entry[id(node)])
+            for n, held in nodes:
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = _resolve_call(n, owner, mod)
+                if callee is not None and id(callee) in entry:
+                    prev = acc.get(id(callee))
+                    acc[id(callee)] = held if prev is None else (prev & held)
+        entry = {k: frozenset(acc.get(k) or frozenset()) for k in entry}
+    index._cache[("mesh_entries", mod.relpath)] = (units, entry)
+    return units, entry
+
+
+# ------------------------------------------------------------- mesh index
+
+class ShardMapSite:
+    """One shard_map construction: the call, its resolved mapped callable
+    (a FunctionDef/Lambda or None), and the axis names its specs bind."""
+
+    __slots__ = ("call", "relpath", "body", "in_spec_elts", "out_spec_elts",
+                 "spec_literals", "spec_vars")
+
+    def __init__(self, call, relpath, body, in_spec, out_spec):
+        self.call = call
+        self.relpath = relpath
+        self.body = body
+        self.in_spec_elts = _spec_elts(in_spec)
+        self.out_spec_elts = _spec_elts(out_spec)
+        self.spec_literals, self.spec_vars = set(), set()
+        for expr in (in_spec, out_spec):
+            if expr is None:
+                continue
+            for node in ast.walk(expr):
+                if not (isinstance(node, ast.Call)
+                        and _tail(call_name(node)) in _SPEC_TAILS):
+                    continue
+                for arg in node.args:
+                    items = arg.elts if isinstance(arg, ast.Tuple) else [arg]
+                    for item in items:
+                        if isinstance(item, ast.Constant) and \
+                                isinstance(item.value, str):
+                            self.spec_literals.add(item.value)
+                        elif isinstance(item, ast.Name):
+                            self.spec_vars.add(item.id)
+
+
+def _spec_elts(expr):
+    """The per-operand spec expressions: a tuple literal's elements, a
+    single spec applied to every operand (list of one marker), or None when
+    the spec expression is absent/opaque."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Tuple):
+        return list(expr.elts)
+    return [expr]
+
+
+def _spec_is_replicated(elt):
+    """True for `P()` / `P(None, None)` — an out_spec claiming the body's
+    output is identical on every shard."""
+    if not (isinstance(elt, ast.Call)
+            and _tail(call_name(elt)) in _SPEC_TAILS):
+        return False
+    if elt.keywords:
+        return False
+    return all(isinstance(a, ast.Constant) and a.value is None
+               for a in elt.args)
+
+
+def _spec_has_axis(elt):
+    """True when a spec element names at least one mesh axis (a string
+    literal or an axis variable) — i.e. the operand differs per shard."""
+    if elt is None:
+        return True
+    for node in ast.walk(elt):
+        if isinstance(node, ast.Call) and \
+                _tail(call_name(node)) in _SPEC_TAILS:
+            for arg in node.args:
+                items = arg.elts if isinstance(arg, ast.Tuple) else [arg]
+                for item in items:
+                    if not (isinstance(item, ast.Constant)
+                            and item.value is None):
+                        return True
+            return False
+    return True   # opaque spec expression: conservatively per-shard
+
+
+def _local_defs(scope, mod_tree):
+    """name -> FunctionDef, innermost-first: defs inside `scope` shadow
+    same-named defs elsewhere in the module."""
+    defs = {}
+    for node in ast.walk(mod_tree):
+        if isinstance(node, _FUNC_DEFS):
+            defs.setdefault(node.name, node)
+    if scope is not None:
+        for node in ast.walk(scope):
+            if isinstance(node, _FUNC_DEFS) and node is not scope:
+                defs[node.name] = node
+    return defs
+
+
+def _resolve_mapped(call, scope, mod_tree):
+    """The FunctionDef/Lambda the shard_map maps, or None. A lambda that
+    just forwards to a local function (`lambda p, b, k: local_loss(p, b,
+    k)`) resolves to that function — dp.py's donation idiom."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    defs = _local_defs(scope, mod_tree)
+    if isinstance(arg, ast.Call) and \
+            _tail(call_name(arg)) == "partial" and arg.args:
+        arg = arg.args[0]
+    if isinstance(arg, ast.Lambda):
+        if isinstance(arg.body, ast.Call) and \
+                isinstance(arg.body.func, ast.Name) and \
+                arg.body.func.id in defs:
+            return defs[arg.body.func.id]
+        return arg
+    if isinstance(arg, ast.Name):
+        return defs.get(arg.id)
+    return None
+
+
+def _resolve_spec(expr, scope):
+    """A spec passed as a bare name resolves to its assignment in the
+    enclosing function (`p_specs = {...}; in_specs=(p_specs, ...)`)."""
+    if not isinstance(expr, ast.Name) or scope is None:
+        return expr
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == expr.id
+                for t in node.targets):
+            return node.value
+    return expr
+
+
+class MeshIndex:
+    """Project-wide mesh/SPMD facts, cached on ProjectIndex._cache."""
+
+    def __init__(self):
+        self.sites = []              # [ShardMapSite]
+        self.by_mod = {}             # relpath -> [ShardMapSite]
+        self.call_site = {}          # id(Call inside a body) -> ShardMapSite
+        self.dispatcher_names = set()   # calling one dispatches a collective
+        self.dispatcher_ids = set()     # id(FunctionDef) of the same
+        self.factory_names = set()      # calling one RETURNS a sharded callable
+        self.class_sharded_attrs = {}   # id(ClassIndex) -> {attr}
+        self.vocab = None               # mesh axis vocabulary, or None
+
+
+def mesh_index(index):
+    cached = index._cache.get("mesh")
+    if cached is not None:
+        return cached
+    mi = MeshIndex()
+    facts = []   # (mod, owner, fn, own, constructs, bound_sm, call_tails)
+    for mod in index.modules.values():
+        for owner, fn in _units(mod):
+            own = _own_nodes(fn)
+            constructs = [n for n in own if _is_shard_map_call(n)]
+            bound_sm = set()
+            for n in own:
+                if isinstance(n, ast.Assign) and _is_shard_map_call(n.value):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            bound_sm.add(t.id)
+            call_tails = set()
+            for n in own:
+                if isinstance(n, ast.Call):
+                    if isinstance(n.func, ast.Name):
+                        call_tails.add(n.func.id)
+            facts.append((mod, owner, fn, own, constructs, bound_sm,
+                          call_tails))
+            for c in constructs:
+                body = _resolve_mapped(c, fn, mod.tree)
+                in_spec = _resolve_spec(
+                    _kwarg(c, "in_specs")
+                    or (c.args[2] if len(c.args) > 2 else None), fn)
+                out_spec = _resolve_spec(
+                    _kwarg(c, "out_specs")
+                    or (c.args[3] if len(c.args) > 3 else None), fn)
+                site = ShardMapSite(c, mod.relpath, body, in_spec, out_spec)
+                mi.sites.append(site)
+                mi.by_mod.setdefault(mod.relpath, []).append(site)
+                trees = [body] if body is not None else []
+                if c.args and isinstance(c.args[0], ast.Lambda):
+                    trees.append(c.args[0])
+                for tree in trees:
+                    for n in ast.walk(tree):
+                        if isinstance(n, ast.Call):
+                            mi.call_site.setdefault(id(n), site)
+        if mi.vocab is None:
+            mi.vocab = _module_vocab(mod)
+
+    # dispatcher seed: a unit that CALLS a shard_map program it built —
+    # `shard_map(...)(args)` immediately, or via a local binding
+    for mod, owner, fn, own, constructs, bound_sm, _tails in facts:
+        direct = any(
+            isinstance(n, ast.Call)
+            and (_is_shard_map_call(n.func)
+                 or (isinstance(n.func, ast.Name) and n.func.id in bound_sm))
+            for n in own)
+        if direct:
+            mi.dispatcher_names.add(fn.name)
+            mi.dispatcher_ids.add(id(fn))
+    # propagate dispatcher-ness: bare-name calls of a dispatcher, and a
+    # parent whose NESTED def dispatches (the training-step shape: `step`
+    # hands `loss_of` to value_and_grad) — unless the parent returns the
+    # nested callable instead of running it (then it's a factory, below)
+    for _ in range(3):
+        for mod, owner, fn, own, _c, _b, call_tails in facts:
+            if id(fn) in mi.dispatcher_ids:
+                continue
+            hit = bool(call_tails & mi.dispatcher_names)
+            if not hit:
+                for node in ast.walk(fn):
+                    if node is not fn and isinstance(node, _FUNC_DEFS) and \
+                            id(node) in mi.dispatcher_ids and \
+                            not _returns_name(own, node.name):
+                        hit = True
+                        break
+            if hit:
+                mi.dispatcher_names.add(fn.name)
+                mi.dispatcher_ids.add(id(fn))
+
+    # factories: units returning a sharded callable — a shard_map
+    # construction, `jit(dispatcher)`, a dispatcher def, or (transitively)
+    # another factory's result
+    for _ in range(3):
+        for mod, owner, fn, own, constructs, bound_sm, _tails in facts:
+            if fn.name in mi.factory_names:
+                continue
+            if _returns_sharded(own, bound_sm, mi):
+                mi.factory_names.add(fn.name)
+
+    # class attributes bound from factory calls anywhere in the class body:
+    # `self._serve_fns = {k: make_sharded_ivf_serve_fn(...) for ...}`
+    for mod in index.modules.values():
+        for ci in mod.classes:
+            attrs = set()
+            for node in ast.walk(ci.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not _value_is_sharded(node.value, mi, ci):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        attrs.add(t.attr)
+            if attrs:
+                mi.class_sharded_attrs[id(ci)] = attrs
+
+    index._cache["mesh"] = mi
+    return mi
+
+
+def _returns_name(own, name):
+    for n in own:
+        if isinstance(n, ast.Return) and n.value is not None:
+            if name in names_in(n.value):
+                return True
+    return False
+
+
+def _returns_sharded(own, bound_sm, mi):
+    bound_fact = set()
+    for n in own:
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) and \
+                isinstance(n.value.func, ast.Name) and \
+                n.value.func.id in mi.factory_names:
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    bound_fact.add(t.id)
+    for n in own:
+        if not isinstance(n, ast.Return) or n.value is None:
+            continue
+        for sub in ast.walk(n.value):
+            if _is_shard_map_call(sub):
+                return True
+            if isinstance(sub, ast.Name) and \
+                    sub.id in (bound_sm | bound_fact
+                               | mi.dispatcher_names):
+                return True
+            if isinstance(sub, ast.Call):
+                name = call_name(sub)
+                if name in mi.factory_names or \
+                        (_tail(name) in _JIT_TAILS and sub.args
+                         and isinstance(sub.args[0], ast.Name)
+                         and sub.args[0].id in mi.dispatcher_names):
+                    return True
+    return False
+
+
+def _value_is_sharded(expr, mi, owner):
+    """True when an assigned expression produces a shard_map-built callable
+    (or a collection of them): a construction without immediate call, or a
+    call of a factory (bare name or `self.method`)."""
+    for sub in ast.walk(expr):
+        if _is_shard_map_call(sub):
+            return True
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Name) and f.id in mi.factory_names:
+                return True
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and f.attr in mi.factory_names:
+                return True
+    return False
+
+
+def _module_vocab(mod):
+    """The `MESH_AXIS_NAMES = ("data", ...)` tuple, when this module
+    declares one (parallel/mesh.py in the real project; fixtures may carry
+    their own)."""
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "MESH_AXIS_NAMES"
+                for t in stmt.targets):
+            if isinstance(stmt.value, (ast.Tuple, ast.List)):
+                vals = [e.value for e in stmt.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+                if vals:
+                    return set(vals)
+    return None
+
+
+# ------------------------------------------------------------------- S1
+
+@rule("S1", "shard_map dispatch from a thread-reachable site without the "
+      "mesh dispatch lock")
+def check_s1(ctx):
+    """A shard_map program is a collective: all mesh devices rendezvous on
+    the SAME program, so two threads dispatching concurrently can interleave
+    their per-device participant arrivals and deadlock the process — the
+    exact bug r16 hit when fleet replicas began sharing one sharded corpus.
+    This rule flags any call of a shard_map-built callable (direct dispatch,
+    a dispatcher function like `topk_sharded`, or a name/attribute bound
+    from a factory like `make_sharded_serve_fn`) from a thread-reachable
+    unit — a method of a thread-shared class (threadcheck's notion: owns a
+    lock or spawns/receives threads) or a function used as a Thread target —
+    without holding the mesh dispatch lock. The sanctioned idiom is
+    `parallel/mesh.dispatch_lock()` (or a wrapper delegating to it:
+    `service._mesh_guard`, `corpus._dispatch_guard`), tracked through the
+    call graph like the C rules track locks."""
+    index = project.index_for(ctx)
+    mod = index.module_for(ctx.path)
+    if mod is None:
+        return []
+    mi = mesh_index(index)
+    if not (mi.sites or mi.dispatcher_names or mi.factory_names):
+        return []
+    target_tails = {t.split(".")[-1] for t in index.thread_target_names}
+    units, entry = _mesh_entries(index, mod)
+    parents = _parents_map(mod)
+    out = []
+    for owner, fn in units:
+        reachable = (owner is not None and owner.is_thread_shared()) or \
+            fn.name in target_tails
+        if not reachable:
+            continue
+        local_sharded = _scope_sharded_names(fn, parents, mi, owner)
+        keyer = _mesh_keyer(owner, mod, index)
+        nodes, _ = _walk_held(fn, keyer, entry[id(fn)])
+        for n, held in nodes:
+            if not isinstance(n, ast.Call) or MESH_KEY in held:
+                continue
+            desc = _dispatch_desc(n, mi, owner, local_sharded)
+            if desc is None:
+                continue
+            out.append(ctx.finding(
+                n, f"{desc} from thread-reachable "
+                f"`{_unit_name(owner, fn)}` without the mesh dispatch lock "
+                "— concurrent shard_map programs interleave their "
+                "per-device rendezvous and deadlock (the r16 bug class); "
+                "wrap the call in `with parallel.mesh.dispatch_lock():`"))
+    return out
+
+
+def _parents_map(mod):
+    """id(FunctionDef) -> enclosing FunctionDef chain, innermost first."""
+    parents = {}
+
+    def visit(node, chain):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_DEFS):
+                parents[id(child)] = chain
+                visit(child, [child] + chain)
+            else:
+                visit(child, chain)
+
+    visit(mod.tree, [])
+    return parents
+
+
+def _scope_sharded_names(fn, parents, mi, owner):
+    """Local names bound to sharded callables in `fn` or any enclosing
+    function (a closure dispatching `serve_fn` bound by its parent)."""
+    names = set()
+    for scope in [fn] + parents.get(id(fn), []):
+        for n in _own_nodes(scope):
+            if isinstance(n, ast.Assign) and \
+                    _binding_is_sharded(n.value, mi, owner):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _binding_is_sharded(expr, mi, owner):
+    if _value_is_sharded(expr, mi, owner):
+        return True
+    # `serve_fn = self._serve_fns[k]` — indexing into a sharded collection
+    attrs = mi.class_sharded_attrs.get(id(owner), set()) if owner else set()
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute) and \
+                isinstance(sub.value, ast.Name) and \
+                sub.value.id == "self" and sub.attr in attrs:
+            return True
+    return False
+
+
+def _dispatch_desc(call, mi, owner, local_sharded):
+    """Human-readable description when `call` dispatches a shard_map-built
+    callable, else None."""
+    f = call.func
+    if _is_shard_map_call(f):
+        return "direct `shard_map(...)(...)` dispatch"
+    base = f.value if isinstance(f, ast.Subscript) else f
+    if isinstance(base, ast.Name):
+        if base.id in local_sharded:
+            return f"dispatch of sharded callable `{base.id}`"
+        if isinstance(f, ast.Name) and f.id in mi.dispatcher_names:
+            return f"call of shard_map dispatcher `{f.id}`"
+    if isinstance(base, ast.Attribute) and \
+            isinstance(base.value, ast.Name) and base.value.id == "self" \
+            and owner is not None:
+        attrs = mi.class_sharded_attrs.get(id(owner), set())
+        if base.attr in attrs:
+            return f"dispatch of sharded callable `self.{base.attr}`"
+        meth = owner.methods.get(base.attr)
+        if isinstance(f, ast.Attribute) and meth is not None and \
+                id(meth) in mi.dispatcher_ids:
+            return f"call of shard_map dispatcher `self.{base.attr}`"
+    return None
+
+
+def _unit_name(owner, fn):
+    return f"{owner.name}.{fn.name}" if owner is not None else fn.name
+
+
+# ------------------------------------------------------------------- S2
+
+@rule("S2", "collective under control flow divergent across shards")
+def check_s2(ctx):
+    """Inside a shard_map body every shard runs the same Python trace — but
+    a collective nested under an `if`/`while` (trace-time divergence if the
+    predicate is a concrete per-shard value) or under a `lax.cond` branch
+    predicated on per-shard data makes shards DISAGREE on whether the
+    rendezvous happens: the shards that enter wait forever for the shards
+    that don't. Taint is seeded from the mapped function's per-shard
+    operands (parameters whose in_spec names a mesh axis; replicated `P()`
+    operands are shard-invariant and exempt) and follows assignments.
+    Uniform predicates — closure config, static shapes — never fire."""
+    index = project.index_for(ctx)
+    mod = index.module_for(ctx.path)
+    if mod is None:
+        return []
+    mi = mesh_index(index)
+    out, seen = [], set()
+    for site in mi.by_mod.get(mod.relpath, ()):
+        body = site.body
+        if body is None:
+            continue
+        tainted = _body_taint(site, per_shard_only=True)
+        for node in ast.walk(body):
+            if isinstance(node, (ast.If, ast.While)):
+                if not (names_in(node.test) & tainted):
+                    continue
+                for stmt in node.body + node.orelse:
+                    for sub in ast.walk(stmt):
+                        if _collective_tail(sub) and id(sub) not in seen:
+                            seen.add(id(sub))
+                            out.append(ctx.finding(
+                                sub, f"collective `{call_name(sub)}` under "
+                                "a branch predicated on per-shard data "
+                                f"(line {node.lineno}) — shards disagreeing "
+                                "on the predicate skip the rendezvous and "
+                                "the rest hang; hoist the collective out or "
+                                "make the predicate shard-invariant"))
+            elif isinstance(node, ast.Call) and \
+                    _tail(call_name(node)) in ("cond", "switch") and \
+                    (call_name(node) or "").split(".")[0] in ("jax", "lax"):
+                if not node.args or not (names_in(node.args[0]) & tainted):
+                    continue
+                branches = node.args[1:]
+                defs = _local_defs(body, mod.tree)
+                for br in branches:
+                    tree = br if isinstance(br, ast.Lambda) else \
+                        defs.get(br.id) if isinstance(br, ast.Name) else None
+                    if tree is None:
+                        continue
+                    for sub in ast.walk(tree):
+                        if _collective_tail(sub) and id(sub) not in seen:
+                            seen.add(id(sub))
+                            out.append(ctx.finding(
+                                sub, f"collective `{call_name(sub)}` inside "
+                                "a `lax.cond`/`switch` branch whose "
+                                "predicate is per-shard data (line "
+                                f"{node.lineno}) — only the shards taking "
+                                "this branch rendezvous; compute both "
+                                "branches and `where`-select, or psum the "
+                                "predicate first"))
+    return out
+
+
+def _collective_tail(node):
+    return isinstance(node, ast.Call) and \
+        _tail(call_name(node)) in _COLLECTIVES
+
+
+def _body_taint(site, per_shard_only=False):
+    """Names carrying per-shard (or, with per_shard_only=False, any traced
+    operand) data inside the mapped body: seeded from its parameters —
+    positionally matched against in_specs when resolvable — plus nested-def
+    parameters, propagated through assignments and loop targets."""
+    body = site.body
+    params = []
+    args = getattr(body, "args", None)
+    if args is not None:
+        params = [a.arg for a in args.args + args.posonlyargs
+                  + args.kwonlyargs]
+    tainted = set()
+    elts = site.in_spec_elts
+    for i, p in enumerate(params):
+        if per_shard_only and elts is not None:
+            elt = elts[i] if len(elts) > 1 and i < len(elts) else elts[0]
+            if not _spec_has_axis(elt):
+                continue
+        tainted.add(p)
+    for node in ast.walk(body):
+        if isinstance(node, _FUNC_DEFS) and node is not body:
+            for a in node.args.args:
+                tainted.add(a.arg)
+    for _ in range(2):
+        for node in ast.walk(body):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                src = node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets, src = [node.target], node.value
+            elif isinstance(node, ast.For):
+                targets, src = [node.target], node.iter
+            else:
+                continue
+            if src is None or not (names_in(src) & tainted):
+                continue
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        tainted.add(sub.id)
+    return tainted
+
+
+# ------------------------------------------------------------------- S3
+
+@rule("S3", "collective axis name not bound by the enclosing shard_map / "
+      "unknown mesh axis")
+def check_s3(ctx):
+    """A collective names the mesh axis it reduces over; an axis the
+    enclosing shard_map's specs never bind — or a string outside the
+    project's mesh vocabulary (`parallel/mesh.MESH_AXIS_NAMES`) — is a typo
+    XLA only reports at trace time, from whichever call site traces first.
+    Matching is nominal and deliberately conservative: literal collective
+    axes are judged against literal spec axes, variable axes against spec
+    variables; mixed or unresolvable specs stay silent. PartitionSpec
+    constructions are vocabulary-checked too."""
+    index = project.index_for(ctx)
+    mod = index.module_for(ctx.path)
+    if mod is None:
+        return []
+    mi = mesh_index(index)
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        tail = _tail(name)
+        if tail in _COLLECTIVES:
+            axis = _kwarg(node, "axis_name")
+            if axis is None:
+                pos = _AXIS_ARG_POS.get(tail, 1)
+                axis = node.args[pos] if len(node.args) > pos else None
+            if axis is None:
+                continue
+            items = axis.elts if isinstance(axis, ast.Tuple) else [axis]
+            site = mi.call_site.get(id(node))
+            for item in items:
+                lit = item.value if (isinstance(item, ast.Constant) and
+                                     isinstance(item.value, str)) else None
+                if site is not None:
+                    ok_lit = (lit is not None and site.spec_literals
+                              and not site.spec_vars
+                              and lit not in site.spec_literals)
+                    ok_var = (isinstance(item, ast.Name)
+                              and site.spec_vars and not site.spec_literals
+                              and item.id not in site.spec_vars)
+                    if ok_lit or ok_var:
+                        shown = lit if lit is not None else item.id
+                        bound = sorted(site.spec_literals
+                                       or site.spec_vars)
+                        out.append(ctx.finding(
+                            node, f"`{name}` names axis `{shown}` but the "
+                            "enclosing shard_map's specs bind "
+                            f"{', '.join(f'`{b}`' for b in bound)} — an "
+                            "unbound axis fails at trace time from "
+                            "whichever caller traces first"))
+                        continue
+                if lit is not None and mi.vocab is not None and \
+                        lit not in mi.vocab:
+                    out.append(ctx.finding(
+                        node, f"`{name}` names axis '{lit}', not in the "
+                        "mesh axis vocabulary "
+                        f"({', '.join(sorted(mi.vocab))}) — no mesh in "
+                        "this project binds it (MESH_AXIS_NAMES, "
+                        "parallel/mesh.py)"))
+        elif tail in _SPEC_TAILS and mi.vocab is not None:
+            for arg in node.args:
+                items = arg.elts if isinstance(arg, ast.Tuple) else [arg]
+                for item in items:
+                    if isinstance(item, ast.Constant) and \
+                            isinstance(item.value, str) and \
+                            item.value not in mi.vocab:
+                        out.append(ctx.finding(
+                            node, f"PartitionSpec names axis "
+                            f"'{item.value}', not in the mesh axis "
+                            "vocabulary "
+                            f"({', '.join(sorted(mi.vocab))}) — arrays "
+                            "placed with it can never match a mesh axis"))
+    return out
+
+
+# ------------------------------------------------------------------- S4
+
+@rule("S4", "host-side work captured in a shard_map body")
+def check_s4(ctx):
+    """`device_put`/`device_get`, `np.` materialization of traced values, or
+    host-list construction inside the mapped function runs per-trace on
+    TRACERS: it either breaks tracing outright or pins a host round-trip
+    into every dispatch of the collective — the generalization of the
+    `r1_ivf_cell_lists` hazard from jit bodies to shard_map bodies. Static
+    `np` arithmetic on Python ints (tile shapes) is untouched: only calls
+    whose arguments involve the body's traced operands fire; device
+    transfers fire unconditionally (there is no device to move to/from
+    inside the mapped program)."""
+    index = project.index_for(ctx)
+    mod = index.module_for(ctx.path)
+    if mod is None:
+        return []
+    mi = mesh_index(index)
+    out, seen = [], set()
+    for site in mi.by_mod.get(mod.relpath, ()):
+        body = site.body
+        if body is None:
+            continue
+        tainted = _body_taint(site, per_shard_only=False)
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            name = call_name(node)
+            arg_names = set()
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                arg_names |= names_in(a)
+            if name in _DEVICE_MOVERS:
+                seen.add(id(node))
+                out.append(ctx.finding(
+                    node, f"`{name}` inside a shard_map body — the mapped "
+                    "function runs per shard under trace; device placement "
+                    "belongs to the caller (specs/shardings), not the "
+                    "body"))
+            elif name in _HOST_NP_CALLS and (arg_names & tainted):
+                seen.add(id(node))
+                out.append(ctx.finding(
+                    node, f"`{name}` materializes a traced per-shard value "
+                    "on the host inside a shard_map body — this breaks "
+                    "tracing or pins a host sync into every collective "
+                    "dispatch; keep the body device-only (jnp/lax)"))
+            elif name in ("list", "tuple") and (arg_names & tainted):
+                seen.add(id(node))
+                out.append(ctx.finding(
+                    node, f"host `{name}(...)` of a traced per-shard value "
+                    "inside a shard_map body — iterating a tracer "
+                    "materializes it element-wise on the host; use jnp "
+                    "ops on the whole array"))
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "tolist" and \
+                    (names_in(node.func.value) & tainted):
+                seen.add(id(node))
+                out.append(ctx.finding(
+                    node, "`.tolist()` on a traced per-shard value inside "
+                    "a shard_map body — host materialization under trace; "
+                    "keep the body device-only"))
+    return out
+
+
+# ------------------------------------------------------------------- S5
+
+@rule("S5", "out_spec claims replication for an output the body never "
+      "reduces")
+def check_s5(ctx):
+    """An `out_specs` entry of `P()` promises the runtime that the body's
+    corresponding output is IDENTICAL on every shard — the runtime then
+    reads one shard's buffer and calls it the answer. Only a reducing
+    collective (`psum`/`pmean`/`pmax`/`pmin`/`all_gather`) makes that true;
+    a per-shard value returned through `P()` silently serves shard 0's
+    partial result. This is the static twin of shard_map's `check_rep`
+    (which the Pallas paths must disable — `check_rep=False` — because
+    pallas_call carries no replication rule, leaving exactly this hole).
+    Outputs whose return expression contains, or derives by assignment
+    from, a reducing collective pass; everything else under a replicated
+    spec fires."""
+    index = project.index_for(ctx)
+    mod = index.module_for(ctx.path)
+    if mod is None:
+        return []
+    mi = mesh_index(index)
+    out = []
+    for site in mi.by_mod.get(mod.relpath, ()):
+        body, elts = site.body, site.out_spec_elts
+        if body is None or elts is None or isinstance(body, ast.Lambda):
+            continue
+        rep = [i for i, e in enumerate(elts) if _spec_is_replicated(e)]
+        if not rep:
+            continue
+        reduced = _reduced_names(body)
+        for ret in _own_nodes(body):
+            if not isinstance(ret, ast.Return) or ret.value is None:
+                continue
+            v = ret.value
+            if isinstance(v, ast.Tuple) and len(v.elts) == len(elts):
+                exprs = [(i, v.elts[i]) for i in rep]
+            elif len(elts) == 1:
+                exprs = [(0, v)]
+            else:
+                continue   # opaque return shape: stay silent
+            for i, e in exprs:
+                if _expr_reduced(e, reduced):
+                    continue
+                out.append(ctx.finding(
+                    ret, f"out_specs position {i} claims `P()` "
+                    "(replicated) but the returned value is never reduced "
+                    "with a collective — the runtime will serve one "
+                    "shard's partial result as the answer; psum/pmean it "
+                    "(or shard the out_spec)"))
+    return out
+
+
+def _reduced_names(body):
+    """Names (transitively) assigned from a reducing collective."""
+    reduced = set()
+    for _ in range(2):
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Assign):
+                continue
+            ok = any(isinstance(s, ast.Call)
+                     and _tail(call_name(s)) in _REDUCING
+                     for s in ast.walk(node.value))
+            ok = ok or bool(names_in(node.value) & reduced)
+            if not ok:
+                continue
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        reduced.add(sub.id)
+    return reduced
+
+
+def _expr_reduced(expr, reduced_names):
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and \
+                _tail(call_name(sub)) in _REDUCING:
+            return True
+    return bool(names_in(expr) & reduced_names)

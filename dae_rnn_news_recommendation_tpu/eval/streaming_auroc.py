@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 import scipy.sparse as sp
 
+from ..parallel.mesh import _shard_map, dispatch_lock, pcast_varying
+
 _FLUSH_PAIRS = 2**31 - 2**26  # flush device int32 accumulators before overflow
 
 
@@ -331,7 +333,7 @@ def ring_streaming_auroc(embeddings, labels, mesh, metric="cosine", bins=8192,
         # zeros are device-invariant; the loop carry must match the varying
         # values ppermute/scatter produce (same dance as parallel/ring.py)
         lo_h, hi_h, ob_lo, ob_hi = (
-            jax.lax.pcast(v, (axis_name,), to="varying")
+            pcast_varying(v, axis_name)
             for v in (lo_h, hi_h, ob_lo, ob_hi))
         carry = jax.lax.fori_loop(0, n_steps, body,
                                   (local, llab, lo_h, hi_h, ob_lo, ob_hi))
@@ -341,10 +343,18 @@ def ring_streaming_auroc(embeddings, labels, mesh, metric="cosine", bins=8192,
 
     from jax.sharding import PartitionSpec as P
 
-    fn = jax.shard_map(local_fn, mesh=mesh,
-                       in_specs=(P(axis_name, None), P(None, axis_name)),
-                       out_specs=(P(), P(), P(), P()))
-    lo_h, hi_h, ob_lo, ob_hi = fn(jnp.asarray(x), jnp.asarray(label_mat))
+    # the canonical compat alias (parallel/mesh): bare `jax.shard_map` only
+    # exists on jax >= 0.6, and this module must import on 0.4.x
+    fn = _shard_map(local_fn, mesh=mesh,
+                    in_specs=(P(axis_name, None), P(None, axis_name)),
+                    out_specs=(P(), P(), P(), P()))
+    # the ring program is a collective; an eval sweep runs concurrently with
+    # serving threads (fleet soaks, churn rollouts) sharing this host's one
+    # mesh, so the dispatch serializes through the process-wide lock exactly
+    # like every sharded serve-fn call (see parallel/mesh.MESH_DISPATCH_LOCK)
+    with dispatch_lock():
+        lo_h, hi_h, ob_lo, ob_hi = fn(jnp.asarray(x), jnp.asarray(label_mat))
+        jax.block_until_ready((lo_h, hi_h, ob_lo, ob_hi))
     hist = (np.asarray(lo_h, np.float64)
             + np.asarray(hi_h, np.float64) * float(1 << _LO_BITS))
     hist_rel, hist_unrel = hist[0], hist[1]

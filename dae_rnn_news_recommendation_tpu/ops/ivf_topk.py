@@ -51,10 +51,7 @@ from jax.sharding import PartitionSpec as P
 from .topk_fused import (_ACC_LANES, _IDX_SENTINEL, _on_tpu, topk_fused,
                          topk_sharded)
 
-try:  # jax >= 0.6 re-homed shard_map; 0.4.x only has the experimental name
-    from jax.experimental.shard_map import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    _shard_map = jax.shard_map
+from ..parallel.mesh import _shard_map
 
 # queries per block: the f32 min sublane tile. Shortlists are per-block
 # unions, so a bigger bq widens every query's scanned set — keep it minimal.

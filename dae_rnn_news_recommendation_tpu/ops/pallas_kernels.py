@@ -88,6 +88,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# the TPU-flavored interpreter (emulates the TPU PRNG primitives with
+# zero-stubbed bits) only exists on jax >= 0.5; on 0.4.x this is None and the
+# masking path falls back to its exact v == 0 identity short-circuit
+_INTERPRET_PARAMS = getattr(pltpu, "InterpretParams", None)
+
 _EPS = 1e-16
 
 
@@ -594,7 +599,7 @@ def _masking_pallas(seed, x, v, block_rows, interpret):
         out_shape=jax.ShapeDtypeStruct((bp, f), x.dtype),
         # the generic interpreter has no rule for the TPU PRNG primitives — the
         # TPU-flavored interpreter emulates them (bits stubbed to zeros)
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=_INTERPRET_PARAMS() if interpret else False,
     )(seed, x)
 
 
@@ -619,6 +624,12 @@ def masking_noise_pallas(seed, x, v, block_rows=256, interpret=None):
             "masking_noise_pallas with v > 0 needs real TPU hardware (the "
             "interpreter's PRNG is stubbed to zeros); use "
             "ops.corruption.masking_noise off-TPU")
+    if interpret and _INTERPRET_PARAMS is None:
+        # jax 0.4.x has no TPU-flavored interpreter at all, and the generic one
+        # lacks rules for prng_seed/prng_random_bits. v == 0 here (the v > 0
+        # case raised above), and at v == 0 the kernel is the identity
+        # (u >= 0 holds for every draw), so skip the pallas_call outright
+        return x
     b, f = x.shape
     # keep the (rows, F) block near 2 MB so in+out+temps stay inside ~16 MB VMEM
     vmem_rows = max(8, (2 << 20) // (x.dtype.itemsize * f) // 8 * 8)

@@ -42,10 +42,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.sharding import PartitionSpec as P
 
-try:  # jax >= 0.6 re-homed shard_map; 0.4.x only has the experimental name
-    from jax.experimental.shard_map import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    _shard_map = jax.shard_map
+from ..parallel.mesh import _shard_map
 
 # corpus rows per VMEM panel: 512 x 128 lanes of f32 panel + [bq, block]
 # scores stay ~1 MB per step, far under the ~16 MB VMEM budget, and 512 is a

@@ -25,7 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import telemetry
 from ..telemetry.health import sentinel_metrics
 from ..train.step import grads_and_metrics, loss_and_metrics
-from .mesh import get_mesh  # noqa: F401  (re-exported for the estimator)
+from .mesh import _shard_map, get_mesh  # noqa: F401  (get_mesh re-exported for the estimator)
 
 _ROW_MATRICES = ("x", "x_corr", "org", "pos", "neg", "org_corr", "pos_corr",
                  "neg_corr")
@@ -189,7 +189,10 @@ def _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate,
         cost, metrics = loss_fn(params, batch, keys[0], config)
         cost = jax.lax.pmean(cost, data_axis)
         metrics = {k: jax.lax.pmean(v, data_axis) for k, v in metrics.items()}
-        return cost, metrics
+        # metrics are diagnostics riding the grad trace as aux outputs; cut
+        # them out of differentiation so shard_map's transpose never sees
+        # their symbolic-Zero cotangents (jax 0.4.x chokes on the mix)
+        return cost, jax.lax.stop_gradient(metrics)
 
     def _specs(batch):
         return {k: _key_spec(k, data_axis) for k in batch}
@@ -198,7 +201,7 @@ def _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate,
         keys = jax.random.split(key, n_shards)
 
         def loss_of(p):
-            cost, metrics = jax.shard_map(
+            cost, metrics = _shard_map(
                 lambda p_, b_, k_: local_loss(p_, b_, k_),
                 mesh=mesh,
                 in_specs=(P(), _specs(batch), P(data_axis)),
@@ -250,7 +253,7 @@ def make_parallel_eval_step(config, mesh, mining_scope="global",
         def shard_eval(params, batch):
             batch = _clean_feed(batch, config)
             specs = {k: _key_spec(k, data_axis) for k in batch}
-            return jax.shard_map(
+            return _shard_map(
                 local_metrics, mesh=mesh, in_specs=(P(), specs), out_specs=P(),
             )(params, batch)
 

@@ -41,6 +41,7 @@ from ..telemetry.health import embedding_health, mining_health, sentinel_metrics
 from ..train.step import materialize_x
 from . import mining
 from .dp import _key_spec
+from .mesh import _shard_map
 
 
 def moe_init_params(key, config, n_experts):
@@ -311,11 +312,13 @@ def make_moe_train_step(config, optimizer, mesh, capacity_factor=2.0,
                 p, b, k[0], config, router_weight=router_weight, cap=cap,
                 axis_name=axis_name)
             cost = jax.lax.pmean(cost, axis_name)
-            return cost, {m: jax.lax.pmean(v, axis_name)
-                          for m, v in metrics.items()}
+            # diagnostics only: stop_gradient keeps shard_map's transpose
+            # away from their symbolic-Zero cotangents (jax 0.4.x bug)
+            return cost, jax.lax.stop_gradient(
+                {m: jax.lax.pmean(v, axis_name) for m, v in metrics.items()})
 
         def loss_of(p):
-            return jax.shard_map(
+            return _shard_map(
                 local, mesh=mesh,
                 in_specs=(p_specs, b_specs, P(axis_name)),
                 out_specs=(P(), P()),
@@ -361,7 +364,7 @@ def make_moe_encode_fn(config, mesh=None, capacity_factor=2.0, axis_name="expert
             h, _, routed, _ = moe_forward_routed(p, xs, config, cap, axis_name)
             return h, routed
 
-        return jax.shard_map(
+        return _shard_map(
             local, mesh=mesh, in_specs=(p_specs, P(axis_name)),
             out_specs=(P(axis_name), P(axis_name)),
         )(params, x)

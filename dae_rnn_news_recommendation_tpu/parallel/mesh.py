@@ -7,8 +7,67 @@ spans all hosts after jax.distributed.initialize, so the same mesh code scales f
 one chip to a full pod — collectives ride ICI within a slice and DCN across slices.
 """
 
+import contextlib
+import threading
+
 import jax
 import numpy as np
+
+try:  # jax >= 0.6 re-homed shard_map; 0.4.x only has the experimental name
+    from jax.experimental.shard_map import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    _shard_map = jax.shard_map
+
+# The replicated->varying cast has been renamed twice: jax >= 0.8 spells it
+# `lax.pcast(..., to="varying")`, 0.6-0.7 `lax.pvary`, and 0.4.x only has the
+# rewrite primitive `shard_map.pbroadcast`. Loop carries seeded with
+# device-invariant zeros must be cast before ppermute/scatter results (which
+# ARE varying) replace them, so every ring/pipeline body routes through this
+# one alias instead of version-guessing locally.
+if hasattr(jax.lax, "pcast"):  # pragma: no cover
+
+    def pcast_varying(x, axis_name):
+        """Cast a replicated value to per-device varying on `axis_name`."""
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+
+elif hasattr(jax.lax, "pvary"):  # pragma: no cover
+
+    def pcast_varying(x, axis_name):
+        """Cast a replicated value to per-device varying on `axis_name`."""
+        return jax.lax.pvary(x, (axis_name,))
+
+else:
+    from jax.experimental.shard_map import pbroadcast as _smap_pbroadcast
+
+    def pcast_varying(x, axis_name):
+        """Cast a replicated value to per-device varying on `axis_name`."""
+        return _smap_pbroadcast(x, (axis_name,))
+
+# Every axis name any mesh in this package binds. meshcheck (analysis/
+# meshcheck.py rule S3) reads this tuple as the project's axis vocabulary:
+# a collective naming an axis outside it is a typo that XLA only reports at
+# trace time, from whichever call site happens to trace first.
+MESH_AXIS_NAMES = ("data", "model", "seq", "stage", "expert")
+
+MESH_DISPATCH_LOCK = threading.Lock()
+# Process-wide serialization of multi-device collective dispatches. A
+# shard_map program is a collective: all mesh devices must rendezvous on the
+# SAME program. Two threads (fleet replicas, the churn/rollout thread, an
+# eval sweep) dispatching concurrently can interleave their programs'
+# per-device participant arrivals and deadlock the rendezvous. Every sharded
+# dispatch in this process — serve fns, corpus health gates and index refits
+# over mesh-sharded slots, the ring AUROC — takes this lock via
+# dispatch_lock(). Single-device dispatches never touch it.
+
+
+def dispatch_lock(sharded=True):
+    """The collective-dispatch guard: `with dispatch_lock(sharded):` around
+    any call of a shard_map-built (or jit-over-sharded-arrays) program.
+    Returns the process-wide `MESH_DISPATCH_LOCK` when `sharded`, else a free
+    nullcontext — callers pass their "am I on a mesh" predicate and the
+    single-device path pays nothing. This is the one sanctioned idiom
+    meshcheck rule S1 recognizes as holding the mesh dispatch lock."""
+    return MESH_DISPATCH_LOCK if sharded else contextlib.nullcontext()
 
 
 def initialize_multihost(coordinator_address=None, num_processes=None,

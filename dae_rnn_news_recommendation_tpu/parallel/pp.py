@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..models.dae_core import resolve_activation
+from .mesh import _shard_map, pcast_varying
 
 
 def stack_tower_params(sdae):
@@ -95,15 +96,13 @@ def pipeline_stack_encode(tower, x, mesh, act, axis_name="stage",
             recv = jax.lax.ppermute(h_out, axis_name, perm)
             return recv, out
 
-        recv = jax.lax.pcast(jnp.zeros((bm, d), x_all.dtype), (axis_name,),
-                             to="varying")
-        out = jax.lax.pcast(jnp.zeros((m_micro, bm, d), x_all.dtype), (axis_name,),
-                            to="varying")
+        recv = pcast_varying(jnp.zeros((bm, d), x_all.dtype), axis_name)
+        out = pcast_varying(jnp.zeros((m_micro, bm, d), x_all.dtype), axis_name)
         _, out = jax.lax.fori_loop(0, m_micro + n_dev - 1, body, (recv, out))
         # codes exist on the last stage only; psum replicates them
         return jax.lax.psum(out, axis_name).reshape(b, d)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local_fn, mesh=mesh,
         in_specs=({"W": P(axis_name, None, None), "bh": P(axis_name, None)}, P()),
         out_specs=P(),

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.normalize import l2_normalize
+from .mesh import _shard_map, pcast_varying
 
 
 def _l2_normalize_rows(x):
@@ -56,11 +57,11 @@ def ring_pairwise_similarity(embeddings, mesh, axis_name="data", normalize=True,
         out = jnp.zeros((n_local, n), local.dtype)
         # zeros are device-invariant; mark them varying over the mesh axis so the
         # loop carry type matches the ppermute-updated value
-        out = jax.lax.pcast(out, (axis_name,), to="varying")
+        out = pcast_varying(out, axis_name)
         _, out = jax.lax.fori_loop(0, n_dev, body, (local, out))
         return out
 
-    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=P(axis_name, None),
+    fn = _shard_map(local_fn, mesh=mesh, in_specs=P(axis_name, None),
                        out_specs=P(axis_name, None))
     sim = fn(embeddings)
     if set_diagonal_zero:

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..models.gru_user import gru_apply
+from .mesh import _shard_map, pcast_varying
 
 
 def pipeline_gru_apply(params, seq, mask, mesh, axis_name="seq", microbatches=None):
@@ -56,7 +57,7 @@ def pipeline_gru_apply(params, seq, mask, mesh, axis_name="seq", microbatches=No
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
         def body(s, carry):
-            recv, states_buf, finals = carry
+            recv, states_buf = carry
             m = s - stage
             active = (m >= 0) & (m < m_micro)
             mc = jnp.clip(m, 0, m_micro - 1)
@@ -69,29 +70,29 @@ def pipeline_gru_apply(params, seq, mask, mesh, axis_name="seq", microbatches=No
 
             upd = jax.lax.dynamic_update_index_in_dim(states_buf, states_c, mc, 0)
             states_buf = jnp.where(active, upd, states_buf)
-            upd_f = jax.lax.dynamic_update_index_in_dim(finals, h_out, mc, 0)
-            finals = jnp.where(active & (stage == n_dev - 1), upd_f, finals)
 
             # one ICI hop; the wrapped-around value into stage 0 is never read
             recv = jax.lax.ppermute(h_out, axis_name, perm)
-            return recv, states_buf, finals
+            return recv, states_buf
 
         zeros_h = jnp.zeros((bm, h_dim), seq_l.dtype)
         states_buf = jnp.zeros((m_micro, bm, tc, h_dim), seq_l.dtype)
-        finals = jnp.zeros((m_micro, bm, h_dim), seq_l.dtype)
-        recv = jax.lax.pcast(zeros_h, (axis_name,), to="varying")
-        states_buf = jax.lax.pcast(states_buf, (axis_name,), to="varying")
-        finals = jax.lax.pcast(finals, (axis_name,), to="varying")
-        _, states_buf, finals = jax.lax.fori_loop(
-            0, m_micro + n_dev - 1, body, (recv, states_buf, finals))
+        recv = pcast_varying(zeros_h, axis_name)
+        states_buf = pcast_varying(states_buf, axis_name)
+        _, states_buf = jax.lax.fori_loop(
+            0, m_micro + n_dev - 1, body, (recv, states_buf))
+        return states_buf.reshape(b, tc, h_dim)
 
-        # finals live on the last stage only — psum replicates them everywhere
-        finals = jax.lax.psum(finals, axis_name)
-        return states_buf.reshape(b, tc, h_dim), finals.reshape(b, h_dim)
-
-    fn = jax.shard_map(
+    fn = _shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(), P(None, axis_name, None), P(None, axis_name)),
-        out_specs=(P(None, axis_name, None), P()),
+        out_specs=P(None, axis_name, None),
     )
-    return fn(params, seq, mask)
+    states = fn(params, seq, mask)
+    # masked steps carry state through (gru_apply's scan emits the carry at
+    # every step), so the last time slice IS the final state — reading it off
+    # the states output instead of psum-ing a separate per-stage buffer keeps
+    # the shard_map single-output, which jax 0.4.x's transpose requires when a
+    # caller differentiates through states only (a dead second output reaches
+    # the transpose as a symbolic Zero and crashes it)
+    return states, states[:, -1, :]

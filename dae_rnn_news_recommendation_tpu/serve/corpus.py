@@ -90,6 +90,7 @@ import jax.numpy as jnp
 CORPUS_DTYPES = ("float32", "bfloat16", "int8")
 
 from .. import telemetry
+from ..parallel.mesh import dispatch_lock
 from ..reliability import faults as _faults
 from ..telemetry.health import embedding_health
 from ..train.resident import build_resident
@@ -611,11 +612,15 @@ class ServingCorpus:
             resident = build_resident(new_articles,
                                       device_put=self._device_put)
             blocks = block_indices(n_new, self.block)
-            new_emb = np.asarray(jax.device_get(
-                self._encode_corpus(params, resident, blocks)))[:n_new]
+            with self._dispatch_guard():
+                new_emb = np.asarray(jax.device_get(
+                    self._encode_corpus(params, resident, blocks)))[:n_new]
 
-        old = np.asarray(jax.device_get(
-            dequantize_rows(base.emb, base.scales, base.n)))
+        # base is the ACTIVE slot — on a sharded corpus this dequantize is a
+        # collective racing the serving threads' dispatches, so it serializes
+        with self._dispatch_guard(base):
+            old = np.asarray(jax.device_get(
+                dequantize_rows(base.emb, base.scales, base.n)))
         ages = (base.ages[:base.n] if base.ages is not None
                 else np.full(base.n, max(version, 1), np.int32))
         next_version = version + 1  # promotion will assert this exact bump
@@ -667,8 +672,12 @@ class ServingCorpus:
         n = int(articles.shape[0])
         resident = build_resident(articles, device_put=self._device_put)
         blocks = block_indices(n, self.block, row_multiple=self._row_mult)
-        emb = self._encode_corpus(params, resident, blocks)
-        emb, scales = quantize_corpus(emb, self.corpus_dtype)
+        with self._dispatch_guard():
+            # the corpus sharder row-shards any resident leaf whose rows
+            # divide the mesh, so this encode can be a multi-device program
+            emb = self._encode_corpus(params, resident, blocks)
+            emb, scales = quantize_corpus(emb, self.corpus_dtype)
+            jax.block_until_ready(emb)
         n_pad = blocks.size
         valid = np.zeros(n_pad, np.float32)
         valid[:n] = 1.0
@@ -683,6 +692,18 @@ class ServingCorpus:
                           note=note, built_s=time.monotonic(),
                           scales=scales, dtype=self.corpus_dtype)
 
+    def _dispatch_guard(self, *slots):
+        """The process-wide collective-dispatch lock (parallel/mesh) when the
+        device work about to run touches mesh-sharded arrays. The swap path
+        runs on a churn/rollout thread CONCURRENTLY with serving threads
+        dispatching against the active slot; a compiled program over sharded
+        operands is a collective, and two collectives interleaving their
+        per-device rendezvous deadlock (the r16 bug class). Single-device
+        corpora return a free nullcontext."""
+        sharded = self.mesh is not None or any(
+            s is not None and _slot_is_sharded(s) for s in slots)
+        return dispatch_lock(sharded)
+
     def _health_gate(self, slot, tail=False):
         """Finiteness + collapse score on a sample of the standby embeddings
         (DEQUANTIZED — the gate judges what scoring will actually see, so a
@@ -695,14 +716,15 @@ class ServingCorpus:
         are stored on `slot.stats` as the drift reference the next refresh
         batch is compared against (telemetry/health.drift_health)."""
         rows = min(_GATE_SAMPLE, slot.n)
-        if tail:
-            sample = dequantize_rows(
-                slot.emb, slot.scales, slot.n)[slot.n - rows:]
-        else:
-            sample = dequantize_rows(slot.emb, slot.scales, rows)
-        host = np.asarray(jax.device_get(sample), np.float32)
-        finite = bool(np.all(np.isfinite(host)))
-        stats = jax.device_get(embedding_health(sample))
+        with self._dispatch_guard(slot):
+            if tail:
+                sample = dequantize_rows(
+                    slot.emb, slot.scales, slot.n)[slot.n - rows:]
+            else:
+                sample = dequantize_rows(slot.emb, slot.scales, rows)
+            host = np.asarray(jax.device_get(sample), np.float32)
+            finite = bool(np.all(np.isfinite(host)))
+            stats = jax.device_get(embedding_health(sample))
         collapse = float(stats["health/embedding_collapse"])
         ok = finite and np.isfinite(collapse) and (
             collapse <= self.collapse_ceiling)
@@ -744,27 +766,29 @@ class ServingCorpus:
         if n_cells is None:  # sqrt(N): the classic IVF scan-balance point
             n_cells = int(round(max(slot.n, 1) ** 0.5))
         n_cells = max(1, min(int(n_cells), max(slot.n, 1)))
-        x = dequantize_rows(slot.emb, slot.scales, slot.emb.shape[0])
-        if refit or base is None or base.ivf is None:
-            refit = True
-            km = kmeans_fit(x, slot.valid, n_cells, seed=self.index_seed,
-                            n_iters=self.index_iters,
-                            init_centroid=slot.stats.get("centroid"))
-            centroids, assign = km.centroids, km.assign
-        else:
-            centroids = base.ivf.centroids
-            assign = assign_cells(x, centroids)
-        n_shards = self._row_mult
-        if n_shards is None and _slot_is_sharded(slot):
-            n_shards = len(slot.emb.sharding.device_set)
-        if n_shards is not None and n_shards > 1:
-            slot.ivf = build_sharded_cells(
-                slot.emb, slot.valid, slot.scales, centroids, assign,
-                n_shards=n_shards, cap_min=self.cell_cap,
-                device_put=self._device_put)
-        else:
-            slot.ivf = build_cells(slot.emb, slot.valid, slot.scales,
-                                   centroids, assign, cap_min=self.cell_cap)
+        with self._dispatch_guard(slot):
+            x = dequantize_rows(slot.emb, slot.scales, slot.emb.shape[0])
+            if refit or base is None or base.ivf is None:
+                refit = True
+                km = kmeans_fit(x, slot.valid, n_cells, seed=self.index_seed,
+                                n_iters=self.index_iters,
+                                init_centroid=slot.stats.get("centroid"))
+                centroids, assign = km.centroids, km.assign
+            else:
+                centroids = base.ivf.centroids
+                assign = assign_cells(x, centroids)
+            n_shards = self._row_mult
+            if n_shards is None and _slot_is_sharded(slot):
+                n_shards = len(slot.emb.sharding.device_set)
+            if n_shards is not None and n_shards > 1:
+                slot.ivf = build_sharded_cells(
+                    slot.emb, slot.valid, slot.scales, centroids, assign,
+                    n_shards=n_shards, cap_min=self.cell_cap,
+                    device_put=self._device_put)
+            else:
+                slot.ivf = build_cells(slot.emb, slot.valid, slot.scales,
+                                       centroids, assign,
+                                       cap_min=self.cell_cap)
         st = cell_stats(slot.ivf)
         with self._lock:
             if refit:

@@ -50,10 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-try:  # jax >= 0.6 re-homed shard_map; 0.4.x only has the experimental name
-    from jax.experimental.shard_map import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    _shard_map = jax.shard_map
+from ..parallel.mesh import _shard_map
 
 from .. import telemetry
 from ..models import dae_core

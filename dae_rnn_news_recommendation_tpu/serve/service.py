@@ -37,7 +37,6 @@ so dispatches amortize better). Each degraded episode is recorded in
 first-class, never silent.
 """
 
-import contextlib
 import dataclasses
 import queue
 import threading
@@ -49,6 +48,7 @@ import jax
 
 from .. import telemetry
 from ..analysis.runtime import CompileWatcher
+from ..parallel import mesh as _mesh
 from ..reliability import faults as _faults
 from ..reliability.retry import RetryPolicy
 from ..train.pipeline import bucket_sizes
@@ -56,14 +56,16 @@ from .graph import make_serve_fn
 
 _LATENCY_WINDOW = 4096  # replies kept for p50/p95 (bounded, like the queue)
 
-_MESH_LOCK = threading.Lock()
+_MESH_LOCK = _mesh.MESH_DISPATCH_LOCK
 # Process-wide serialization of SHARDED serve dispatches. A shard_map program
 # is a collective: all mesh devices must rendezvous on the SAME program. Two
 # service threads (fleet replicas share this host's one device mesh)
 # dispatching concurrently can interleave their programs' per-device
 # participant arrivals and deadlock the rendezvous — so every sharded
 # serve-fn call in this process takes this lock. Single-device dispatches
-# never touch it.
+# never touch it. The lock itself lives in parallel/mesh.py (r17): the
+# corpus health gate, index refit, bench parity sweeps and the ring AUROC
+# dispatch collectives too, and they all must serialize against US.
 
 
 @dataclasses.dataclass
@@ -672,9 +674,9 @@ class RecommendationService:
 
     def _mesh_guard(self):
         """The collective-dispatch guard: sharded services serialize their
-        device calls through the process-wide `_MESH_LOCK` (see its comment);
-        single-device services pay nothing."""
-        return _MESH_LOCK if self.sharded else contextlib.nullcontext()
+        device calls through the process-wide mesh dispatch lock (see the
+        `_MESH_LOCK` comment); single-device services pay nothing."""
+        return _mesh.dispatch_lock(self.sharded)
 
     def _slot_args(self, slot, fallback=False):
         """Positional slot operands for the compiled serve variants — the

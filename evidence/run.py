@@ -707,15 +707,18 @@ def main(argv=None):
         checks[name] = {"pass": bool(ok), "detail": detail}
         print(f"  [{'PASS' if ok else 'FAIL'}] {name}: {detail}")
 
-    # jaxcheck/threadcheck self-clean as an explicit, exit-code-gated stage
-    # (previously only indirect via tier-1): the evidence record must not be
-    # producible from a tree the repo's own analyzer rejects
+    # jaxcheck/threadcheck/meshcheck self-clean as an explicit, exit-code-
+    # gated stage (previously only indirect via tier-1): the evidence record
+    # must not be producible from a tree the repo's own analyzer rejects.
+    # One invocation selecting all three families pins the full catalog —
+    # adding a family without gating it here is impossible.
     from dae_rnn_news_recommendation_tpu.analysis.__main__ import (
         main as _jaxcheck_main)
-    _jaxcheck_rc = _jaxcheck_main([])
+    _jaxcheck_rc = _jaxcheck_main(["--select", "R,C,S"])
     check("jaxcheck_self_clean", _jaxcheck_rc == 0,
-          f"python -m dae_rnn_news_recommendation_tpu.analysis exit code "
-          f"{_jaxcheck_rc} (0 = zero unsuppressed findings, R1-R14 + C1-C5)")
+          f"python -m dae_rnn_news_recommendation_tpu.analysis --select "
+          f"R,C,S exit code {_jaxcheck_rc} (0 = zero unsuppressed findings, "
+          f"R1-R14 + C1-C5 + S1-S5)")
 
     enc_tr = aurocs["similarity_boxplot_encoded(Category)"]
     enc_vl = aurocs["similarity_boxplot_encoded_validate(Category)"]

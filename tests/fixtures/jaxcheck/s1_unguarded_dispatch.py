@@ -1,0 +1,66 @@
+"""S1 fixture: a shard_map program is a collective — every mesh device must
+rendezvous on the same program — so dispatching one from a thread-reachable
+site without the process-wide mesh dispatch lock can interleave two
+programs' per-device arrivals and deadlock (the r16 bug class). Clean twins
+wrap the dispatch in `with dispatch_lock():`.
+"""
+
+import threading
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dae_rnn_news_recommendation_tpu.parallel.mesh import dispatch_lock
+
+MESH_AXIS_NAMES = ("data",)
+
+
+def make_gather(mesh):
+    """Factory: returns a shard_map-built callable (never dispatches it)."""
+
+    def local(x):
+        return jax.lax.psum(x, "data")
+
+    return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                     out_specs=P("data", None))
+
+
+class ShardedScorer:
+    """Serving replicas share one scorer; a refresh thread swaps state, so
+    its methods run concurrently (it owns a lock -> thread-shared)."""
+
+    def __init__(self, mesh):
+        self._lock = threading.Lock()
+        self._fn = make_gather(mesh)
+
+    def lookup(self, x):
+        return self._fn(x)                    # planted: S1
+
+    def lookup_guarded(self, x):
+        # the sanctioned idiom: serialize collective dispatch process-wide
+        with dispatch_lock():
+            return self._fn(x)
+
+
+def refresh_worker(mesh, x):
+    """Runs on a spawned thread (see start_refresh) — bare dispatch."""
+    fn = shard_map(lambda v: v * 2, mesh=mesh, in_specs=(P("data", None),),
+                   out_specs=P("data", None))
+    return fn(x)                              # planted: S1
+
+
+def refresh_worker_guarded(mesh, x):
+    fn = shard_map(lambda v: v * 2, mesh=mesh, in_specs=(P("data", None),),
+                   out_specs=P("data", None))
+    with dispatch_lock():
+        return fn(x)
+
+
+def start_refresh(mesh, x):
+    t = threading.Thread(target=refresh_worker, args=(mesh, x), daemon=True)
+    t.start()
+    u = threading.Thread(target=refresh_worker_guarded, args=(mesh, x),
+                         daemon=True)
+    u.start()
+    return t, u

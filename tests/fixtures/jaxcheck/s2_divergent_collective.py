@@ -1,0 +1,36 @@
+"""S2 fixture: a collective under a branch predicated on PER-SHARD data —
+shards disagreeing on the predicate skip the rendezvous and the rest hang.
+Clean twin: the predicate is a shard-invariant closure value and the
+collective runs unconditionally.
+"""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+MESH_AXIS_NAMES = ("data",)
+
+
+def make_accumulate(mesh):
+    def local(x):
+        shard_max = x.max()        # concrete per-shard value at trace time
+        if shard_max > 0:
+            total = jax.lax.psum(x, "data")      # planted: S2
+        else:
+            total = x
+        return total
+
+    return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                     out_specs=P("data", None))
+
+
+def make_accumulate_clean(mesh, reduce_it):
+    def local(x):
+        # shard-invariant config predicate, collective unconditional
+        total = jax.lax.psum(x, "data")
+        if reduce_it:
+            return total
+        return x
+
+    return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                     out_specs=P("data", None))

@@ -1,0 +1,41 @@
+"""S3 fixture: axis-name hygiene. A collective naming an axis the enclosing
+shard_map never binds, or a PartitionSpec naming an axis outside the mesh
+vocabulary (MESH_AXIS_NAMES), is a typo XLA only reports at trace time.
+Clean twins: literal axis matching the specs, and the variable-axis idiom
+(axis flows through one parameter into specs and collectives alike).
+"""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+MESH_AXIS_NAMES = ("data", "model")
+
+
+def make_row_sum(mesh):
+    def local(x):
+        return jax.lax.psum(x, "rows")           # planted: S3
+
+    return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                     out_specs=P("data", None))
+
+
+def make_row_sum_clean(mesh):
+    def local(x):
+        return jax.lax.psum(x, "data")
+
+    return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                     out_specs=P("data", None))
+
+
+def make_gather_clean(mesh, axis_name="data"):
+    # variable-axis idiom: the same name threads specs and collectives
+    def local(x):
+        return jax.lax.all_gather(x, axis_name)
+
+    return shard_map(local, mesh=mesh, in_specs=(P(axis_name, None),),
+                     out_specs=P(None, axis_name))
+
+
+def stale_layout():
+    return P("batch", None)                      # planted: S3

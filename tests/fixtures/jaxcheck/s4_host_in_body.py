@@ -1,0 +1,35 @@
+"""S4 fixture: host-side work captured in a shard_map body — device
+transfers, `np.` materialization of traced operands, `.tolist()` — breaks
+tracing or pins a host round-trip into every collective dispatch. Clean
+twin: device-only body; static host `np` arithmetic outside the traced
+operands stays allowed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+MESH_AXIS_NAMES = ("data",)
+
+
+def make_densify(mesh):
+    def local(x):
+        rows = np.asarray(x)                     # planted: S4
+        moved = jax.device_put(rows)             # planted: S4
+        cells = rows.tolist()                    # planted: S4
+        return jnp.asarray(moved) + len(cells)
+
+    return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                     out_specs=P("data", None))
+
+
+def make_densify_clean(mesh):
+    scale = np.float32(1.0 / 8.0)   # static host constant: fine
+
+    def local(x):
+        return x * jnp.asarray(scale)
+
+    return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                     out_specs=P("data", None))

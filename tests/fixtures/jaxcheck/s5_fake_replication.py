@@ -1,0 +1,41 @@
+"""S5 fixture: an out_spec of `P()` promises the output is identical on
+every shard — the runtime reads ONE shard's buffer as the answer. Only a
+reducing collective makes that true; returning a per-shard value through
+`P()` silently serves shard 0's partial result. This is the static twin of
+shard_map's check_rep, which the Pallas paths must disable. Clean twin:
+psum before returning through `P()`.
+"""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+MESH_AXIS_NAMES = ("data",)
+
+
+def make_mean(mesh):
+    def local(x):
+        local_sum = x.sum()         # per-shard partial, never reduced
+        return local_sum                         # planted: S5
+
+    return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                     out_specs=P())
+
+
+def make_mean_clean(mesh):
+    def local(x):
+        total = jax.lax.psum(x.sum(), "data")
+        return total
+
+    return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                     out_specs=P())
+
+
+def make_stats(mesh):
+    def local(x):
+        total = jax.lax.psum(x.sum(), "data")
+        peak = x.max()              # position 1 claims P() but never reduced
+        return total, peak                       # planted: S5
+
+    return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                     out_specs=(P(), P()))

@@ -55,7 +55,8 @@ def test_every_rule_has_a_fixture():
                     planted_markers(os.path.join(FIXTURE_DIR, name))}
     assert {"R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
             "R10", "R11", "R12", "R13", "R14",
-            "C1", "C2", "C3", "C4", "C5"} <= planted
+            "C1", "C2", "C3", "C4", "C5",
+            "S1", "S2", "S3", "S4", "S5"} <= planted
 
 
 @pytest.mark.parametrize("name", fixture_files())

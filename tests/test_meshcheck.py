@@ -1,0 +1,223 @@
+"""meshcheck (S1-S5) wiring into tier-1.
+
+Mirrors test_threadcheck.py for the mesh/SPMD rule family:
+  * seeded    — the s*_ fixtures' planted violations fire and their clean
+                twins stay silent (test_jaxcheck.py's parametrized sweep
+                covers them; here we pin the CROSS-FILE behavior those
+                can't show: a sharded callable built by a factory in one
+                module and dispatched from a thread-spawned method in
+                another);
+  * self-clean — the repo's contract set has zero unsuppressed S findings;
+  * CLI       — family-letter --select ('S', 'R,C,S') ergonomics;
+  * runtime   — the satellite-1 regression: the swap/health-gate device
+                work of a mesh-sharded ServingCorpus and the eval ring
+                dispatch actually serialize through the process-wide
+                parallel/mesh.MESH_DISPATCH_LOCK (the r16 deadlock fix),
+                and a single-device corpus never touches it.
+"""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+
+from dae_rnn_news_recommendation_tpu.analysis import (
+    RULES, analyze_file, analyze_paths, default_targets)
+from dae_rnn_news_recommendation_tpu.analysis.__main__ import main as cli_main
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "jaxcheck")
+S_RULES = {"S1", "S2", "S3", "S4", "S5"}
+
+
+def _write(path, src):
+    path.write_text(textwrap.dedent(src))
+    return str(path)
+
+
+# ---------------------------------------------------------------- registry
+
+def test_s_rules_registered():
+    assert S_RULES <= set(RULES)
+
+
+# -------------------------------------------------- cross-file / call graph
+
+def test_s1_cross_module_factory_dispatch(tmp_path):
+    """The tentpole case per-file analysis cannot see: the sharded callable
+    is BUILT by a factory in builder.py and dispatched from a
+    thread-spawned method in worker.py. The whole-package mesh index closes
+    the factory -> attribute -> dispatch chain, so the bare dispatch fires
+    S1 while the dispatch_lock-guarded twin stays silent."""
+    pkg = tmp_path / "meshpkg"
+    pkg.mkdir()
+    _write(pkg / "__init__.py", "")
+    builder = _write(pkg / "builder.py", """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        MESH_AXIS_NAMES = ("data",)
+
+
+        def make_gather(mesh):
+            def local(x):
+                return jax.lax.psum(x, "data")
+
+            return shard_map(local, mesh=mesh, in_specs=(P("data", None),),
+                             out_specs=P("data", None))
+        """)
+    worker = _write(pkg / "worker.py", """\
+        import threading
+
+        from .builder import make_gather
+
+
+        class Refresher:
+            def __init__(self, mesh):
+                self._fn = make_gather(mesh)
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                return self._fn(0)
+
+            def run_guarded(self, dispatch_lock):
+                with dispatch_lock():
+                    return self._fn(0)
+        """)
+    fb, _ = analyze_file(builder, root=str(tmp_path))
+    fw, _ = analyze_file(worker, root=str(tmp_path))
+    assert fb == []
+    assert [f.rule for f in fw] == ["S1"]
+    assert "self._fn" in fw[0].message and "_run" in fw[0].message
+
+
+# -------------------------------------------------------------- self-clean
+
+def test_repo_is_s_clean():
+    """The acceptance criterion: zero unsuppressed S findings on the
+    package + bench.py + evidence/ (the serving, eval, and bench dispatch
+    sites all route through parallel/mesh.dispatch_lock)."""
+    root, targets = default_targets()
+    findings, suppressed, n_files = analyze_paths(
+        targets, root=root, select=S_RULES)
+    assert n_files > 30
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert all(s.suppress_reason for s in suppressed)
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_cli_family_letter_select(capsys):
+    rc = cli_main(["--json", "--select", "S",
+                   os.path.join(FIXTURE_DIR, "s3_axis_hygiene.py")])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["rule"] for f in report["findings"]} == {"S3"}
+
+
+def test_cli_mixed_families_and_ids(capsys):
+    rc = cli_main(["--json", "--select", "R,C,S1,S3",
+                   os.path.join(FIXTURE_DIR, "s3_axis_hygiene.py")])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["rule"] for f in report["findings"]} == {"S3"}
+
+
+def test_cli_unknown_family_is_usage_error(capsys):
+    assert cli_main(["--select", "Q",
+                     os.path.join(FIXTURE_DIR, "s3_axis_hygiene.py")]) == 2
+    capsys.readouterr()
+    assert cli_main(["--select", "S9",
+                     os.path.join(FIXTURE_DIR, "s3_axis_hygiene.py")]) == 2
+
+
+# ------------------------------------------------- runtime lock regression
+
+class _RecordingLock:
+    """Context-manager proxy standing in for MESH_DISPATCH_LOCK."""
+
+    def __init__(self):
+        self.acquired = 0
+        self.depth = 0
+
+    def __enter__(self):
+        assert self.depth == 0, "mesh dispatch lock acquired re-entrantly"
+        self.depth += 1
+        self.acquired += 1
+        return self
+
+    def __exit__(self, *exc):
+        self.depth -= 1
+        return False
+
+
+@pytest.fixture()
+def recording_lock(monkeypatch):
+    """Swap the process-wide mesh dispatch lock for a counting proxy.
+    dispatch_lock() reads the module global at call time, so every caller
+    that routes through it is observed."""
+    from dae_rnn_news_recommendation_tpu.parallel import mesh as mesh_mod
+
+    proxy = _RecordingLock()
+    monkeypatch.setattr(mesh_mod, "MESH_DISPATCH_LOCK", proxy)
+    return proxy
+
+
+def _small_setup():
+    from dae_rnn_news_recommendation_tpu.models import DAEConfig, init_params
+
+    config = DAEConfig(n_features=24, n_components=8,
+                       triplet_strategy="none", corr_frac=0.0)
+    params = init_params(jax.random.PRNGKey(0), config)
+    articles = np.random.default_rng(0).random((48, 24), dtype=np.float32)
+    return config, params, articles
+
+
+def test_sharded_corpus_swap_takes_dispatch_lock(recording_lock):
+    """A mesh-sharded corpus's swap path (encode + health gate) runs on the
+    churn/rollout thread concurrently with serving threads — its device
+    dispatches must serialize through the process-wide lock (the r16 bug
+    class, satellite 1)."""
+    from dae_rnn_news_recommendation_tpu.parallel.mesh import get_mesh
+    from dae_rnn_news_recommendation_tpu.serve import ServingCorpus
+
+    config, params, articles = _small_setup()
+    corpus = ServingCorpus(config, block=16, mesh=get_mesh())
+    corpus.swap(params, articles, note="initial")
+    assert recording_lock.acquired >= 2  # encode/build + health gate
+    assert recording_lock.depth == 0
+
+
+def test_single_device_corpus_skips_dispatch_lock(recording_lock):
+    """dispatch_lock(sharded=False) is a free nullcontext: a single-device
+    corpus must never contend on the collective-dispatch lock."""
+    from dae_rnn_news_recommendation_tpu.serve import ServingCorpus
+
+    config, params, articles = _small_setup()
+    corpus = ServingCorpus(config, block=16)
+    corpus.swap(params, articles, note="initial")
+    assert recording_lock.acquired == 0
+
+
+def test_ring_auroc_dispatch_takes_dispatch_lock(recording_lock):
+    """The eval ring (ppermute collective) was the named real finding: it
+    used to dispatch shard_map with no guard while serving threads dispatch
+    concurrently. It must now hold the lock exactly once per sweep."""
+    from dae_rnn_news_recommendation_tpu.eval.streaming_auroc import (
+        ring_streaming_auroc, streaming_auroc)
+    from dae_rnn_news_recommendation_tpu.parallel.mesh import get_mesh
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((24, 6)).astype(np.float32)
+    labels = rng.integers(0, 3, size=24)
+    got = ring_streaming_auroc(x, labels, get_mesh(), bins=128)
+    assert recording_lock.acquired == 1
+    assert recording_lock.depth == 0
+    ref = streaming_auroc(x, labels, bins=128)
+    np.testing.assert_allclose(got, ref, rtol=0, atol=0)

@@ -12,6 +12,7 @@ from jax.sharding import PartitionSpec as P
 from dae_rnn_news_recommendation_tpu.ops.triplet import (
     batch_all_triplet_loss, batch_hard_triplet_loss)
 from dae_rnn_news_recommendation_tpu.parallel import get_mesh
+from dae_rnn_news_recommendation_tpu.parallel.mesh import _shard_map
 from dae_rnn_news_recommendation_tpu.parallel.mining import (
     sharded_batch_all_triplet_loss, sharded_batch_hard_triplet_loss)
 
@@ -39,7 +40,7 @@ def _run_sharded(fn, labels, enc, valid, **kw):
                                          row_valid=valid_g, **kw)
         return loss, dw, frac, num, extras
 
-    return jax.shard_map(
+    return _shard_map(
         local, mesh=mesh, in_specs=(P("x"), P(), P()),
         out_specs=(P(), P("x"), P(), P(), P()),
     )(enc, labels, valid)
@@ -92,7 +93,7 @@ def test_sharded_mining_differentiable():
             return sharded_batch_all_triplet_loss(
                 labels, enc_local, enc_g, "x", row_valid=valid)[0]
 
-        return jax.shard_map(local, mesh=mesh, in_specs=P("x"),
+        return _shard_map(local, mesh=mesh, in_specs=P("x"),
                              out_specs=P())(e)
 
     g_o = jax.grad(oracle_loss)(enc)

@@ -34,9 +34,13 @@ def test_user_model_pipeline_end_to_end(workdir):
     from dae_rnn_news_recommendation_tpu.cli.main_user_model import main
 
     gru, metrics = main([
-        "--model_name", "t", "--n_articles", "500", "--max_features", "400",
-        "--n_components", "32", "--dae_epochs", "2", "--n_users", "100",
-        "--seq_len", "8", "--gru_epochs", "15", "--seq_devices", "4",
+        # max_features must cover the category vocabulary: the synthetic
+        # corpus spreads its 8 category slices across a 3000-word Zipf vocab,
+        # so a top-400 document-frequency cut keeps mostly base words and the
+        # ranking task degenerates to chance
+        "--model_name", "t", "--n_articles", "500", "--max_features", "2000",
+        "--n_components", "32", "--dae_epochs", "2", "--n_users", "200",
+        "--seq_len", "8", "--gru_epochs", "25", "--seq_devices", "4",
         "--seed", "0",
     ])
     # ranking the clicked article above the non-clicked one must beat chance
