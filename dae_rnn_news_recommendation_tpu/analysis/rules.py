@@ -281,7 +281,10 @@ def _r2_scope(relpath):
 
     base = os.path.basename(relpath)
     parts = relpath.replace("\\", "/").split("/")
-    return base.startswith("bench") or "evidence" in parts
+    # devprof: the device timer itself lives by the same fencing law it
+    # enforces on bench/evidence code
+    return base.startswith("bench") or "evidence" in parts \
+        or "devprof" in base
 
 
 @rule("R2", "timed region without a fetch fence", scope=_r2_scope)
@@ -323,6 +326,10 @@ def _is_fence_call(node, fence_fns=()):
         return True
     if name and name.split(".")[-1] in ("device_get", "block_until_ready",
                                         "device_fence"):
+        return True
+    # devprof.measure is a fence: every timed iteration ends with a
+    # device_fence on the call's result (telemetry/devprof.py)
+    if name and name.split(".")[-1] == "measure" and "devprof" in name:
         return True
     if _is_fenced_span_call(node):
         return True

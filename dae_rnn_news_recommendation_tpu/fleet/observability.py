@@ -76,12 +76,25 @@ def fleet_registries(router=None, replicas=(), supervisor=None):
 
 
 def fleet_observability_bundle(router=None, replicas=(), supervisor=None,
-                               monitor=None, ledger=None, extra=None):
+                               monitor=None, ledger=None, extra=None,
+                               memory=True):
     """Join the fleet's observability surfaces into one serializable dict —
     the `report --fleet` input. Every section is optional and None-safe:
-    whatever the run actually wired shows up, nothing crashes on absence."""
+    whatever the run actually wired shows up, nothing crashes on absence.
+
+    `memory=True` additionally samples per-device `memory_stats()` HBM
+    gauges (devprof.sample_memory) into the FIRST fleet registry before it
+    is snapshotted — the device-memory-growth SLO's data source — and
+    carries the raw snapshot under `"memory"`. Where the backend exports no
+    memory stats (CPU) the section is `{}` and no gauges appear, so the
+    growth spec stays silent by absence."""
     regs = fleet_registries(router=router, replicas=replicas,
                             supervisor=supervisor)
+    mem_snap = {}
+    if memory:
+        from ..telemetry import devprof
+
+        mem_snap = devprof.sample_memory(regs[0] if regs else None)
     snaps = [m.snapshot() for m in regs]
     bundle = {
         "requests": (list(router.records) if router is not None else []),
@@ -94,6 +107,7 @@ def fleet_observability_bundle(router=None, replicas=(), supervisor=None,
                     "counts": ledger.counts(),
                     "problems": list(ledger.audit())}
                    if ledger is not None else None),
+        "memory": mem_snap,
     }
     if extra:
         bundle.update(extra)

@@ -220,20 +220,24 @@ def ivf_topk(queries, emb, valid, k, *, cells, probes, scales=None,
                           interpret=interpret)
     h = queries.astype(jnp.float32)
     cent_valid = jnp.ones((n_cells,), jnp.float32)
-    _, cell_ids = topk_fused(h, cells.centroids, cent_valid, probes,
-                             impl=impl, interpret=interpret)
+    with jax.named_scope(f"ops/ivf_centroid_scan_p{probes}"):
+        _, cell_ids = topk_fused(h, cells.centroids, cent_valid, probes,
+                                 impl=impl, interpret=interpret)
     if impl == "jnp":
-        return _ivf_reference(h, emb, valid, scales, cells.assign, cell_ids,
-                              k, n_cells)
+        with jax.named_scope(f"ops/ivf_rescore_jnp_k{k}"):
+            return _ivf_reference(h, emb, valid, scales, cells.assign,
+                                  cell_ids, k, n_cells)
     if interpret is None:
         interpret = not _on_tpu()
     if bq is None:
         bq = DEFAULT_BQ
     cell_scales = (cells.cell_scales if scales is not None else
                    jnp.ones((cells.row_ids.shape[0],), jnp.float32))
-    return _ivf_pallas(h, cell_ids, cells.cell_emb, cells.cell_valid,
-                       cell_scales, cells.row_ids, k=k, cap=cap, bq=bq,
-                       interpret=interpret)
+    # trace-time label only (host-side wrapper — never inside the kernel)
+    with jax.named_scope(f"ops/ivf_rescore_k{k}"):
+        return _ivf_pallas(h, cell_ids, cells.cell_emb, cells.cell_valid,
+                           cell_scales, cells.row_ids, k=k, cap=cap, bq=bq,
+                           interpret=interpret)
 
 
 def _ivf_local_reference(queries, cell_emb, cell_valid, cell_scales,
@@ -309,8 +313,9 @@ def sharded_ivf_topk(queries, emb, valid, k, *, cells, probes, mesh,
                             interpret=interpret)
     h = queries.astype(jnp.float32)
     cent_valid = jnp.ones((n_cells,), jnp.float32)
-    _, cell_ids = topk_fused(h, cells.centroids, cent_valid, probes,
-                             impl=impl, interpret=interpret)
+    with jax.named_scope(f"ops/ivf_centroid_scan_p{probes}"):
+        _, cell_ids = topk_fused(h, cells.centroids, cent_valid, probes,
+                                 impl=impl, interpret=interpret)
     if interpret is None:
         interpret = not _on_tpu()
     if bq is None:
@@ -337,8 +342,9 @@ def sharded_ivf_topk(queries, emb, valid, k, *, cells, probes, mesh,
         check_rep=False)(  # pallas_call has no replication rule
             cells.cell_emb, cells.cell_valid, cell_scales, cells.row_ids,
             h, cell_ids)
-    order = jnp.argsort(i_cat, axis=1)          # ascending global id
-    s_srt = jnp.take_along_axis(s_cat, order, axis=1)
-    i_srt = jnp.take_along_axis(i_cat, order, axis=1)
-    s_top, pos = jax.lax.top_k(s_srt, k)
-    return s_top, jnp.take_along_axis(i_srt, pos, axis=1)
+    with jax.named_scope(f"ops/ivf_sharded_merge_k{k}"):
+        order = jnp.argsort(i_cat, axis=1)      # ascending global id
+        s_srt = jnp.take_along_axis(s_cat, order, axis=1)
+        i_srt = jnp.take_along_axis(i_cat, order, axis=1)
+        s_top, pos = jax.lax.top_k(s_srt, k)
+        return s_top, jnp.take_along_axis(i_srt, pos, axis=1)

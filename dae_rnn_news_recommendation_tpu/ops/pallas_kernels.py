@@ -376,8 +376,10 @@ def batch_all_triplet_loss_pallas(labels, encode, pos_triplets_only=False,
     """
     if interpret is None:
         interpret = not _on_tpu()
-    return _batch_all_loss_vjp(labels, encode, bool(pos_triplets_only),
-                               row_valid, tuple(tiles), bool(interpret))
+    # trace-time label only (host-side wrapper — never inside the kernel)
+    with jax.named_scope("ops/batch_all_pallas"):
+        return _batch_all_loss_vjp(labels, encode, bool(pos_triplets_only),
+                                   row_valid, tuple(tiles), bool(interpret))
 
 
 # --------------------------------------------------------------------- batch_hard
@@ -564,8 +566,10 @@ def batch_hard_triplet_loss_pallas(labels, encode, row_valid=None,
     """
     if interpret is None:
         interpret = not _on_tpu()
-    return _batch_hard_loss_vjp(labels, encode, row_valid, int(block_rows),
-                                bool(interpret))
+    # trace-time label only (host-side wrapper — never inside the kernel)
+    with jax.named_scope("ops/batch_hard_pallas"):
+        return _batch_hard_loss_vjp(labels, encode, row_valid,
+                                    int(block_rows), bool(interpret))
 
 
 # ------------------------------------------------------------------ masking noise
@@ -637,5 +641,8 @@ def masking_noise_pallas(seed, x, v, block_rows=256, interpret=None):
     bp = int(-(-b // block_rows) * block_rows)
     xp = jnp.pad(x, ((0, bp - b), (0, 0))) if bp != b else x
     seed = jnp.asarray(seed, jnp.int32).reshape(1)
-    out = _masking_pallas(seed, xp, float(v), int(block_rows), bool(interpret))
+    # trace-time label only (host-side wrapper — never inside the kernel)
+    with jax.named_scope("ops/masking_noise_pallas"):
+        out = _masking_pallas(seed, xp, float(v), int(block_rows),
+                              bool(interpret))
     return out[:b]

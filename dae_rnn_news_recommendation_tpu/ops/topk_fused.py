@@ -184,7 +184,8 @@ def topk_fused(queries, emb, valid, k, *, scales=None, block=DEFAULT_PANEL,
     if impl == "pallas" and (k > _ACC_LANES or k > block):
         impl = "jnp"   # the accumulator holds k lanes; huge k is top_k's game
     if impl == "jnp":
-        return _topk_reference(queries, emb, valid, k, scales)
+        with jax.named_scope(f"ops/topk_fused_jnp_k{k}"):
+            return _topk_reference(queries, emb, valid, k, scales)
     if block % 128 != 0:
         raise ValueError(f"block={block} must be a multiple of 128")
     if interpret is None:
@@ -193,8 +194,11 @@ def topk_fused(queries, emb, valid, k, *, scales=None, block=DEFAULT_PANEL,
         bq = min(256, -(-queries.shape[0] // 8) * 8)
     if scales is None:
         scales = jnp.ones((n,), jnp.float32)
-    return _topk_pallas(queries, emb, valid, scales, k=k, block=block, bq=bq,
-                        interpret=interpret)
+    # trace-time label only (host-side wrapper — never inside the kernel
+    # body): trace spans attribute the pallas_call to this op by name
+    with jax.named_scope(f"ops/topk_fused_k{k}"):
+        return _topk_pallas(queries, emb, valid, scales, k=k, block=block,
+                            bq=bq, interpret=interpret)
 
 
 def topk_sharded(queries, emb, valid, k, *, mesh, axis_name="data",
@@ -233,5 +237,6 @@ def topk_sharded(queries, emb, valid, k, *, mesh, axis_name="data",
         out_specs=(P(None, axis_name), P(None, axis_name)),
         check_rep=False)(  # pallas_call has no replication rule
             emb, valid, scales, queries)
-    s_top, pos = jax.lax.top_k(s_cat, k)         # [B, n_dev*k] -> [B, k]
-    return s_top, jnp.take_along_axis(i_cat, pos, axis=1)
+    with jax.named_scope(f"ops/topk_sharded_merge_k{k}"):
+        s_top, pos = jax.lax.top_k(s_cat, k)     # [B, n_dev*k] -> [B, k]
+        return s_top, jnp.take_along_axis(i_cat, pos, axis=1)

@@ -148,13 +148,16 @@ def _make_global_step(config, optimizer, mesh, loss_fn, data_axis, model_axis,
                       donate, weight_update_sharding=False, health=True,
                       accum_steps=1):
     def step(params, opt_state, key, batch):
-        cost, metrics, grads = grads_and_metrics(loss_fn, config, params,
-                                                 batch, key, accum_steps)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        if health:
-            metrics = {**metrics,
-                       **sentinel_metrics(cost, grads, updates, params)}
-        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        with jax.named_scope("dp/grads"):
+            cost, metrics, grads = grads_and_metrics(loss_fn, config, params,
+                                                     batch, key, accum_steps)
+        with jax.named_scope("dp/update"):
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            if health:
+                metrics = {**metrics,
+                           **sentinel_metrics(cost, grads, updates, params)}
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                            updates)
         return params, opt_state, metrics
 
     p_sh = param_shardings(mesh, model_axis)
@@ -209,14 +212,18 @@ def _make_shard_step(config, optimizer, mesh, loss_fn, data_axis, donate,
             )(p, batch, keys)
             return cost, metrics
 
-        (cost, metrics), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        if health:
-            # outside shard_map: grads are already pmean'd, so these are
-            # global-norm flags — identical semantics to the 'global' scope
-            metrics = {**metrics,
-                       **sentinel_metrics(cost, grads, updates, params)}
-        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        with jax.named_scope("dp/grads_sharded"):
+            (cost, metrics), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+        with jax.named_scope("dp/update"):
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            if health:
+                # outside shard_map: grads are already pmean'd, so these are
+                # global-norm flags — identical semantics to the 'global' scope
+                metrics = {**metrics,
+                           **sentinel_metrics(cost, grads, updates, params)}
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                            updates)
         return params, opt_state, metrics
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -100,8 +100,10 @@ def make_corpus_encode_fn(config):
 
     def run(params, resident, idx_blocks):
         def body(carry, idx):
-            x = _gather_rows(resident, idx, config)
-            return carry, l2_normalize(dae_core.encode(params, x, config))
+            with jax.named_scope("corpus/gather"):
+                x = _gather_rows(resident, idx, config)
+            with jax.named_scope("corpus/encode"):
+                return carry, l2_normalize(dae_core.encode(params, x, config))
 
         _, emb = jax.lax.scan(body, None, idx_blocks)
         return emb.reshape(-1, emb.shape[-1])
@@ -118,7 +120,8 @@ def make_serve_fn(config, k, *, fused=True):
     assert k >= 1
 
     def run(params, emb, valid, scales, queries):
-        h = l2_normalize(dae_core.encode(params, queries, config))
+        with jax.named_scope("serve/query_encode"):
+            h = l2_normalize(dae_core.encode(params, queries, config))
         if fused:
             # trace-time import: pallas loads only when a fused graph is built
             # (same lazy discipline as ops/__init__'s _PALLAS_EXPORTS)
@@ -127,11 +130,13 @@ def make_serve_fn(config, k, *, fused=True):
             return topk_fused(h, emb, valid, k, scales=scales)
         # the r07 materializing path, kept compiled as the bench baseline:
         # [B, N] scores in HBM, then a full-width top_k over them
-        scores = h @ emb.astype(jnp.float32).T
-        if scales is not None:
-            scores = scores * scales[None, :]
-        scores = jnp.where(valid[None, :] > 0, scores, -jnp.inf)
-        return jax.lax.top_k(scores, k)
+        with jax.named_scope("serve/score_materialized"):
+            scores = h @ emb.astype(jnp.float32).T
+            if scales is not None:
+                scores = scores * scales[None, :]
+            scores = jnp.where(valid[None, :] > 0, scores, -jnp.inf)
+        with jax.named_scope("serve/topk_full"):
+            return jax.lax.top_k(scores, k)
 
     name = f"serve/topk{k}" + ("" if fused else "_unfused")
     return telemetry.instrument(jax.jit(run), name)
@@ -154,7 +159,8 @@ def make_ivf_serve_fn(config, k, probes):
     assert k >= 1 and probes >= 1
 
     def run(params, emb, valid, scales, cells, queries):
-        h = l2_normalize(dae_core.encode(params, queries, config))
+        with jax.named_scope("serve/query_encode"):
+            h = l2_normalize(dae_core.encode(params, queries, config))
         # trace-time import: pallas loads only when a fused graph is built
         from ..ops.ivf_topk import ivf_topk
 
@@ -179,7 +185,8 @@ def make_sharded_serve_fn(config, k, mesh, axis_name="data"):
     assert k >= 1
 
     def run(params, emb, valid, scales, queries):
-        h = l2_normalize(dae_core.encode(params, queries, config))
+        with jax.named_scope("serve/query_encode"):
+            h = l2_normalize(dae_core.encode(params, queries, config))
         # trace-time import: pallas loads only when a fused graph is built
         from ..ops.topk_fused import topk_sharded
 
@@ -205,7 +212,8 @@ def make_sharded_ivf_serve_fn(config, k, probes, mesh, axis_name="data"):
     assert k >= 1 and probes >= 1
 
     def run(params, emb, valid, scales, cells, queries):
-        h = l2_normalize(dae_core.encode(params, queries, config))
+        with jax.named_scope("serve/query_encode"):
+            h = l2_normalize(dae_core.encode(params, queries, config))
         # trace-time import: pallas loads only when a fused graph is built
         from ..ops.ivf_topk import sharded_ivf_topk
 

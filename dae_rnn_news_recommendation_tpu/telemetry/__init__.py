@@ -19,12 +19,14 @@ invariant, built in. `telemetry.span(..., fence=False)` marks host-only
 regions; jaxcheck R6 flags device work inside them.
 """
 
+from . import devprof
 from .health import (drift_health, embedding_health, mining_health,
                      sentinel_metrics)
 from .manifest import build_manifest, read_manifest, write_manifest
 from .metrics_registry import (DEFAULT_LATENCY_BOUNDS_MS, Counter, Gauge,
                                Histogram, MetricsRegistry, aggregate,
                                histogram_percentile)
+from .profile_db import ProfileDB, row_key
 from .recorder import FlightRecorder, summarize_batch
 from .slo import SLOMonitor, SLOSpec, serving_slo_specs
 from .tracer import (Tracer, counters, current_tracer, device_fence, disable,
@@ -38,6 +40,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "ProfileDB",
     "SLOMonitor",
     "SLOSpec",
     "Tracer",
@@ -47,6 +50,7 @@ __all__ = [
     "counters",
     "current_tracer",
     "device_fence",
+    "devprof",
     "disable",
     "drift_health",
     "embedding_health",
@@ -57,6 +61,7 @@ __all__ = [
     "mining_health",
     "read_manifest",
     "record_transfer",
+    "row_key",
     "sentinel_metrics",
     "serving_slo_specs",
     "span",

@@ -1,7 +1,8 @@
 """CLI: `python -m dae_rnn_news_recommendation_tpu.telemetry report ...`
 
     report <trace.json> [--metrics PATH] [--bench PATH] [--health PATH]
-                        [--churn PATH] [--fleet [PATH]] [--json]
+                        [--churn PATH] [--fleet [PATH]] [--profile [PATH]]
+                        [--json]
 
 Prints the per-span p50/p95/total table (with feed-stall and compile-count
 columns) from a trace exported by a traced fit; optionally joins metrics.jsonl
@@ -43,6 +44,10 @@ def main(argv=None):
                      help="fleet_observability.json dumped by "
                           "dump_fleet_observability; bare --fleet (or no "
                           "flag) auto-detects next to the trace")
+    rep.add_argument("--profile", nargs="?", const="auto", default=None,
+                     help="profile_db.json written by devprof/ProfileDB; "
+                          "bare --profile (or no flag) auto-detects next "
+                          "to the trace")
     rep.add_argument("--json", action="store_true",
                      help="emit the report as JSON instead of a table")
     args = parser.parse_args(argv)
@@ -51,7 +56,7 @@ def main(argv=None):
         text, code = report(args.trace, metrics_path=args.metrics,
                             bench_path=args.bench, health_path=args.health,
                             churn_path=args.churn, fleet_path=args.fleet,
-                            as_json=args.json)
+                            profile_path=args.profile, as_json=args.json)
     except (OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2

@@ -1,0 +1,405 @@
+"""Device-time profiling: fenced best-of-N timing, cost/roofline join, HBM.
+
+Where device time goes, measured honestly. Three instruments, all built on
+the repo's existing fencing and compile-accounting discipline:
+
+  * ``measure(fn, args, n=, warmup=)`` — the fenced best-of-N device timer.
+    Every timed iteration ends with a real host round trip
+    (``telemetry.device_fence``: the bench.py ``_hard_sync`` lesson — under
+    async dispatch even ``block_until_ready`` has been observed lying on the
+    tunneled TPU, so only a fetch fences), and every iteration runs under its
+    own ``CompileWatcher`` so an XLA compile inside a timed iteration marks
+    that sample as polluted and excludes it from best/median. Warmup absorbs
+    the expected compiles; the counts travel with the result as provenance.
+
+  * ``cost_analysis(fn, args)`` + ``roofline(...)`` — the static FLOPs /
+    bytes-accessed numbers XLA already knows
+    (``fn.lower(...).compile().cost_analysis()``), joined against the
+    per-``device_kind`` peak table into MFU and roofline fractions. The peak
+    table lives HERE (bench.py delegates) so the two can never disagree.
+    CPU caveat: there is no peak entry for host CPUs, so roofline fields are
+    None off-TPU — the ms/FLOPs/bytes columns still record.
+
+  * ``sample_memory(registry)`` / ``phase(name, registry)`` — per-device HBM
+    gauges (``device.memory_stats()``) into the existing metrics registry,
+    plus a per-phase high-water mark sampled at phase exit. Degrades to a
+    no-op where the backend exposes no memory stats (CPU).
+
+Results persist to a ``ProfileDB`` (profile_db.py): atomic JSON keyed by
+``(op, shape, dtype, device_kind)`` — the cache the ROADMAP item-4 kernel
+autotuner reads, and what ``telemetry report --profile`` renders.
+
+Overhead contract: nothing here touches a hot path unless explicitly called.
+``instrument(fn, op)`` exists for always-on wiring and costs one ``if`` per
+call while profiling is disabled — no clock reads, no fences, no host syncs,
+no extra compiles (the wrapper is transparent to jit caching). The
+``profile_overhead_lt_1pct`` evidence gate and the fetch-count regression
+test pin that contract.
+"""
+
+import contextlib
+import dataclasses
+import statistics
+import threading
+import time
+
+from ..analysis.runtime import CompileWatcher
+from .profile_db import ProfileDB  # noqa: F401  (re-exported convenience)
+from .tracer import device_fence
+
+# per-chip peak (bf16 TFLOP/s, HBM GB/s) by device_kind substring, most
+# specific first (public spec-sheet numbers; device_kind strings look like
+# "TPU v5 lite"). Single source of truth — bench.py delegates here.
+PEAK = (
+    ("v5p", (459.0, 2765.0)),
+    ("v5 lite", (197.0, 819.0)),
+    ("v5e", (197.0, 819.0)),
+    ("v6", (918.0, 1640.0)),
+    ("v4", (275.0, 1228.0)),
+    ("v3", (123.0, 900.0)),
+    ("v2", (45.0, 700.0)),
+)
+
+
+def peak_for(device_kind):
+    """(peak bf16 TFLOP/s, peak HBM GB/s) for a device_kind string, or None
+    when the kind is unknown (host CPUs: no roofline denominator exists)."""
+    dk = (device_kind or "").lower()
+    for sub, spec in PEAK:
+        if sub in dk:
+            return spec
+    return None
+
+
+# ------------------------------------------------------------------ results
+
+@dataclasses.dataclass
+class MeasureResult:
+    """One fenced measurement with its provenance and cost join."""
+
+    op: str
+    shape: str
+    dtype: str
+    device_kind: str
+    best_ms: float
+    median_ms: float
+    n: int                    # timed iterations requested
+    n_clean: int              # iterations that saw zero compiles (the stats)
+    warmup: int
+    compiles_warmup: int
+    compiles_timed: int
+    times_ms: tuple = ()
+    flops: float = None
+    bytes_accessed: float = None
+    mfu: float = None         # achieved / peak compute (None off-TPU)
+    bw_fraction: float = None  # achieved / peak HBM bandwidth
+    roofline_fraction: float = None  # fraction of the BINDING roof
+    bound: str = None         # "compute" | "memory" | None
+
+    def as_row(self):
+        """The ProfileDB row form: key fields inline + rounded figures."""
+        row = dataclasses.asdict(self)
+        row["times_ms"] = [round(t, 6) for t in self.times_ms]
+        for k in ("best_ms", "median_ms"):
+            row[k] = round(row[k], 6)
+        for k in ("mfu", "bw_fraction", "roofline_fraction"):
+            if row[k] is not None:
+                row[k] = round(row[k], 6)
+        return row
+
+
+# ------------------------------------------------------------- cost account
+
+def cost_analysis(fn, args=()):
+    """XLA's static cost model for one jitted call: {"flops", "bytes_accessed"}
+    (whichever keys the backend reports; {} when unavailable). Works on
+    jax.jit-wrapped callables; a bare callable is jitted for the analysis
+    (the analysis compile is NOT the caller's executable — run this outside
+    timed regions). Never raises: cost accounting is advisory."""
+    try:
+        import jax
+
+        lowerable = fn if hasattr(fn, "lower") else jax.jit(fn)
+        compiled = lowerable.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # some jax versions: one per device
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            return {}
+        out = {}
+        if isinstance(ca.get("flops"), (int, float)):
+            out["flops"] = float(ca["flops"])
+        ba = ca.get("bytes accessed", ca.get("bytes_accessed"))
+        if isinstance(ba, (int, float)):
+            out["bytes_accessed"] = float(ba)
+        return out
+    except Exception:
+        return {}
+
+
+def roofline(flops, bytes_accessed, seconds, device_kind):
+    """Join a measured time against the peak table: MFU, bandwidth fraction,
+    and the fraction of the BINDING roof (max of the two — how close the
+    kernel runs to the resource that limits it). All None when the
+    device_kind has no peak entry (the CPU caveat) or the time is unusable."""
+    spec = peak_for(device_kind)
+    if spec is None or not seconds or seconds <= 0:
+        return {}
+    peak_tflops, peak_gbs = spec
+    out = {}
+    fracs = []
+    if isinstance(flops, (int, float)) and flops > 0:
+        out["mfu"] = (flops / seconds) / (peak_tflops * 1e12)
+        fracs.append(("compute", out["mfu"]))
+    if isinstance(bytes_accessed, (int, float)) and bytes_accessed > 0:
+        out["bw_fraction"] = (bytes_accessed / seconds) / (peak_gbs * 1e9)
+        fracs.append(("memory", out["bw_fraction"]))
+    if fracs:
+        bound, frac = max(fracs, key=lambda bf: bf[1])
+        out["roofline_fraction"] = frac
+        out["bound"] = bound
+    return out
+
+
+def _args_signature(args):
+    """(shape, dtype) of the largest array leaf in args — the honest default
+    key coordinates when the caller doesn't name them explicitly."""
+    try:
+        import jax
+
+        leaves = [leaf for leaf in jax.tree_util.tree_leaves(args)
+                  if hasattr(leaf, "shape") and hasattr(leaf, "dtype")]
+        if not leaves:
+            return "scalar", "none"
+        big = max(leaves, key=lambda a: int(getattr(a, "size", 0) or 0))
+        shape = "x".join(str(int(d)) for d in big.shape) or "0d"
+        return shape, str(big.dtype)
+    except Exception:
+        return "unknown", "unknown"
+
+
+def _device_kind():
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
+
+
+# ------------------------------------------------------------------ measure
+
+def measure(fn, args=(), *, n=5, warmup=1, op=None, shape=None, dtype=None,
+            device_kind=None, db=None, cost=True):
+    """Fenced best-of-N device timing of ``fn(*args)``.
+
+    Each warmup call and each timed iteration ends with a real host fetch
+    (``device_fence`` on the call's result), and each runs under its own
+    ``CompileWatcher``: warmup absorbs the expected XLA compiles, and any
+    compile landing inside a timed iteration excludes that sample from the
+    best/median statistics (the counts stay in the result as provenance —
+    ``n_clean`` says how many samples the stats actually rest on). When every
+    timed iteration compiled, the stats fall back to all samples rather than
+    returning nothing: a caller measuring an uncacheable path still gets a
+    number, flagged by ``n_clean == 0``.
+
+    ``db`` (a ProfileDB) records-and-saves the result. ``cost=True`` joins
+    XLA's static FLOPs/bytes and the peak-table roofline fractions (None off
+    TPU — the CPU caveat)."""
+    assert n >= 1, "measure() needs at least one timed iteration"
+    op = op or getattr(fn, "__name__", "fn")
+    sig_shape, sig_dtype = _args_signature(args)
+    shape = shape if shape is not None else sig_shape
+    dtype = dtype if dtype is not None else sig_dtype
+    device_kind = device_kind or _device_kind()
+
+    wwatch = CompileWatcher().start()
+    try:
+        for _ in range(warmup):
+            device_fence(fn(*args))
+    finally:
+        compiles_warmup = wwatch.stop()
+
+    times, dirty = [], 0
+    for _ in range(n):
+        iwatch = CompileWatcher().start()
+        t0 = time.perf_counter()
+        out = fn(*args)
+        device_fence(out)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        compiled = iwatch.stop() > 0
+        times.append((dt_ms, compiled))
+        dirty += int(compiled)
+
+    clean = [t for t, compiled in times if not compiled]
+    stats_over = clean or [t for t, _ in times]
+    best_ms = min(stats_over)
+    median_ms = float(statistics.median(stats_over))
+
+    result = MeasureResult(
+        op=op, shape=shape, dtype=dtype, device_kind=device_kind,
+        best_ms=best_ms, median_ms=median_ms, n=n, n_clean=len(clean),
+        warmup=warmup, compiles_warmup=compiles_warmup, compiles_timed=dirty,
+        times_ms=tuple(t for t, _ in times))
+    if cost:
+        ca = cost_analysis(fn, args)
+        result.flops = ca.get("flops")
+        result.bytes_accessed = ca.get("bytes_accessed")
+        roof = roofline(result.flops, result.bytes_accessed,
+                        best_ms / 1e3, device_kind)
+        result.mfu = roof.get("mfu")
+        result.bw_fraction = roof.get("bw_fraction")
+        result.roofline_fraction = roof.get("roofline_fraction")
+        result.bound = roof.get("bound")
+    if db is not None:
+        db.record(result)
+        db.save()
+    return result
+
+
+# -------------------------------------------------------------- HBM gauges
+
+# memory_stats keys worth exporting, canonical name -> gauge suffix
+_MEMORY_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def memory_snapshot(devices=None):
+    """Per-device ``memory_stats()`` as {label: {key: bytes}}. Empty where
+    the backend exposes nothing (CPU) — callers degrade by absence."""
+    out = {}
+    try:
+        import jax
+
+        devices = jax.local_devices() if devices is None else devices
+    except Exception:
+        return out
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except (RuntimeError, NotImplementedError, AttributeError):
+            ms = None  # backend exposes no allocator stats
+        if not ms:
+            continue
+        stats = {k: int(ms[k]) for k in _MEMORY_KEYS
+                 if isinstance(ms.get(k), (int, float))}
+        if stats:
+            out[f"{d.platform}:{d.id}"] = stats
+    return out
+
+
+def sample_memory(registry=None, devices=None):
+    """Sample HBM gauges into a MetricsRegistry (per-device plus the
+    fleet-aggregatable worst-device rollups ``hbm_bytes_in_use`` /
+    ``hbm_peak_bytes_in_use``). Returns the raw snapshot; {} on CPU (no
+    gauges are created, so the memory-growth SLO stays silent by absence)."""
+    snap = memory_snapshot(devices)
+    if registry is not None and snap:
+        for label, stats in snap.items():
+            for key, val in stats.items():
+                registry.gauge(f"hbm_{key}/{label}").set(float(val))
+        registry.gauge("hbm_bytes_in_use").set(float(
+            max(s.get("bytes_in_use", 0) for s in snap.values())))
+        registry.gauge("hbm_peak_bytes_in_use").set(float(
+            max(s.get("peak_bytes_in_use", 0) for s in snap.values())))
+    return snap
+
+
+@contextlib.contextmanager
+def phase(name, registry=None):
+    """Per-phase HBM high-water mark: on exit, the max ``peak_bytes_in_use``
+    across devices lands in gauge ``hbm_phase_peak_bytes/<name>`` (plus a
+    fresh ``sample_memory`` rollup). A no-op where memory_stats is absent."""
+    try:
+        yield
+    finally:
+        snap = sample_memory(registry)
+        if registry is not None and snap:
+            registry.gauge(f"hbm_phase_peak_bytes/{name}").set(float(
+                max(s.get("peak_bytes_in_use", 0) for s in snap.values())))
+
+
+# ----------------------------------------------- always-on instrumentation
+
+_enabled = False  # read on every instrumented call: keep it a plain bool
+_lock = threading.Lock()
+_accum = {}       # op -> {"count", "times_ms" (bounded ring)}
+_RING = 64
+
+
+def enabled():
+    return _enabled
+
+
+def enable():
+    """Arm the instrumented-call accumulator. Profiling is a diagnosis mode:
+    enabled calls fence (that is what makes the numbers honest), so enable it
+    to ask where device time goes, not while benchmarking peak throughput."""
+    global _enabled
+    with _lock:
+        _accum.clear()
+        _enabled = True
+
+
+def disable():
+    """Disarm and return {op: MeasureResult-shaped row} for everything the
+    instrumented calls accumulated while enabled."""
+    global _enabled
+    with _lock:
+        _enabled = False
+        rows = {op: dict(rec) for op, rec in _accum.items()}
+        _accum.clear()
+    return rows
+
+
+def collect(device_kind=None, db=None):
+    """The accumulator as ProfileDB-recordable rows (without disarming).
+    ``db`` records-and-saves them."""
+    device_kind = device_kind or _device_kind()
+    with _lock:
+        items = [(op, dict(rec)) for op, rec in _accum.items()]
+    rows = []
+    for op, rec in items:
+        times = rec["times_ms"]
+        rows.append({
+            "op": op, "shape": rec["shape"], "dtype": rec["dtype"],
+            "device_kind": device_kind, "n": rec["count"],
+            "n_clean": len(times), "warmup": 0,
+            "compiles_warmup": 0, "compiles_timed": 0,
+            "best_ms": round(min(times), 6),
+            "median_ms": round(float(statistics.median(times)), 6),
+            "times_ms": [round(t, 6) for t in times],
+        })
+    if db is not None:
+        for row in rows:
+            db.record(row)
+        if rows:
+            db.save()
+    return rows
+
+
+def instrument(fn, op):
+    """Wrap ``fn`` so each call is fenced-and-timed into the accumulator
+    while profiling is enabled. Disabled cost: ONE ``if`` per call — no clock
+    reads, no fences, no host syncs, and the wrapper adds no jit signatures
+    (the fetch-count + compile_guard regression test pins this)."""
+
+    def wrapper(*args, **kwargs):
+        if not _enabled:
+            return fn(*args, **kwargs)
+        shape, dtype = _args_signature(args)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        device_fence(out)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with _lock:
+            rec = _accum.setdefault(
+                op, {"count": 0, "times_ms": [], "shape": shape,
+                     "dtype": dtype})
+            rec["count"] += 1
+            rec["times_ms"].append(dt_ms)
+            del rec["times_ms"][:-_RING]
+        return out
+
+    wrapper.__name__ = getattr(fn, "__name__", "instrumented")
+    wrapper.__wrapped__ = fn
+    return wrapper

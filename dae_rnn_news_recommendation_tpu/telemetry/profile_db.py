@@ -1,0 +1,117 @@
+"""ProfileDB: the persisted device-time measurement cache.
+
+One JSON file, rows keyed by ``(op, shape, dtype, device_kind)`` — the four
+coordinates that determine a kernel's device time. Every row carries the
+measurement's provenance (best/median over n timed iterations, warmup count,
+compiles observed during warmup vs timed) next to the static cost-analysis
+join (FLOPs, bytes accessed, roofline fraction), so a reader can tell a
+trustworthy number from a polluted one without re-running anything.
+
+This is the cache the ROADMAP item-4 kernel autotuner will read: an autotuner
+sweep is just ``measure()`` over a tile grid with each result ``record()``-ed
+here, and the serving/default tile choice becomes "best row for this key".
+
+Durability contract (same as every artifact dump in this repo): writes go
+through a tmp file + ``os.replace``, so a concurrent reader always parses a
+complete JSON document — either the previous generation or the new one, never
+a torn write. The reader side tolerates a missing file (empty DB) but not a
+malformed one (that is a corrupted artifact worth failing loudly on).
+"""
+
+import json
+import os
+
+_SCHEMA_VERSION = 1
+
+# fields that make up the row key, in key-string order
+KEY_FIELDS = ("op", "shape", "dtype", "device_kind")
+
+
+def row_key(op, shape, dtype, device_kind):
+    """The canonical string key for one measurement row. ``shape`` is any
+    iterable of ints (or a pre-rendered "AxBxC" string); dtype is the jnp
+    dtype name. Keys must be stable across processes — they are dict keys in
+    the JSON file — so everything is stringified one way."""
+    if not isinstance(shape, str):
+        shape = "x".join(str(int(d)) for d in shape)
+    return "|".join((str(op), shape, str(dtype), str(device_kind)))
+
+
+class ProfileDB:
+    """Load-mutate-save store for measurement rows.
+
+    The in-memory form is ``{key_string: row_dict}`` where each row also
+    carries its key fields inline (op/shape/dtype/device_kind) so ``rows()``
+    consumers never have to parse key strings."""
+
+    def __init__(self, path):
+        self.path = path
+        self._rows = {}
+        self.load()
+
+    # ------------------------------------------------------------------ I/O
+    def load(self):
+        """(Re)read the file. Missing file -> empty DB; malformed JSON or a
+        wrong top-level shape raises ValueError (a corrupt cache must not be
+        silently treated as empty and then clobbered)."""
+        self._rows = {}
+        if not os.path.exists(self.path):
+            return self
+        with open(self.path, encoding="utf-8") as f:
+            obj = json.load(f)
+        if not isinstance(obj, dict) or not isinstance(obj.get("rows"), dict):
+            raise ValueError(f"{self.path}: not a profile DB")
+        self._rows = dict(obj["rows"])
+        return self
+
+    def save(self):
+        """Atomic rewrite: tmp + os.replace, so a reader mid-rewrite sees a
+        complete old or complete new document."""
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": _SCHEMA_VERSION,
+                       "rows": self._rows}, f, indent=1, sort_keys=True,
+                      default=str)
+            f.write("\n")
+        os.replace(tmp, self.path)
+        return self.path
+
+    # ---------------------------------------------------------------- store
+    def record(self, result_or_row, **extra):
+        """Upsert one row. Accepts a devprof ``MeasureResult`` (anything with
+        ``as_row()``) or a plain dict carrying at least the KEY_FIELDS.
+        Returns the stored row dict."""
+        row = (result_or_row.as_row()
+               if hasattr(result_or_row, "as_row") else dict(result_or_row))
+        row.update(extra)
+        missing = [k for k in KEY_FIELDS if row.get(k) is None]
+        if missing:
+            raise ValueError(f"profile row missing key fields: {missing}")
+        key = row_key(row["op"], row["shape"], row["dtype"],
+                      row["device_kind"])
+        self._rows[key] = row
+        return row
+
+    def get(self, op, shape, dtype, device_kind):
+        return self._rows.get(row_key(op, shape, dtype, device_kind))
+
+    def rows(self):
+        """All rows, stably ordered by key."""
+        return [self._rows[k] for k in sorted(self._rows)]
+
+    def top(self, n=10, by="best_ms"):
+        """The n most expensive rows by a timing field (for the report's
+        top-N table). Rows without the field sort last."""
+        def cost(row):
+            v = row.get(by)
+            return -float(v) if isinstance(v, (int, float)) else 0.0
+
+        return sorted(self._rows.values(), key=cost)[:n]
+
+    def __len__(self):
+        return len(self._rows)
+
+    def __contains__(self, key):
+        return key in self._rows

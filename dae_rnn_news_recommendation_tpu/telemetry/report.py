@@ -146,6 +146,16 @@ def load_fleet(path):
     return obj
 
 
+def load_profile(path):
+    """A ProfileDB file (telemetry/profile_db.py): {"version", "rows":
+    {key: row}} with rows keyed by (op, shape, dtype, device_kind)."""
+    with open(path, encoding="utf-8") as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict) or not isinstance(obj.get("rows"), dict):
+        raise ValueError(f"{path}: not a profile DB")
+    return obj
+
+
 # -------------------------------------------------------------- aggregation
 
 def _percentile(sorted_vals, q):
@@ -408,6 +418,46 @@ def fleet_summary(bundle, max_rows=12):
     return out
 
 
+def profile_summary(dump, top=10):
+    """The ProfileDB's device-time story: the top-N most expensive rows by
+    best_ms (device ms / FLOPs / bytes / roofline fraction), the device kinds
+    measured, and how many rows carry polluted samples (a timed iteration
+    that saw an XLA compile — provenance the autotuner reads before trusting
+    a number)."""
+    rows = list(((dump or {}).get("rows") or {}).values())
+    if not rows:
+        return None
+
+    def cost(row):
+        v = row.get("best_ms")
+        return -float(v) if isinstance(v, (int, float)) else 0.0
+
+    rows.sort(key=cost)
+    kinds = sorted({str(r.get("device_kind")) for r in rows
+                    if r.get("device_kind") is not None})
+    polluted = sum(1 for r in rows
+                   if isinstance(r.get("compiles_timed"), int)
+                   and r["compiles_timed"] > 0)
+    table = []
+    for r in rows[:top]:
+        table.append({
+            "op": str(r.get("op", "?")),
+            "shape": str(r.get("shape", "?")),
+            "dtype": str(r.get("dtype", "?")),
+            "device_kind": str(r.get("device_kind", "?")),
+            "best_ms": r.get("best_ms"),
+            "median_ms": r.get("median_ms"),
+            "n": r.get("n"),
+            "flops": r.get("flops"),
+            "bytes_accessed": r.get("bytes_accessed"),
+            "roofline_fraction": r.get("roofline_fraction"),
+            "bound": r.get("bound"),
+        })
+    return {"n_rows": len(rows), "n_polluted": polluted,
+            "device_kinds": kinds, "top": table,
+            "n_rows_omitted": max(0, len(rows) - top)}
+
+
 def faults_summary(manifest):
     """The manifest's `faults` section (models/estimator.py
     `_write_fault_manifest`): injected chaos faults, recorded I/O retries,
@@ -516,8 +566,45 @@ def _render_fleet(fleet, lines):
         lines.append(line)
 
 
+def _fmt_quantity(v):
+    """Human-scaled FLOPs/bytes: 1.23e9 -> '1.2G'."""
+    if not isinstance(v, (int, float)):
+        return "-"
+    for thresh, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(v) >= thresh:
+            return f"{v / thresh:.1f}{suffix}"
+    return f"{v:.0f}"
+
+
+def _render_profile(profile, lines):
+    head = (f"device-time profile: {profile['n_rows']} rows, device kinds "
+            + (", ".join(profile["device_kinds"]) or "?"))
+    if profile.get("n_polluted"):
+        head += f"  ({profile['n_polluted']} with compile-polluted samples)"
+    lines.append(head)
+    lines.append("  op / shape / dtype / best ms / median ms / flops / "
+                 "bytes / roofline")
+    for r in profile.get("top") or ():
+        roof = r.get("roofline_fraction")
+        roof_txt = (f"{roof:.3f} ({r.get('bound') or '?'})"
+                    if isinstance(roof, (int, float)) else "-")
+        best = r.get("best_ms")
+        med = r.get("median_ms")
+        best_txt = f"{best:.3f}" if isinstance(best, (int, float)) else "-"
+        med_txt = f"{med:.3f}" if isinstance(med, (int, float)) else "-"
+        lines.append(
+            f"    {r['op']:<28} {r['shape']:>14} {r['dtype']:>9} "
+            f"{best_txt:>10} {med_txt:>10} "
+            f"{_fmt_quantity(r.get('flops')):>8} "
+            f"{_fmt_quantity(r.get('bytes_accessed')):>8} "
+            f" {roof_txt}")
+    if profile.get("n_rows_omitted"):
+        lines.append(f"    ... {profile['n_rows_omitted']} more")
+
+
 def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
-                health=None, faults=None, churn=None, fleet=None, notes=None):
+                health=None, faults=None, churn=None, fleet=None,
+                profile=None, notes=None):
     lines = []
     if manifest:
         lines.append("run: git %s  backend=%s  feed=%s  created %s" % (
@@ -639,11 +726,15 @@ def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
     if fleet:
         lines.append("")
         _render_fleet(fleet, lines)
+    if profile:
+        lines.append("")
+        _render_profile(profile, lines)
     return "\n".join(lines)
 
 
 def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
-           churn_path=None, fleet_path=None, as_json=False):
+           churn_path=None, fleet_path=None, profile_path=None,
+           as_json=False):
     """Build the report. Returns (text, exit_code).
 
     The trace is the report's backbone — an unreadable trace still raises
@@ -657,7 +748,9 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
     None auto-detects `fleet_observability.json` next to the trace and stays
     SILENT when it isn't there (an r12-era run directory renders exactly as
     before); the sentinel "auto" (the CLI's bare `--fleet`) also auto-detects
-    but notes the absence, since the section was explicitly asked for."""
+    but notes the absence, since the section was explicitly asked for.
+    `profile_path` (a ProfileDB file, default name `profile_db.json`)
+    follows the same sentinel contract."""
     trace = load_trace(trace_path)
     rows = span_table(trace)
     meta = trace.get("metadata", {}) or {}
@@ -713,17 +806,32 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
         else:
             fleet_path = None
     fleet = fleet_summary(optional(fleet_path, load_fleet, "fleet bundle"))
+    if profile_path in (None, "auto"):
+        cand = os.path.join(os.path.dirname(os.path.abspath(trace_path)),
+                            "profile_db.json")
+        if os.path.exists(cand):
+            profile_path = cand
+        elif profile_path == "auto":
+            notes.append("profile DB unavailable, section skipped "
+                         "(no profile_db.json next to trace)")
+            profile_path = None
+        else:
+            profile_path = None
+    profile = profile_summary(optional(profile_path, load_profile,
+                                       "profile DB"))
     faults = faults_summary(manifest)
     if as_json:
         return json.dumps({"spans": rows, "counters": counters,
                            "manifest": manifest, "metrics": metrics,
                            "bench": bench, "health": health,
                            "faults": faults, "churn": churn,
-                           "fleet": fleet, "notes": notes or None},
+                           "fleet": fleet, "profile": profile,
+                           "notes": notes or None},
                           indent=2, default=str), 0
-    if not rows and not (metrics or bench or health or churn or fleet):
+    if not rows and not (metrics or bench or health or churn or fleet
+                         or profile):
         return "no span events in trace", 1
     return render_text(rows, counters=counters, manifest=manifest,
                        metrics=metrics, bench=bench, health=health,
                        faults=faults, churn=churn, fleet=fleet,
-                       notes=notes), 0
+                       profile=profile, notes=notes), 0

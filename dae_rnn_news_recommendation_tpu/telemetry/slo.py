@@ -45,9 +45,13 @@ class SLOSpec:
     :param name: stable alert id ("deadline-miss-rate", "hedge-faults").
     :param kind: "rate_max" (numerator/denominator counters, objective is
         the max acceptable ratio; objective 0.0 = the event must never
-        happen), "gauge_min" (gauge must stay >= objective), or
+        happen), "gauge_min" (gauge must stay >= objective),
         "latency_max" (histogram percentile must stay <= objective, in the
-        histogram's own unit).
+        histogram's own unit), or "gauge_growth_max" (the gauge's
+        long-window GROWTH — latest minus window baseline — must stay <=
+        objective while the short window is still climbing; an absent gauge
+        never breaches, so backends without the underlying stat stay
+        silent by construction).
     :param objective: the target (ratio / floor / ceiling by kind).
     :param numerator / denominator: counter names for "rate_max"
         (denominator "" with objective 0.0 = pure event count).
@@ -73,7 +77,8 @@ class SLOSpec:
     slow_burn: float = 1.0
 
     def __post_init__(self):
-        assert self.kind in ("rate_max", "gauge_min", "latency_max"), (
+        assert self.kind in ("rate_max", "gauge_min", "latency_max",
+                             "gauge_growth_max"), (
             f"unknown SLO kind {self.kind!r}")
         assert self.short_window_s <= self.long_window_s
 
@@ -134,6 +139,8 @@ class SLOMonitor:
             return self._eval_rate(spec, ring, now)
         if spec.kind == "gauge_min":
             return self._eval_gauge(spec, ring, now)
+        if spec.kind == "gauge_growth_max":
+            return self._eval_gauge_growth(spec, ring, now)
         return self._eval_latency(spec, ring, now)
 
     # one window's (baseline, latest) snapshot pair: the baseline is the
@@ -196,6 +203,38 @@ class SLOMonitor:
                              "value": None if val is None else round(
                                  float(val), 6)}}
 
+    def _gauge_peak(self, snapshot, name):
+        g = (snapshot.get("gauges") or {}).get(name)
+        if isinstance(g, dict):      # fleet aggregate form: {min,max,mean}
+            return g.get("max")
+        return g
+
+    def _eval_gauge_growth(self, spec, ring, now):
+        """Sustained-growth detector (the memory-leak shape): breach when
+        the LONG window's growth (latest - baseline, worst device via the
+        aggregate max) exceeds the objective AND the SHORT window is still
+        climbing — a one-off allocation spike that then plateaus resolves
+        as soon as the short window flattens. A gauge absent from either
+        snapshot (CPU backends export no memory stats) never breaches."""
+        evidence = {"gauge": spec.gauge}
+        growths = []
+        for label, window_s in (("short", spec.short_window_s),
+                                ("long", spec.long_window_s)):
+            (t0, base), (t1, last) = self._window(ring, now, window_s)
+            v0 = self._gauge_peak(base, spec.gauge)
+            v1 = self._gauge_peak(last, spec.gauge)
+            if v0 is None or v1 is None:
+                evidence[f"{label}_growth"] = None
+                growths.append(None)
+                continue
+            growth = float(v1) - float(v0)
+            evidence[f"{label}_growth"] = round(growth, 6)
+            growths.append(growth)
+        short_g, long_g = growths
+        breached = (long_g is not None and long_g > spec.objective
+                    and short_g is not None and short_g > 0.0)
+        return {"breached": breached, "evidence": evidence}
+
     def _eval_latency(self, spec, ring, now):
         burns, evidence = [], {}
         for label, window_s, threshold in (
@@ -241,10 +280,17 @@ def _histogram_delta(last, base):
 
 def serving_slo_specs(*, deadline_miss_max=0.05, shed_max=0.05,
                       coverage_floor=0.99, p95_ms_max=2500.0,
+                      memory_growth_bytes_max=256e6,
                       short_window_s=60.0, long_window_s=300.0):
     """The default serving SLO set: the generic health objectives every
     fleet run carries (fault-family zero-tolerance specs ride alongside —
-    see fleet/chaos_fleet.fleet_fault_slo_specs)."""
+    see fleet/chaos_fleet.fleet_fault_slo_specs).
+
+    `memory_growth_bytes_max` bounds sustained per-device HBM growth over
+    the long window (the leak detector over devprof.sample_memory's
+    `hbm_bytes_in_use` gauge). Where the backend exports no memory stats
+    (CPU tier-1, chaos reference replays) the gauge is never set and the
+    spec stays silent by absence."""
     w = {"short_window_s": short_window_s, "long_window_s": long_window_s}
     return (
         SLOSpec("deadline-miss-rate", "rate_max", deadline_miss_max,
@@ -258,4 +304,7 @@ def serving_slo_specs(*, deadline_miss_max=0.05, shed_max=0.05,
         SLOSpec("reply-p95", "latency_max", p95_ms_max,
                 histogram="request_latency_ms", percentile=95.0,
                 fast_burn=1.0, slow_burn=1.0, **w),
+        SLOSpec("device-memory-growth", "gauge_growth_max",
+                float(memory_growth_bytes_max), gauge="hbm_bytes_in_use",
+                **w),
     )

@@ -151,15 +151,20 @@ def make_epoch_fn(config, optimizer, loss_fn=loss_and_metrics, health=True,
         def body(carry, sl):
             params, opt_state, key = carry
             idx, rv = sl
-            batch = gather_batch(resident, idx, rv, extremes)
+            with jax.named_scope("resident/gather"):
+                batch = gather_batch(resident, idx, rv, extremes)
             key, sub = jax.random.split(key)
-            cost, metrics, grads = grads_and_metrics(
-                loss_fn, config, params, batch, sub, accum_steps)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            if health:
-                metrics = {**metrics,
-                           **sentinel_metrics(cost, grads, updates, params)}
-            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            with jax.named_scope("resident/grads"):
+                cost, metrics, grads = grads_and_metrics(
+                    loss_fn, config, params, batch, sub, accum_steps)
+            with jax.named_scope("resident/update"):
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                if health:
+                    metrics = {**metrics, **sentinel_metrics(cost, grads,
+                                                             updates, params)}
+                params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                                updates)
             return (params, opt_state, key), metrics
 
         (params, opt_state, key), metrics = jax.lax.scan(

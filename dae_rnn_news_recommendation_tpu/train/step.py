@@ -172,22 +172,26 @@ def _corrupt_batch(key, batch, config):
 def loss_and_metrics(params, batch, key, config):
     """Full training objective (reference _create_cost_function_node,
     autoencoder.py:417-442). Returns (cost, metrics_dict)."""
-    batch = materialize_x(batch, config)
+    with jax.named_scope("train/materialize"):
+        batch = materialize_x(batch, config)
     x = batch["x"]
     row_valid = batch.get("row_valid")
     x_corr = batch.get("x_corr")
     if x_corr is None:
-        x_corr = _corrupt_batch(key, batch, config)
+        with jax.named_scope("train/corrupt"):
+            x_corr = _corrupt_batch(key, batch, config)
 
-    h = dae_core.encode(params, x_corr, config)
-    y = dae_core.decode(params, h, config)
+    with jax.named_scope("train/encode_decode"):
+        h = dae_core.encode(params, x_corr, config)
+        y = dae_core.decode(params, h, config)
 
     if config.triplet_strategy != "none":
         mining_impl = getattr(config, "mining_impl", "auto")
-        t_loss, data_weight, fraction, num, extras = mine_triplets(
-            config.triplet_strategy, batch["labels"], h, row_valid=row_valid,
-            mining_impl=mining_impl
-        )
+        with jax.named_scope("train/mine"):
+            t_loss, data_weight, fraction, num, extras = mine_triplets(
+                config.triplet_strategy, batch["labels"], h,
+                row_valid=row_valid, mining_impl=mining_impl
+            )
         if config.label2_alpha > 0.0 and "labels2" in batch:
             # joint two-label mining: a second batch_all term over labels2
             # (always batch_all — batch_hard's max/min would let one label's
@@ -389,13 +393,16 @@ def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True,
     crash-exact resume possible without persisting any intra-step state."""
 
     def step(params, opt_state, key, batch):
-        cost, metrics, grads = grads_and_metrics(loss_fn, config, params,
-                                                 batch, key, accum_steps)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        if health:
-            metrics = {**metrics,
-                       **sentinel_metrics(cost, grads, updates, params)}
-        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        with jax.named_scope("train/grads"):
+            cost, metrics, grads = grads_and_metrics(loss_fn, config, params,
+                                                     batch, key, accum_steps)
+        with jax.named_scope("train/update"):
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            if health:
+                metrics = {**metrics,
+                           **sentinel_metrics(cost, grads, updates, params)}
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                            updates)
         return params, opt_state, metrics
 
     donate_argnums = (0, 1) if donate else ()

@@ -325,6 +325,11 @@ BENCH_REGRESSION_TOLERANCE = 0.15  # >15% drop vs prior same-platform fails
 # registries on, same trace, same hedged router config) may cost at most
 # this fraction of the bare fleet_qps.
 FLEET_TRACING_OVERHEAD_MAX = 0.03
+# ISSUE 18: the devprof instrument() wrapper must be free while profiling is
+# DISABLED — the bench races the same compiled train step bare vs wrapped
+# (fenced best-of-N both legs, telemetry/devprof.measure), and the wrapped
+# leg may cost at most this fraction of the bare throughput.
+PROFILE_OVERHEAD_MAX = 0.01
 
 
 def _bench_history():
@@ -431,6 +436,29 @@ def _fleet_tracing_overhead_gate():
                         f"{'<=' if ok else '>'} "
                         f"{FLEET_TRACING_OVERHEAD_MAX:.0%}")
     return True, ("no bench record carries the fleet_qps_traced race yet — "
+                  "pass by absence, not by measurement")
+
+
+def _profile_overhead_gate():
+    """(ok, detail) for the disabled-profiling overhead check: the LATEST
+    bench record carrying both legs of the devprof race must keep the
+    instrumented-disabled train-step throughput within PROFILE_OVERHEAD_MAX
+    of the bare leg. Pass-by-absence like the tracing gate: a history without
+    the race (pre-r18 records) is a note, not a failure — the gate fails only
+    on a measured slowdown."""
+    hist = _bench_history()
+    for name, extra in reversed(hist):
+        bare = extra.get("profile_overhead_bare_aps")
+        instr = extra.get("profile_overhead_instrumented_aps")
+        if (isinstance(bare, (int, float)) and bare > 0
+                and isinstance(instr, (int, float)) and instr > 0):
+            overhead = 1.0 - float(instr) / float(bare)
+            ok = overhead <= PROFILE_OVERHEAD_MAX
+            return ok, (f"{name}: instrumented-disabled step {instr} aps vs "
+                        f"bare {bare} aps — profiling-off overhead "
+                        f"{overhead:.2%} {'<=' if ok else '>'} "
+                        f"{PROFILE_OVERHEAD_MAX:.0%}")
+    return True, ("no bench record carries the devprof overhead race yet — "
                   "pass by absence, not by measurement")
 
 
@@ -1076,6 +1104,14 @@ def main(argv=None):
     # registries) and the traced qps may trail the bare qps by at most 3%.
     trace_ok, trace_detail = _fleet_tracing_overhead_gate()
     check("fleet_tracing_overhead_lt_3pct", trace_ok, trace_detail)
+    # ISSUE 18: always-on profiling hooks (devprof.instrument on the train
+    # step) must cost nothing while profiling is disabled — one predicate per
+    # call, no clocks, no fences. The bench measures both legs fenced
+    # (devprof.measure); this gate reads the committed race like the tracing
+    # gate above. The zero-host-sync half of the contract is pinned by the
+    # fetch-count + compile_guard regression test in tests/test_profile.py.
+    prof_ok, prof_detail = _profile_overhead_gate()
+    check("profile_overhead_lt_1pct", prof_ok, prof_detail)
     check("user_category_top1", user["category_top1_accuracy"] > 0.6,
           f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.6 "
           "(chance ~1/8; scored against 5-candidate category means — one "

@@ -1,0 +1,29 @@
+"""Planted R2 violation: a perf_counter pair around device work with no
+fence and no warmup — measures dispatch, not compute.
+
+Named r2_devprof_* so it falls inside R2's devprof scope (the device timer's
+own module lives by the fencing law it enforces). The clean twin routes the
+same workload through `devprof.measure`, which R2 knows is a fence: every
+timed iteration ends with a `device_fence` on the call's result.
+"""
+
+import time
+
+from dae_rnn_news_recommendation_tpu.telemetry import devprof
+
+
+def timed_wrong(fn, x):
+    # no fence between dispatch and the clock read, no warmup to absorb the
+    # compile: the delta is dispatch latency plus XLA compile time
+    t0 = time.perf_counter()
+    out = fn(x)
+    dt = time.perf_counter() - t0  # planted: R2
+    return out, dt
+
+
+def timed_right(fn, x):
+    # the fenced best-of-N timer IS the fence for this region
+    t0 = time.perf_counter()
+    result = devprof.measure(fn, (x,), n=3, warmup=1)
+    host_total = time.perf_counter() - t0
+    return result, host_total

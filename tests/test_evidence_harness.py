@@ -195,3 +195,29 @@ def test_bench_trajectory_gate_inverts_lower_is_better_metrics(evrun,
     ])
     ok, detail = evrun._bench_trajectory_gate()
     assert ok and "pass by absence" in detail
+
+
+def test_profile_overhead_gate_reads_latest_race(evrun, monkeypatch):
+    """ISSUE 18: the devprof disabled-instrumentation race gates <1% on the
+    LATEST record carrying both legs; a history without the race passes with
+    a note, a measured slowdown fails."""
+    monkeypatch.setattr(evrun, "_bench_history",
+                        lambda: [("r1", {"platform": "cpu"})])
+    ok, detail = evrun._profile_overhead_gate()
+    assert ok and "pass by absence" in detail
+
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [
+        ("r1", {"profile_overhead_bare_aps": 1000.0,
+                "profile_overhead_instrumented_aps": 996.0}),
+    ])
+    ok, detail = evrun._profile_overhead_gate()
+    assert ok and "0.40%" in detail
+
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [
+        ("old", {"profile_overhead_bare_aps": 1000.0,
+                 "profile_overhead_instrumented_aps": 999.0}),
+        ("new", {"profile_overhead_bare_aps": 1000.0,
+                 "profile_overhead_instrumented_aps": 950.0}),
+    ])
+    ok, detail = evrun._profile_overhead_gate()
+    assert not ok and detail.startswith("new:") and "5.00%" in detail

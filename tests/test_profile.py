@@ -1,0 +1,221 @@
+"""Device-time profiling (ISSUE 18): the fenced `devprof.measure` timer, the
+ProfileDB persistence contract, `report --profile`, and the always-on
+instrumentation's disabled-cost contract.
+
+Contracts pinned here:
+  * measure() — fenced best-of-N with per-iteration compile accounting: the
+    fresh executable's compile lands in warmup, timed iterations stay clean
+    (n_clean == n), best <= median, and the key coordinates default to the
+    largest array leaf's signature;
+  * ProfileDB — rows keyed by (op, shape, dtype, device_kind) round-trip
+    through the JSON file; the tmp+os.replace rewrite means a concurrent
+    reader always parses a COMPLETE document; a malformed file raises
+    instead of being silently treated as empty and clobbered;
+  * report --profile — an explicit path renders the top-N device-time
+    table; a bare --profile with no DB next to the trace is a note + exit 0
+    (pass-by-absence, the --fleet contract); with no flag at all a
+    `profile_db.json` next to the trace is auto-detected;
+  * instrument() disabled — ZERO host syncs (devprof.device_fence is never
+    reached) and zero extra compiles across N calls (compile_guard): the
+    regression-test half of the profile_overhead_lt_1pct evidence gate.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu.analysis.runtime import compile_guard
+from dae_rnn_news_recommendation_tpu.telemetry import ProfileDB, devprof
+from dae_rnn_news_recommendation_tpu.telemetry.__main__ import main as cli_main
+from dae_rnn_news_recommendation_tpu.telemetry.profile_db import row_key
+
+# ------------------------------------------------------------------ measure
+
+
+def test_measure_is_fenced_best_of_n_with_compile_provenance():
+    f = jax.jit(lambda x: (x * 2.0).sum())
+    x = jnp.arange(512, dtype=jnp.float32).reshape(8, 64)
+    res = devprof.measure(f, (x,), n=4, warmup=1, op="t/sum")
+    assert res.op == "t/sum"
+    assert res.shape == "8x64" and res.dtype == "float32"
+    assert res.n == 4 and len(res.times_ms) == 4
+    assert res.compiles_warmup >= 1  # the fresh executable compiled in warmup
+    assert res.compiles_timed == 0 and res.n_clean == 4
+    assert 0.0 < res.best_ms <= res.median_ms
+
+
+def test_measure_records_and_round_trips_through_profile_db(tmp_path):
+    path = str(tmp_path / "profile_db.json")
+    db = ProfileDB(path)
+    f = jax.jit(lambda x: x @ x.T)
+    x = jnp.ones((16, 32), jnp.float32)
+    res = devprof.measure(f, (x,), n=3, warmup=1, op="t/matmul", db=db)
+    fresh = ProfileDB(path)  # a separate reader, straight from disk
+    row = fresh.get("t/matmul", "16x32", "float32", res.device_kind)
+    assert row is not None
+    assert row["best_ms"] == pytest.approx(res.best_ms, abs=1e-6)
+    assert row["n"] == 3 and row["warmup"] == 1
+    # rows carry their key fields inline — consumers never parse key strings
+    assert [row[k] for k in ("op", "shape", "dtype")] == [
+        "t/matmul", "16x32", "float32"]
+
+
+# ---------------------------------------------------------------- ProfileDB
+
+
+def test_row_key_and_record_validation(tmp_path):
+    assert row_key("op/a", (4, 8), "float32", "cpu") == "op/a|4x8|float32|cpu"
+    db = ProfileDB(str(tmp_path / "db.json"))
+    with pytest.raises(ValueError, match="missing key fields"):
+        db.record({"op": "x", "shape": "4", "dtype": "f32"})  # no device_kind
+    db.record({"op": "x", "shape": (4,), "dtype": "f32",
+               "device_kind": "cpu", "best_ms": 1.0})
+    assert "x|4|f32|cpu" in db and len(db) == 1
+
+
+def test_malformed_db_raises_not_clobbers(tmp_path):
+    p = tmp_path / "profile_db.json"
+    p.write_text('{"rows": []}')  # wrong shape: rows must be a dict
+    with pytest.raises(ValueError, match="not a profile DB"):
+        ProfileDB(str(p))
+    assert p.read_text() == '{"rows": []}'  # failed load must not rewrite
+
+
+def test_atomic_rewrite_under_concurrent_reader(tmp_path):
+    """tmp + os.replace: a reader racing 200 rewrites must always parse a
+    complete document — either generation, never a torn write."""
+    path = str(tmp_path / "profile_db.json")
+    db = ProfileDB(path)
+    db.record({"op": "k0", "shape": "1", "dtype": "f32",
+               "device_kind": "cpu", "best_ms": 0.5})
+    db.save()
+    n_seen, failures = [], []
+
+    def reader():
+        for _ in range(400):
+            try:
+                n_seen.append(len(ProfileDB(path)))
+            except ValueError as e:  # a torn write would parse-error here
+                failures.append(repr(e))
+                return
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for i in range(200):
+            db.record({"op": f"k{i % 7}", "shape": "1", "dtype": "f32",
+                       "device_kind": "cpu", "best_ms": 0.5 + i})
+            db.save()
+    finally:
+        t.join(timeout=60)
+    assert failures == []
+    assert n_seen and all(n >= 1 for n in n_seen)
+    assert len(ProfileDB(path)) == 7  # k0..k6, last write per key wins
+
+
+# ----------------------------------------------------------- report --profile
+
+
+def _trace_with_one_span(tmp_path):
+    trace = tmp_path / "trace.json"
+    trace.write_text(
+        '{"traceEvents": [{"name": "fit/epoch", "ph": "X", "ts": 0,'
+        ' "dur": 1000, "pid": 1, "tid": 1}]}')
+    return trace
+
+
+def _sample_row(**over):
+    row = {"op": "ops/topk_fused_k10", "shape": "8x512", "dtype": "float32",
+           "device_kind": "TPU v5 lite", "best_ms": 0.25, "median_ms": 0.3,
+           "n": 5, "n_clean": 5, "warmup": 2, "compiles_warmup": 1,
+           "compiles_timed": 0, "times_ms": [0.25, 0.3, 0.31],
+           "flops": 1.2e9, "bytes_accessed": 3.4e6, "mfu": 0.02,
+           "bw_fraction": 0.41, "roofline_fraction": 0.41, "bound": "memory"}
+    row.update(over)
+    return row
+
+
+def test_report_cli_profile_flag_renders_table(tmp_path, capsys):
+    trace = _trace_with_one_span(tmp_path)
+    db = ProfileDB(str(tmp_path / "pdb.json"))
+    db.record(_sample_row())
+    db.record(_sample_row(op="train/step", shape="256x10000",
+                          dtype="bfloat16", best_ms=12.5, median_ms=13.0))
+    db.save()
+    rc = cli_main(["report", str(trace), "--profile",
+                   str(tmp_path / "pdb.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "device-time profile: 2 rows" in out
+    assert "TPU v5 lite" in out
+    assert "ops/topk_fused_k10" in out and "train/step" in out
+    assert "0.410 (memory)" in out  # the roofline column
+
+
+def test_report_bare_profile_with_no_db_is_note_not_failure(tmp_path, capsys):
+    trace = _trace_with_one_span(tmp_path)
+    rc = cli_main(["report", str(trace), "--profile"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "profile DB unavailable" in out
+    assert "device-time profile" not in out
+
+
+def test_report_autodetects_profile_db_next_to_trace(tmp_path, capsys):
+    trace = _trace_with_one_span(tmp_path)
+    db = ProfileDB(str(tmp_path / "profile_db.json"))  # the default name
+    db.record(_sample_row())
+    db.save()
+    rc = cli_main(["report", str(trace)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "device-time profile: 1 rows" in out
+
+
+# --------------------------------------------------------------- instrument
+
+
+def test_instrument_disabled_adds_no_syncs_and_no_compiles(monkeypatch):
+    """The profile_overhead_lt_1pct contract's regression half: with
+    profiling disabled the wrapper is ONE predicate per call — it must never
+    reach device_fence (zero host syncs) and must not add a jit signature
+    (a single compile across 10 calls)."""
+    assert not devprof.enabled()
+
+    def boom(x=None):
+        raise AssertionError("device_fence reached with profiling disabled")
+
+    monkeypatch.setattr(devprof, "device_fence", boom)
+    f = jax.jit(lambda x: x * 3.0 + 1.0)
+    w = devprof.instrument(f, op="t/step")
+    x = jnp.arange(16.0)
+    with compile_guard(max_compiles=1) as guard:
+        outs = [w(x) for _ in range(10)]
+    assert guard.count <= 1
+    np.testing.assert_allclose(jax.device_get(outs[-1]),
+                               np.arange(16.0) * 3.0 + 1.0)
+
+
+def test_instrument_enabled_accumulates_and_collects_rows(tmp_path):
+    f = jax.jit(lambda x: x + 1.0)
+    w = devprof.instrument(f, op="t/inc")
+    x = jnp.ones((4, 4), jnp.float32)
+    w(x)  # compile before arming: enabled-mode rows measure steady state
+    devprof.enable()
+    try:
+        for _ in range(3):
+            w(x)
+        db = ProfileDB(str(tmp_path / "pdb.json"))
+        rows = devprof.collect(device_kind="cpu", db=db)
+    finally:
+        acc = devprof.disable()
+    (row,) = rows
+    assert row["op"] == "t/inc" and row["n"] == 3
+    assert row["shape"] == "4x4" and row["n_clean"] == 3
+    assert ProfileDB(str(tmp_path / "pdb.json")).get(
+        "t/inc", "4x4", "float32", "cpu")
+    assert "t/inc" in acc  # disable() hands back the accumulator

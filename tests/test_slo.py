@@ -135,6 +135,34 @@ def test_latency_percentile_evaluated_on_window_delta():
     assert [a["slo"] for a in fired] == ["p95"]
 
 
+def test_gauge_growth_fires_on_sustained_climb_only():
+    """The memory-leak shape: long-window growth past the objective AND a
+    still-climbing short window breach; a spike that plateaus resolves, and
+    an absent gauge (CPU: no memory_stats) never breaches."""
+    clk = {"t": 0.0}
+    spec = SLOSpec("mem", "gauge_growth_max", 100.0, gauge="hbm_bytes_in_use",
+                   short_window_s=5.0, long_window_s=10.0)
+    mon = SLOMonitor([spec], clock=_clock(clk))
+    # absent gauge: silent by absence
+    mon.observe(_snap())
+    assert mon.evaluate() == []
+    # steady climb: 50 bytes/s -> long-window growth 500 > 100, short > 0
+    for t, v in ((1.0, 1000), (5.0, 1200), (9.0, 1400), (11.0, 1500)):
+        clk["t"] = t
+        mon.observe(_snap(gauges={"hbm_bytes_in_use":
+                                  {"min": v, "max": v, "mean": v}}))
+    fired = mon.evaluate()
+    assert [a["slo"] for a in fired] == ["mem"]
+    # plateau: long growth still big vs an old baseline, but the short
+    # window stops climbing -> the episode resolves
+    for t in (12.0, 14.0, 18.0, 21.0):
+        clk["t"] = t
+        mon.observe(_snap(gauges={"hbm_bytes_in_use":
+                                  {"min": 1500, "max": 1500, "mean": 1500}}))
+    assert mon.evaluate() == []
+    assert mon.summary()["active"] == []
+
+
 # ------------------------------------------------------------ housekeeping
 
 def test_summary_carries_specs_alerts_and_active_state():
@@ -146,7 +174,8 @@ def test_summary_carries_specs_alerts_and_active_state():
     mon.evaluate()
     s = mon.summary()
     assert {sp["name"] for sp in s["specs"]} == {
-        "deadline-miss-rate", "shed-rate", "corpus-coverage", "reply-p95"}
+        "deadline-miss-rate", "shed-rate", "corpus-coverage", "reply-p95",
+        "device-memory-growth"}
     assert [a["slo"] for a in s["alerts"]] == ["shed-rate"]
     assert s["active"] == ["shed-rate"]
     assert s["n_observations"] == 2
