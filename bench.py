@@ -1422,6 +1422,47 @@ def _bench_fleet(jax, params, config, sz):
         out["fleet_tracing_overhead"] = round(
             1.0 - out["fleet_qps_traced"] / max(out["fleet_qps"], 1e-9), 4)
 
+        _phase("fleet: shadow re-replay (shadow-overhead race)")
+        # third leg of the race: the same trace through the same warmed
+        # replicas, but every replica shadow-samples 100% of its replies
+        # through the exact re-score path (serve/shadow.py). The re-score
+        # rides the scorer's own thread strictly after the primary reply
+        # resolves, so evidence/run.py gates fleet_qps_shadow / fleet_qps
+        # at <2% — tighter than tracing, because nothing shadow does is
+        # allowed on the reply path at all. The corpus here is exact
+        # (non-IVF), so the shadow fns are the already-warm serve fns:
+        # zero new compiles in this leg.
+        for r in replicas:
+            r.service.attach_shadow(1.0, max_queue=max(256, n_requests))
+        shadow_router = Router(replicas, hedge=True,
+                               default_deadline_s=sla_s,
+                               hedge_delay_floor_s=hedge_floor_s,
+                               hedge_delay_cap_s=hedge_cap_s, seed=17)
+        try:
+            s_replies, s_wall = replay(shadow_router, trace)
+            s_counts = dict(shadow_router.counts)
+            for r in replicas:
+                r.service.shadow.flush(timeout=30.0)
+            shadow_scored = sum(
+                r.service.shadow.counts.get("scored", 0) for r in replicas)
+            shadow_recalls = [r.service.shadow.recall_mean()
+                              for r in replicas
+                              if r.service.shadow.recall_mean() is not None]
+        finally:
+            shadow_router.stop()
+            for r in replicas:
+                r.service.attach_shadow(0.0)  # rollout section measures bare
+        out["fleet_qps_shadow"] = round(
+            s_counts["replied"] / max(s_wall, 1e-9), 1)
+        out["fleet_shadow_overhead"] = round(
+            1.0 - out["fleet_qps_shadow"] / max(out["fleet_qps"], 1e-9), 4)
+        out["fleet_shadow_scored"] = int(shadow_scored)
+        if shadow_recalls:
+            # exact corpus + exact shadow path: anything below 1.0 here is
+            # a shadow-scorer bug, not a retrieval miss
+            out["fleet_shadow_recall_mean"] = round(
+                float(np.mean(shadow_recalls)), 6)
+
         _phase("fleet: staged rollout under replay (inflight percentiles)")
         fresh = sp.random(64, F, density=0.005, format="csr",
                           random_state=18, dtype=np.float32)

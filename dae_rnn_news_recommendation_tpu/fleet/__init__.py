@@ -35,9 +35,11 @@ fault-site table: docs/reliability.md.
 from .chaos_fleet import (FleetPlanResult, chaos_fleet_soak, fleet_fault_plan,
                           run_fleet_plan, run_fleet_reference)
 from .loadgen import make_session_trace, replay_trace
-from .observability import (FAMILY_ALERTS, dump_fleet_observability,
-                            fleet_fault_slo_specs, fleet_observability_bundle,
-                            fleet_registries)
+from .observability import (FAMILY_ALERTS, QUALITY_FAMILY_ALERTS,
+                            dump_fleet_observability,
+                            dump_quality_observability, fleet_fault_slo_specs,
+                            fleet_observability_bundle, fleet_registries,
+                            quality_observability_bundle)
 from .replica import HEALTH_STATES, ServiceReplica
 from .rollout import FleetSupervisor
 from .router import Router
@@ -47,6 +49,8 @@ __all__ = [
     "make_session_trace", "replay_trace",
     "FleetPlanResult", "fleet_fault_plan", "run_fleet_plan",
     "run_fleet_reference", "chaos_fleet_soak",
-    "FAMILY_ALERTS", "fleet_fault_slo_specs", "fleet_registries",
-    "fleet_observability_bundle", "dump_fleet_observability",
+    "FAMILY_ALERTS", "QUALITY_FAMILY_ALERTS", "fleet_fault_slo_specs",
+    "fleet_registries", "fleet_observability_bundle",
+    "dump_fleet_observability", "quality_observability_bundle",
+    "dump_quality_observability",
 ]

@@ -36,6 +36,20 @@ FAMILY_ALERTS = {
     5: "replica-admission-transients",
 }
 
+# chaos_quality fault family -> the QUALITY alert that must fire under it
+# (telemetry/slo.quality_slo_specs names). Cell-owning-shard loss shrinks
+# live coverage — the quarantine masks the rows out of both the exact
+# shadow and the IVF shortlist, so recall is unaffected but coverage drops
+# below its floor. Churn drift leaves the service scoring with perturbed
+# params against centroids built at the old ones: the IVF probe ordering
+# degrades while the exact full-scan shadow does not, and the recall
+# burn-rate fires. The soak audits both directions: the injected family's
+# alert fires, the fault-free reference replay stays silent.
+QUALITY_FAMILY_ALERTS = {
+    "cell-owning-shard-loss": "quality-coverage",
+    "churn-drift": "quality-recall",
+}
+
 
 def fleet_fault_slo_specs(window_s=3600.0):
     """One zero-tolerance spec per fleet fault family. Objective 0.0 means
@@ -120,6 +134,50 @@ def dump_fleet_observability(path, **bundle_kw):
     `fleet_observability.json` next to a trace, `telemetry report`
     auto-detects it."""
     bundle = fleet_observability_bundle(**bundle_kw)
+    return _dump_json(path, bundle)
+
+
+def quality_observability_bundle(service=None, corpus=None, monitor=None,
+                                 registry=None, extra=None):
+    """Join the retrieval-quality surfaces into one serializable dict —
+    the `report --quality` input. Same philosophy as the fleet bundle:
+    every section optional and None-safe, pass-by-absence all the way
+    down.
+
+    Sections: the shadow scorer's sample window + counters (from
+    `service.shadow`), the corpus ledger tail + live coverage, the shared
+    registry snapshot (shadow recall histograms, corpus/IVF quality
+    gauges), and the quality SLO monitor's specs/alert history."""
+    regs = []
+    for m in (registry, getattr(service, "metrics", None),
+              getattr(corpus, "metrics", None)):
+        if m is not None and all(m is not seen for seen in regs):
+            regs.append(m)
+    snaps = [m.snapshot() for m in regs]
+    shadow = getattr(service, "shadow", None)
+    bundle = {
+        "shadow": shadow.summary() if shadow is not None else None,
+        "corpus": ({"coverage": corpus.coverage,
+                    "ledger": list(corpus.ledger)[-64:]}
+                   if corpus is not None else None),
+        "registries": snaps,
+        "aggregate": aggregate(snaps) if snaps else None,
+        "slo": monitor.summary() if monitor is not None else None,
+    }
+    if extra:
+        bundle.update(extra)
+    return bundle
+
+
+def dump_quality_observability(path, **bundle_kw):
+    """Write the quality bundle as JSON and return `path`. Dropped as
+    `quality_observability.json` next to a trace, `telemetry report
+    --quality` auto-detects it."""
+    bundle = quality_observability_bundle(**bundle_kw)
+    return _dump_json(path, bundle)
+
+
+def _dump_json(path, bundle):
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     tmp = f"{path}.tmp"

@@ -15,6 +15,9 @@ back rather than serving a bad build. Full story in docs/serving.md.
     svc.stop()
 """
 
+from .chaos_quality import (QUALITY_FAMILIES, QualityPlanResult,
+                            chaos_quality_soak, run_quality_plan,
+                            run_quality_reference)
 from .chaos_serve import (ServePlanResult, ShardPlanResult, chaos_serve_soak,
                           chaos_shard_soak, overload_trace, run_serve_plan,
                           run_shard_plan, serve_fault_plan, shard_fault_plan)
@@ -29,6 +32,8 @@ from .service import RecommendationService, Reply, ReplyFuture
 __all__ = [
     "CORPUS_DTYPES",
     "CorpusSlot",
+    "QUALITY_FAMILIES",
+    "QualityPlanResult",
     "RecommendationService",
     "Reply",
     "ReplyFuture",
@@ -39,6 +44,7 @@ __all__ = [
     "SwapInProgress",
     "SwapRejected",
     "block_indices",
+    "chaos_quality_soak",
     "chaos_serve_soak",
     "chaos_shard_soak",
     "default_corpus",
@@ -50,6 +56,8 @@ __all__ = [
     "make_sharded_serve_fn",
     "overload_trace",
     "quantize_corpus",
+    "run_quality_plan",
+    "run_quality_reference",
     "run_serve_plan",
     "run_shard_plan",
     "serve_fault_plan",

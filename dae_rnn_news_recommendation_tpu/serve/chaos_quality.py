@@ -1,0 +1,308 @@
+"""Chaos-quality soak: the both-ways contract for the QUALITY alerts.
+
+r14's chaos soaks pin the LATENCY/health alerts both ways (the injected
+family's alert fires, the fault-free reference replay stays silent); this
+module gives the retrieval-QUALITY alerts (`telemetry.quality_slo_specs`)
+the same discipline. Two fault families, each degrading the one signal its
+alert watches:
+
+  * ``cell-owning-shard-loss`` — the default sharded+IVF configuration
+    loses a shard that OWNS index cells under load, with the shadow scorer
+    sampling every reply. The first post-loss dispatch quarantines the
+    shard, the corpus/service publish the shrunken `corpus_coverage`
+    gauge, and the ``quality-coverage`` floor alert must fire. The fault
+    is visible to quality observability the moment it lands — not at the
+    next offline bench.
+  * ``churn-drift`` — the serving params have drifted from the params the
+    corpus (and its k-means centroids) were built with, and the service
+    probes fewer cells than exist. Every query the shadow re-scores with
+    the exact full-scan path reveals rows the drifted probe ordering
+    skipped: `shadow_misses` burns against `shadow_expected` and the
+    ``quality-recall`` burn-rate alert must fire — while coverage stays a
+    full 1.0 and the ``quality-coverage`` alert stays silent.
+
+The fault-free reference replay runs each family's exact configuration
+MINUS its fault (no shard loss; service params == corpus build params) and
+must raise zero quality alerts. The reference's recall silence is
+structural, not statistical: queries are corpus rows, and k-means'
+assignment is nearest-centroid under the FINAL centroids, so a probe-1
+lookup of a row's own embedding lands in the cell that holds the row and
+the served top-1 equals the exact top-1 bit-for-bit.
+
+Every plan (faulted and reference) additionally demands ZERO post-warmup
+XLA compiles: the shadow re-scores, the quarantine path, and the degraded
+serving all ride variants `warmup()` compiled — quality observability
+never buys a latency cliff.
+"""
+
+import dataclasses
+import time
+
+import numpy as np
+
+from ..analysis.runtime import compile_guard
+from ..fleet.observability import QUALITY_FAMILY_ALERTS
+from ..models.dae_core import DAEConfig, init_params
+from ..telemetry.metrics_registry import MetricsRegistry
+from ..telemetry.slo import SLOMonitor, quality_slo_specs
+from .chaos_serve import _encode_rows
+from .corpus import ServingCorpus
+from .service import RecommendationService
+
+_N_ARTICLES = 96
+_N_FEATURES = 24
+_N_COMPONENTS = 8
+_SLA_S = 5.0
+_HARNESS_DEADLINE_S = 60.0
+
+QUALITY_FAMILIES = tuple(QUALITY_FAMILY_ALERTS)
+
+# the drift family's index: many THIN cells probed shallowly (96 rows over
+# 16 cells, probes=1), so stale centroid ordering has plenty of room to
+# miss; the loss family probes exhaustively so its recall is IVF==exact
+# and coverage is the only degraded signal
+_DRIFT_IVF_KW = {"retrieval": "ivf", "n_cells": 16, "cell_cap": 96}
+_DRIFT_PROBES = 1
+_LOSS_IVF_KW = {"retrieval": "ivf", "n_cells": 4, "cell_cap": 96}
+_LOSS_PROBES = 4
+
+
+@dataclasses.dataclass
+class QualityPlanResult:
+    seed: int
+    family: str
+    injected: bool          # False = the fault-free reference replay
+    ok: bool
+    detail: str
+    n_replied: int
+    n_scored: int           # shadow samples scored
+    recall_mean: float
+    min_coverage: float     # lowest corpus_coverage gauge value observed
+    alerts: list            # quality alert names fired, in firing order
+    n_post_warm_compiles: int
+    duration_s: float
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def _drift_query_ids(seed, n_requests):
+    """The row ids run_quality_plan's two bursts will submit, in order —
+    the same rng stream `_burst` consumes, replicated so the plan
+    constructor can judge drift materiality on the EXACT query sequence."""
+    rng = np.random.default_rng(3000 + seed)
+    per_burst = max(1, int(n_requests) // 2)
+    return [int(rng.integers(0, _N_ARTICLES)) for _ in range(2 * per_burst)]
+
+
+def _predicted_miss_rate(e1, slot, query_ids):
+    """Host prediction of the probe-1 recall@1 miss rate for drifted query
+    embeddings `e1` against the slot's stored corpus + centroids: a query
+    misses when its exact top-1 row does not live in its nearest-centroid
+    cell (the single probed cell). This is the same dot-product arithmetic
+    the device runs, so the prediction is exact up to fp ties."""
+    import jax
+
+    e0 = np.asarray(jax.device_get(slot.emb), np.float32)[: slot.n]
+    cents = np.asarray(jax.device_get(slot.ivf.centroids), np.float32)
+    assign = np.asarray(jax.device_get(slot.ivf.assign),
+                        np.int64)[: slot.n]
+    misses = 0
+    for i in query_ids:
+        q = e1[i]
+        exact = int(np.argmax(e0 @ q))
+        cell = int(np.argmax(cents @ q))
+        rows = np.where(assign == cell)[0]
+        served = int(rows[np.argmax(e0[rows] @ q)]) if rows.size else -1
+        misses += int(exact != served)
+    return misses / max(len(query_ids), 1)
+
+
+def _material_drift_params(seed, corpus, config, articles, n_requests=24,
+                           floor=0.15):
+    """Pick drifted serving params whose recall damage provably clears the
+    alerting objective (5% miss rate) with margin, on this plan's exact
+    query sequence. Independent re-inits drift by luck — most keys land a
+    20-60% miss rate, but a benign one can land under the objective and
+    would make the plan assert an alert its own fault never earned. Like
+    `serve_fault_plan` pinning the batch fault to a dispatch that provably
+    happens, the constructor walks a seeded key schedule and takes the
+    first candidate whose predicted miss rate clears `floor`."""
+    import jax
+
+    ids = _drift_query_ids(seed, n_requests)
+    slot = corpus.active
+    best = None
+    for attempt in range(8):
+        cand = init_params(
+            jax.random.PRNGKey(9000 + 97 * attempt + seed), config)
+        e1 = _encode_rows(corpus, cand, articles)
+        rate = _predicted_miss_rate(e1, slot, ids)
+        if best is None or rate > best[0]:
+            best = (rate, cand)
+        if rate >= floor:
+            return cand
+    return best[1]   # most damaging candidate; the plan audit still
+    # demands the alert, so an insufficient drift fails loudly, not silently
+
+
+def _quality_service(seed, family, injected, registry):
+    """Build the family's corpus + shadow-sampling service. The drift
+    family's fault is configuration-level (service params != corpus build
+    params), so `injected` selects the params; the loss family's fault is
+    applied later by the harness."""
+    import jax
+
+    config = DAEConfig(n_features=_N_FEATURES, n_components=_N_COMPONENTS,
+                       enc_act_func="tanh", triplet_strategy="none",
+                       corr_type="masking", corr_frac=0.0)
+    build_params = init_params(jax.random.PRNGKey(7 + seed), config)
+    rng = np.random.default_rng(2000 + seed)
+    articles = rng.random((_N_ARTICLES, _N_FEATURES), dtype=np.float32)
+    if family == "cell-owning-shard-loss":
+        from ..parallel.mesh import get_mesh
+
+        corpus = ServingCorpus(config, block=32, mesh=get_mesh(),
+                               registry=registry, **_LOSS_IVF_KW)
+        probes = _LOSS_PROBES
+        serve_params = build_params
+    else:
+        corpus = ServingCorpus(config, block=32, registry=registry,
+                               **_DRIFT_IVF_KW)
+        probes = _DRIFT_PROBES
+        serve_params = build_params
+    corpus.swap(build_params, articles, note="initial")
+    if family == "churn-drift" and injected:
+        # the drift: a refresh cycle updated the model but the corpus (and
+        # its centroids) still embed the OLD params' space. Like every
+        # chaos plan in this repo, the fault must PROVABLY land — the
+        # constructor verifies the candidate drift is material against the
+        # plan's exact query sequence before serving a single request
+        serve_params = _material_drift_params(seed, corpus, config, articles)
+    service = RecommendationService(
+        serve_params, config, corpus, top_k=1, max_batch=8, max_inflight=32,
+        flush_slack_s=0.02, linger_s=0.002, default_deadline_s=_SLA_S,
+        probes=probes, registry=registry, shadow_rate=1.0, shadow_queue=256,
+        name=f"quality-{family}")
+    service.warmup()
+    return service, articles
+
+
+def _burst(service, articles, rng, n):
+    futures = [service.submit(articles[int(rng.integers(0, _N_ARTICLES))],
+                              deadline_s=_SLA_S) for _ in range(n)]
+    deadline = time.monotonic() + _HARNESS_DEADLINE_S
+    return [f.result(timeout=max(0.0, deadline - time.monotonic()))
+            for f in futures]
+
+
+def run_quality_plan(seed, family, n_requests=24, injected=True, log=None):
+    """Execute one quality plan (or, with `injected=False`, its fault-free
+    reference replay). Returns QualityPlanResult; a plan passes when the
+    family's mapped alert fires iff the fault was injected, the untargeted
+    alerts stay silent, and nothing recompiled after warmup."""
+    assert family in QUALITY_FAMILIES, f"unknown quality family {family!r}"
+    t0 = time.monotonic()
+    registry = MetricsRegistry(name=f"quality-{family}-{seed}")
+    monitor = SLOMonitor(quality_slo_specs())
+    service, articles = _quality_service(seed, family, injected, registry)
+    corpus = service.corpus
+    rng = np.random.default_rng(3000 + seed)
+    problems = []
+    replies = []
+    pre_fired = []
+    try:
+        with compile_guard() as guard:
+            monitor.observe(registry.snapshot())   # pre-traffic baseline
+            replies += _burst(service, articles, rng, max(1, n_requests // 2))
+            service.shadow.flush(timeout=_HARNESS_DEADLINE_S)
+            monitor.observe(registry.snapshot())
+            pre_fired = monitor.evaluate()
+            if injected and family == "cell-owning-shard-loss":
+                # the drift family is degraded from the first request; the
+                # loss family must be CLEAN until the fault actually lands
+                if pre_fired:
+                    problems.append(
+                        "quality alert fired before the fault: "
+                        f"{[a['slo'] for a in pre_fired]}")
+                from ..index import cell_shard_owner
+
+                owners = sorted({int(s) for s in
+                                 cell_shard_owner(corpus.active.ivf)})
+                corpus.inject_shard_loss(owners[seed % len(owners)],
+                                         note="cell-owning shard lost")
+            replies += _burst(service, articles, rng, max(1, n_requests // 2))
+            if not service.shadow.flush(timeout=_HARNESS_DEADLINE_S):
+                problems.append("shadow queue failed to drain")
+            monitor.observe(registry.snapshot())
+            monitor.evaluate()
+    finally:
+        service.stop()
+    if any(r.status != "ok" for r in replies):
+        problems.append("not every request was answered ok")
+    shadow = service.shadow.summary()
+    if shadow["counts"]["errors"]:
+        problems.append(f"{shadow['counts']['errors']} shadow re-score "
+                        "errors")
+    if not shadow["counts"]["scored"]:
+        problems.append("shadow scorer scored nothing")
+    alert_names = [a["slo"] for a in monitor.alerts]
+    target = QUALITY_FAMILY_ALERTS[family]
+    if injected:
+        if target not in alert_names:
+            problems.append(f"injected {family} never fired {target} "
+                            f"(fired: {alert_names or 'nothing'})")
+        if family == "churn-drift" and "quality-coverage" in alert_names:
+            problems.append("drift fired the coverage alert (coverage "
+                            "never dropped)")
+    elif alert_names:
+        problems.append("fault-free reference fired quality alerts: "
+                        f"{alert_names}")
+    if "quality-quant-error" in alert_names:
+        problems.append("float32 corpus fired the quantization-error "
+                        "ceiling (gauge must be absent)")
+    if guard.count > 0:
+        problems.append(f"{guard.count} XLA compiles after warmup — the "
+                        "shadow path must ride warmed variants")
+    gauges = registry.snapshot().get("gauges") or {}
+    result = QualityPlanResult(
+        seed=int(seed), family=family, injected=bool(injected),
+        ok=not problems, detail="; ".join(problems) or "ok",
+        n_replied=sum(1 for r in replies if r.status == "ok"),
+        n_scored=int(shadow["counts"]["scored"]),
+        recall_mean=float(shadow["recall_mean"] or 0.0),
+        min_coverage=float(gauges.get("corpus_coverage", 1.0)),
+        alerts=alert_names,
+        n_post_warm_compiles=int(guard.count),
+        duration_s=round(time.monotonic() - t0, 2))
+    if log:
+        mode = "fault" if injected else "reference"
+        log(f"quality plan {seed} [{family}/{mode}]: "
+            f"{'OK' if result.ok else 'FAIL'} (recall {result.recall_mean}, "
+            f"coverage {result.min_coverage}, alerts {alert_names}) "
+            f"{result.detail}")
+    return result
+
+
+def run_quality_reference(seed, family, n_requests=24, log=None):
+    """The fault-free twin: the family's exact configuration minus its
+    fault. Must raise zero quality alerts."""
+    return run_quality_plan(seed, family, n_requests=n_requests,
+                            injected=False, log=log)
+
+
+def chaos_quality_soak(n_seeds=1, n_requests=24, log=None):
+    """The both-ways quality-alert audit: for each seed, every family runs
+    faulted (its mapped alert MUST fire) and as a fault-free reference
+    (NO quality alert may fire). Returns {"results", "all_ok", ...}."""
+    results = []
+    for seed in range(int(n_seeds)):
+        for family in QUALITY_FAMILIES:
+            results.append(run_quality_plan(seed, family,
+                                            n_requests=n_requests, log=log))
+            results.append(run_quality_reference(seed, family,
+                                                 n_requests=n_requests,
+                                                 log=log))
+    n_ok = sum(1 for r in results if r.ok)
+    return {"results": results, "n_ok": n_ok, "n_plans": len(results),
+            "all_ok": n_ok == len(results)}

@@ -103,6 +103,8 @@ COLLAPSE_CEILING = 0.98
 
 _GATE_SAMPLE = 256  # rows sampled for the collapse gate
 
+_QUANT_SAMPLE = 64  # rows sampled for the swap-time quantization score error
+
 _STAGED = -2  # shard-version sentinel during the prepare phase: visible only
 # on the standby slot (never published), stamped to the real version by the
 # lock-held commit in _promote
@@ -226,7 +228,7 @@ class ServingCorpus:
                  collapse_ceiling=COLLAPSE_CEILING, device_put=None,
                  mesh=None, corpus_dtype="float32", retrieval="exact",
                  n_cells=None, index_seed=0, index_iters=8, imbalance_max=4.0,
-                 reindex_after=3, cell_cap=None):
+                 reindex_after=3, cell_cap=None, registry=None):
         if corpus_dtype not in CORPUS_DTYPES:
             raise ValueError(
                 f"corpus_dtype must be one of {CORPUS_DTYPES}: {corpus_dtype!r}")
@@ -283,6 +285,18 @@ class ServingCorpus:
         self.ledger = []  # append-only version ledger: one record per
         # promote AND per rollback attempt; the chaos_churn soak audits it
         # for version monotonicity + gate coverage
+        self.metrics = registry  # optional telemetry.MetricsRegistry: the
+        # corpus keeps continuous QUALITY gauges current (cell imbalance /
+        # occupancy, staleness since reindex, swap-time quantization score
+        # error, live coverage) so degraded modes are quantified, not just
+        # flagged — the data source for telemetry.quality_slo_specs()
+
+    def attach_registry(self, registry):
+        """Late-bind a MetricsRegistry (mirrors the service's hook, so one
+        registry can carry both the serving and the corpus quality gauges).
+        Gauges publish from the next swap/index/quarantine event on."""
+        self.metrics = registry
+        return registry
 
     # ------------------------------------------------------------ read side
     @property
@@ -483,6 +497,15 @@ class ServingCorpus:
                 "collapse": gate["collapse"],
                 "duration_s": round(time.monotonic() - t0, 4)})
             self.ledger.append(rec)
+        m = self.metrics
+        if m is not None:
+            # the promote is the quality-gauge publish point: whatever slot
+            # a reader can see, the gauges already describe
+            m.gauge("corpus_version").set(standby.version)
+            m.gauge("corpus_coverage").set(standby.coverage)
+            q_err = standby.stats.get("quant_error")
+            if q_err is not None:
+                m.gauge("int8_score_error").set(q_err)
         return standby
 
     def _rollback(self, kind, note, exc, t0):
@@ -659,13 +682,16 @@ class ServingCorpus:
 
         q_emb, scales = quantize_corpus(jnp.asarray(emb_pad),
                                         self.corpus_dtype)
+        q_err = self._quant_score_error(emb_pad, q_emb, scales, n)
         put = self._device_put or jax.device_put
         q_emb = put(q_emb)
         scales = put(scales) if scales is not None else None
         return CorpusSlot(
             emb=q_emb, valid=put(valid), n=n, version=-1, note=note,
             built_s=time.monotonic(), scales=scales, dtype=self.corpus_dtype,
-            ages=slot_ages), n_new, n_evicted
+            ages=slot_ages,
+            stats=(None if q_err is None else {"quant_error": q_err})
+            ), n_new, n_evicted
 
     def _build(self, params, articles, note):
         _faults.fire("serve.swap", note=note)
@@ -675,9 +701,10 @@ class ServingCorpus:
         with self._dispatch_guard():
             # the corpus sharder row-shards any resident leaf whose rows
             # divide the mesh, so this encode can be a multi-device program
-            emb = self._encode_corpus(params, resident, blocks)
-            emb, scales = quantize_corpus(emb, self.corpus_dtype)
+            raw = self._encode_corpus(params, resident, blocks)
+            emb, scales = quantize_corpus(raw, self.corpus_dtype)
             jax.block_until_ready(emb)
+        q_err = self._quant_score_error(raw, emb, scales, n)
         n_pad = blocks.size
         valid = np.zeros(n_pad, np.float32)
         valid[:n] = 1.0
@@ -690,7 +717,32 @@ class ServingCorpus:
             scales = put(scales) if scales is not None else None
         return CorpusSlot(emb=emb, valid=put(valid), n=n, version=-1,
                           note=note, built_s=time.monotonic(),
-                          scales=scales, dtype=self.corpus_dtype)
+                          scales=scales, dtype=self.corpus_dtype,
+                          stats=(None if q_err is None
+                                 else {"quant_error": q_err}))
+
+    def _quant_score_error(self, raw, q_emb, scales, n):
+        """Swap-time quantization SCORE error: max |pairwise cosine
+        difference| between the fp32 embeddings just encoded and their
+        stored (quantized, then dequantized) form, over a small row sample.
+        Measured entirely on HOST copies — zero device programs, so the
+        zero-post-warmup-compile soaks are unaffected (the incremental path
+        already host-copies the whole corpus; this is the same discipline).
+        float32 corpora skip it: no gauge appears and the quantization SLO
+        (`quality-quant-error`) stays silent by absence. Published as gauge
+        `int8_score_error` when the slot promotes."""
+        if self.corpus_dtype == "float32":
+            return None
+        m = int(min(_QUANT_SAMPLE, int(n)))
+        if m < 2:
+            return None
+        ref = np.asarray(jax.device_get(raw), np.float32)[:m]
+        q = np.asarray(jax.device_get(q_emb)).astype(np.float32)[:m]
+        if scales is not None:
+            q = q * np.asarray(jax.device_get(scales),
+                               np.float32)[:m, None]
+        err = np.max(np.abs(ref @ ref.T - q @ q.T))
+        return round(float(err), 8)
 
     def _dispatch_guard(self, *slots):
         """The process-wide collective-dispatch lock (parallel/mesh) when the
@@ -729,9 +781,11 @@ class ServingCorpus:
         ok = finite and np.isfinite(collapse) and (
             collapse <= self.collapse_ceiling)
         norms = np.maximum(np.linalg.norm(host, axis=1, keepdims=True), 1e-12)
-        slot.stats = {"collapse": collapse,
-                      "centroid": np.mean(host / norms, axis=0),
-                      "gate_rows": rows, "gate_tail": bool(tail)}
+        # update, not replace: the build already stashed the swap-time
+        # quantization score error under "quant_error" on non-fp32 corpora
+        slot.stats.update({"collapse": collapse,
+                           "centroid": np.mean(host / norms, axis=0),
+                           "gate_rows": rows, "gate_tail": bool(tail)})
         return {"ok": ok, "finite": finite, "collapse": round(collapse, 6),
                 "ceiling": self.collapse_ceiling, "rows": rows,
                 "tail": bool(tail)}
@@ -803,6 +857,21 @@ class ServingCorpus:
                 "imbalance": round(st["imbalance"], 4),
                 "frac_empty": round(st["frac_empty"], 4),
                 "stale_cycles": self._ivf_stale})
+            stale = self._ivf_stale
+        m = self.metrics
+        if m is not None:
+            # continuous index-quality gauges: every attach (full build,
+            # append re-route, reindex) republishes, so the SLO monitor and
+            # `report --quality` always see the index actually serving
+            m.gauge("ivf_imbalance").set(st["imbalance"])
+            m.gauge("ivf_frac_empty").set(st["frac_empty"])
+            m.gauge("ivf_n_cells").set(st["n_cells"])
+            m.gauge("ivf_stale_cycles").set(stale)
+            occ = m.histogram("ivf_cell_occupancy",
+                              bounds=(8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                                      512.0))
+            for c in st["counts"]:
+                occ.observe(float(c))
 
     def reindex(self, note=""):
         """Refit the IVF centroids on the ACTIVE slot's rows and promote the
@@ -1017,6 +1086,10 @@ class ServingCorpus:
                           f"(coverage {coverage:.3f})"),
                 "active_version": slot.version,
                 "coverage": round(coverage, 4), "note": note})
+        m = self.metrics
+        if m is not None:
+            m.counter("shard_quarantines").inc()
+            m.gauge("corpus_coverage").set(coverage)
         return sorted(lost)
 
     def recover_shards(self, note=""):
@@ -1087,6 +1160,10 @@ class ServingCorpus:
                         "n": len(spans),
                         "versions": [int(v) for v in slot.shard_versions]},
                     "note": note})
+            reg = self.metrics
+            if reg is not None:
+                reg.counter("shard_recoveries").inc()
+                reg.gauge("corpus_coverage").set(1.0)
             return healed
         finally:
             self._swap_busy.release()

@@ -212,6 +212,14 @@ class RecommendationService:
         recorded while tracing is enabled (deterministic every-Nth, 1.0 =
         keep all, 0.0 = none). Sampling applies ONLY to that zero-length
         span: batch spans, registry counters, and replies are unaffected.
+    :param shadow_rate: fraction of replied requests the shadow scorer
+        (serve/shadow.py) re-scores with the exact full-scan path —
+        deterministic every-Nth, asynchronous, off the reply critical path.
+        0.0 (the default) attaches no shadow scorer; the quality metrics
+        land in `registry` and the per-sample records in
+        `service.shadow.summary()`.
+    :param shadow_queue: bounded shadow sample queue depth; a full queue
+        drops samples (counted) rather than ever blocking the batcher.
     """
 
     def __init__(self, params, config, corpus, *, top_k=10,
@@ -219,7 +227,8 @@ class RecommendationService:
                  flush_slack_s=0.02, linger_s=0.005, default_deadline_s=1.0,
                  overload_watermark=0.75, retry=None, fused=True,
                  sharded=None, mesh=None, retrieval=None, probes=8,
-                 name="svc", registry=None, trace_sample_rate=1.0):
+                 name="svc", registry=None, trace_sample_rate=1.0,
+                 shadow_rate=0.0, shadow_queue=64):
         assert int(top_k) >= 1 and int(max_batch) >= 1
         if retrieval is None:
             # follow the corpus: its slots carry an index iff it was built
@@ -308,6 +317,29 @@ class RecommendationService:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"serve-batcher[{self.name}]")
         self._thread.start()
+        self.shadow = None
+        if float(shadow_rate) > 0.0:
+            self.attach_shadow(shadow_rate, max_queue=shadow_queue)
+
+    def attach_shadow(self, rate, *, max_queue=64):
+        """Attach (rate > 0) or detach (rate <= 0) the shadow scorer.
+
+        Call only between bursts — the dispatch loop reads ``self.shadow``
+        without a lock, so toggling while requests are in flight races the
+        offer path. The bench's shadow-overhead leg uses this to run the
+        SAME warmed replicas with sampling on and off; detaching stops the
+        scorer thread and drains its queue first. Returns the new scorer
+        (or None). A re-attach on an already-warm service should be
+        followed by warmup() only when the corpus is IVF — the exact
+        fallback variants are what the shadow path executes."""
+        if self.shadow is not None:
+            self.shadow.stop()
+            self.shadow = None
+        if float(rate) > 0.0:
+            from .shadow import ShadowScorer
+            self.shadow = ShadowScorer(self, rate=float(rate),
+                                       max_queue=int(max_queue))
+        return self.shadow
 
     # ------------------------------------------------------------ admission
     def submit(self, query, deadline_s=None, deadline_at=None,
@@ -515,6 +547,13 @@ class RecommendationService:
         for i, p in enumerate(live):
             self._reply(p, indices[i], scores[i], tags, slot.version,
                         coverage)
+        if self.shadow is not None:
+            # strictly AFTER every primary reply resolved: the shadow offer
+            # is a counter check + put_nowait, and a full shadow queue drops
+            # the sample — the reply path never waits on quality measurement
+            for i, p in enumerate(live):
+                self.shadow.offer(p.rid, batch[i], indices[i], scores[i],
+                                  slot, k, coverage)
 
     def _quarantine_and_redispatch(self, serve_fn, batch, n, slot,
                                    fallback=False):
@@ -702,6 +741,17 @@ class RecommendationService:
             self._fallback_fns[k] = fn
         return fn
 
+    def _shadow_fn(self, k):
+        """The exact full-scan variant the shadow scorer re-scores with: on
+        an exact service this IS the primary variant (same jit cache — zero
+        extra compiles); on an IVF service it is the exact-scoring fallback
+        family (`_fallback_fn`), sharded iff the service is. warmup()
+        pre-compiles these at the shadow's bucket shape whenever a shadow
+        scorer is attached, so sampling never retraces live."""
+        if self.retrieval == "ivf":
+            return self._fallback_fn(k)
+        return self._serve_fns[k]
+
     # ------------------------------------------------------------ lifecycle
     def warmup(self):
         """Compile every (bucket, k) variant — primary AND degraded k, and
@@ -731,6 +781,18 @@ class RecommendationService:
                         out = fn(self.params, *args,
                                  np.zeros((b, f), np.float32))
                         jax.block_until_ready(out)
+                if self.shadow is not None:
+                    # the shadow scorer's exact variants, at its one bucket
+                    # shape — on an exact service these hit the jit cache
+                    # warmed above; on an IVF service they are the fallback
+                    # family, compiled here so a sampled request can never
+                    # retrace post-warmup
+                    sargs = (slot.emb, slot.valid, slot.scales)
+                    for k in sorted({self.top_k, self.degraded_top_k}):
+                        out = self._shadow_fn(k)(
+                            self.params, *sargs,
+                            np.zeros((self.buckets[0], f), np.float32))
+                        jax.block_until_ready(out)
                 # floor := fastest warm repeat of the smallest variant
                 t0 = time.monotonic()
                 out = fns[self.top_k](
@@ -751,6 +813,10 @@ class RecommendationService:
         then exits; anything racing into the queue after is shed explicitly."""
         self._stop.set()
         self._thread.join(timeout=timeout)
+        if self.shadow is not None:
+            # after the batcher: nothing new can be offered, and the shadow
+            # thread drains what it already holds before exiting
+            self.shadow.stop(timeout=timeout)
         if self._post_warm_watcher is not None:
             self._post_warm_watcher.stop()  # .count survives for summary()
         while True:
@@ -798,6 +864,8 @@ class RecommendationService:
                 "lost_shards": list(getattr(self.corpus, "degraded_shards",
                                             ()) or ()),
                 "probes": (self.probes if self.retrieval == "ivf" else None),
+                "shadow": (self.shadow.summary() if self.shadow is not None
+                           else None),
                 "floor_ms": round(self._floor_s * 1e3, 3),
                 "compiles": {
                     "warmup": self._warmup_compiles,

@@ -1,0 +1,324 @@
+"""Shadow scorer: online retrieval-quality measurement for the serving path.
+
+Every quality number the repo had before this module — recall@10, coverage,
+quantization error — was an OFFLINE bench figure; the live fleet observed
+latency, health, and device time, but never what it actually returned. The
+shadow scorer closes that gap: it samples a configurable fraction of live
+requests (deterministic every-Nth, the same discipline as
+`trace_sample_rate`) and asynchronously re-scores them with the EXACT
+(non-IVF, full-scan, fp32-accumulated) path, then compares the exact answer
+against what the request was actually served:
+
+  recall@k            |served ∩ exact-top-k| / |exact-top-k|
+  rank displacement   mean |served rank − exact rank| over the matched rows
+  score delta         mean per-rank score regret (exact − served, clamped ≥ 0)
+
+All three land in the r14 metrics registry (counters + histograms + gauges),
+so the SLO monitor can burn on them (`telemetry.quality_slo_specs`) and
+`telemetry report --quality` can render them.
+
+Design constraints, in order:
+
+  * OFF THE REQUEST CRITICAL PATH. `offer()` is called by the batcher AFTER
+    every primary reply has resolved, and does nothing but a deterministic
+    counter check and a `put_nowait` — a full shadow queue drops the sample
+    (counted, never silent) rather than ever blocking or reordering a reply.
+  * UNDER THE MESH DISPATCH LOCK. The shadow re-score is a device dispatch
+    from a background thread; on a sharded service that is a collective, so
+    it serializes through `parallel.mesh.dispatch_lock` exactly like the
+    batcher, the corpus health gate, and the bench sweeps (the r16 deadlock
+    class; meshcheck S1 lints this site).
+  * ZERO POST-WARM COMPILES. The exact variants the shadow dispatches are
+    compiled inside `RecommendationService.warmup()` (at the shadow's one
+    bucket shape), so a sampled request never triggers a live retrace —
+    the same contract every degraded serving mode honors.
+
+Per-cell probe-hit attribution: when the served slot carries an IVF index,
+each exact-top-k row is mapped to its cell (the replicated `assign` array)
+and its cell's occupancy is observed into a hit or a miss histogram —
+`ivf_probe_hit_cell_rows` / `ivf_probe_miss_cell_rows` — so a recall loss is
+attributable to WHERE the misses live (crowded cells under append skew vs
+sparse cells the probe ordering skips).
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+import jax
+
+from ..parallel.mesh import dispatch_lock
+
+# bounded window of per-sample records kept for summary()/the quality bundle
+_SAMPLE_WINDOW = 512
+
+# histogram bucket bounds (upper edges; +inf overflow implicit)
+RECALL_BOUNDS = (0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0)
+DISPLACEMENT_BOUNDS = (0.0, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+SCORE_DELTA_BOUNDS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.05, 0.1, 0.25)
+CELL_ROWS_BOUNDS = (8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0)
+
+
+class _Sample:
+    __slots__ = ("rid", "query", "indices", "scores", "slot", "k", "coverage")
+
+    def __init__(self, rid, query, indices, scores, slot, k, coverage):
+        self.rid = rid
+        self.query = query
+        self.indices = indices
+        self.scores = scores
+        self.slot = slot
+        self.k = k
+        self.coverage = coverage
+
+
+class ShadowScorer:
+    """Asynchronous exact re-scorer attached to one RecommendationService.
+
+    :param service: the owning RecommendationService — source of the exact
+        serve variants (`_shadow_fn`), the params, the bucket shapes, and
+        the (late-bindable) metrics registry.
+    :param rate: fraction of replied requests sampled (deterministic
+        every-Nth over the reply sequence: 1.0 = every reply, 0.25 = every
+        4th — reproducible across identical request sequences, like
+        `trace_sample_rate`).
+    :param max_queue: bounded sample queue depth; a full queue DROPS the
+        sample (counter `shadow_dropped`) instead of blocking the batcher.
+    """
+
+    def __init__(self, service, *, rate=0.25, max_queue=64):
+        rate = float(rate)
+        assert 0.0 < rate <= 1.0, f"shadow rate must be in (0, 1]: {rate}"
+        self.service = service
+        self.rate = rate
+        self._period = max(1, int(round(1.0 / rate)))
+        self._seen = 0            # replies considered (sampling sequence)
+        self._q = queue.Queue(maxsize=int(max_queue))
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._offered = 0         # samples enqueued
+        self._done = 0            # samples scored, errored — flush() waits
+        self._recalls = []        # bounded recall window (summary mean/min)
+        self.samples = []         # bounded per-sample records, newest last
+        self.counts = {"seen": 0, "sampled": 0, "scored": 0, "dropped": 0,
+                       "errors": 0}
+        self._occupancy = None    # (slot id, version) -> cell occupancy cache
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"shadow-scorer[{service.name}]")
+        self._thread.start()
+
+    # ------------------------------------------------------------ ingestion
+    def offer(self, rid, query, indices, scores, slot, k, coverage=1.0):
+        """Called by the batcher after the primary replies resolved: decide
+        (deterministically) whether this reply is sampled, and if so enqueue
+        a host-side copy for the shadow thread. Never blocks: a full queue
+        drops the sample and counts the drop."""
+        m = self.service.metrics
+        with self._lock:
+            self._seen += 1
+            self.counts["seen"] += 1
+            keep = (self._seen - 1) % self._period == 0
+        if not keep or self._stop.is_set():
+            return False
+        sample = _Sample(rid, np.array(query, np.float32, copy=True),
+                         np.array(indices, copy=True),
+                         np.array(scores, copy=True), slot, int(k),
+                         float(coverage))
+        if m is not None:
+            m.counter("shadow_sampled").inc()
+        try:
+            self._q.put_nowait(sample)
+        except queue.Full:
+            with self._lock:
+                self.counts["dropped"] += 1
+            if m is not None:
+                m.counter("shadow_dropped").inc()
+            return False
+        with self._lock:
+            self.counts["sampled"] += 1
+            self._offered += 1
+        return True
+
+    # --------------------------------------------------------- shadow thread
+    def _loop(self):
+        while True:
+            if self._stop.is_set() and self._q.empty():
+                return
+            try:
+                sample = self._q.get(timeout=0.005)
+            except queue.Empty:
+                continue
+            try:
+                self._score(sample)
+            # nothing is swallowed silently: a failed shadow re-score (a
+            # retired slot's freed buffers, a device fault) is a counted
+            # error and the primary path never notices
+            except Exception as exc:
+                self._record_error(sample, exc)
+
+    def _record_error(self, sample, exc):
+        """A failed re-score surfaces as a counted error with the exception
+        kept on the sample record — operators see it in summary() and the
+        quality bundle; the primary path never notices."""
+        m = self.service.metrics
+        with self._lock:
+            self.counts["errors"] += 1
+            self._done += 1
+            self.samples.append({"rid": sample.rid, "error":
+                                 f"{type(exc).__name__}: {exc}"})
+            del self.samples[:-_SAMPLE_WINDOW]
+        if m is not None:
+            m.counter("shadow_errors").inc()
+
+    def _score(self, sample):
+        svc = self.service
+        k = sample.k
+        fn = svc._shadow_fn(k)
+        bucket = svc.buckets[0]
+        batch = np.zeros((bucket, sample.query.shape[0]), np.float32)
+        batch[0] = sample.query
+        slot = sample.slot
+        # a background-thread device dispatch: on a sharded service this is
+        # a collective program, so it MUST serialize with every other
+        # dispatcher in the process (meshcheck S1's contract)
+        with dispatch_lock(svc.sharded):
+            out = fn(svc.params, slot.emb, slot.valid, slot.scales, batch)
+            jax.block_until_ready(out)
+        exact_sc = np.asarray(out[0])[0][:k]
+        exact_idx = np.asarray(out[1])[0][:k]
+        rec = self._compare(sample, exact_idx, exact_sc)
+        m = svc.metrics
+        if m is not None:
+            m.counter("shadow_scored").inc()
+            m.counter("shadow_expected").inc(rec["expected"])
+            m.counter("shadow_misses").inc(rec["expected"] - rec["hits"])
+            m.gauge("shadow_recall").set(rec["recall"])
+            m.histogram("shadow_recall", bounds=RECALL_BOUNDS).observe(
+                rec["recall"])
+            m.histogram("shadow_rank_displacement",
+                        bounds=DISPLACEMENT_BOUNDS).observe(
+                rec["rank_displacement"])
+            m.histogram("shadow_score_delta",
+                        bounds=SCORE_DELTA_BOUNDS).observe(rec["score_delta"])
+        self._cell_attribution(slot, exact_idx, exact_sc,
+                               np.asarray(sample.indices)[:k])
+        with self._lock:
+            self.counts["scored"] += 1
+            self._done += 1
+            self._recalls.append(rec["recall"])
+            del self._recalls[:-_SAMPLE_WINDOW]
+            self.samples.append(rec)
+            del self.samples[:-_SAMPLE_WINDOW]
+        if m is not None:
+            m.gauge("shadow_recall_mean").set(self.recall_mean())
+
+    def _compare(self, sample, exact_idx, exact_sc):
+        """Per-request quality record: the exact top-k is the reference
+        ranking, the served reply is the candidate. Padding/invalid exact
+        rows (non-finite score) don't count toward the denominator — a
+        corpus smaller than k can still score 1.0."""
+        k = sample.k
+        served_idx = np.asarray(sample.indices)[:k].astype(np.int64)
+        served_sc = np.asarray(sample.scores)[:k].astype(np.float64)
+        finite = np.isfinite(np.asarray(exact_sc, np.float64))
+        exact = [int(r) for r, f in zip(exact_idx, finite) if f]
+        pos = {r: i for i, r in enumerate(exact)}
+        expected = len(exact)
+        disps = [abs(i - pos[int(r)]) for i, r in enumerate(served_idx)
+                 if int(r) in pos]
+        hits = len(disps)
+        recall = hits / expected if expected else 1.0
+        # per-rank score regret vs the best achievable ordering; clamped at
+        # zero so fp jitter in the served direction never reads as "better
+        # than exact" and score_delta stays a one-sided quality loss
+        n = min(len(exact), served_sc.shape[0])
+        regret = [max(0.0, float(exact_sc[i]) - float(served_sc[i]))
+                  for i in range(n) if np.isfinite(served_sc[i])]
+        return {"rid": sample.rid, "k": k, "expected": expected,
+                "hits": hits, "recall": round(recall, 6),
+                "rank_displacement": round(float(np.mean(disps))
+                                           if disps else 0.0, 6),
+                "score_delta": round(float(np.mean(regret))
+                                     if regret else 0.0, 8),
+                "corpus_version": int(getattr(sample.slot, "version", 0)),
+                "coverage": round(sample.coverage, 6)}
+
+    def _cell_attribution(self, slot, exact_idx, exact_sc, served_idx):
+        """Observe each exact-top-k row's CELL occupancy into a hit or a
+        miss histogram (IVF slots only): a miss in a crowded cell points at
+        append skew, a miss in a sparse cell at probe ordering."""
+        m = self.service.metrics
+        ivf = getattr(slot, "ivf", None)
+        if m is None or ivf is None:
+            return
+        counts, assign = self._cell_occupancy(slot, ivf)
+        served = {int(r) for r in np.asarray(served_idx).astype(np.int64)}
+        hit = m.histogram("ivf_probe_hit_cell_rows", bounds=CELL_ROWS_BOUNDS)
+        miss = m.histogram("ivf_probe_miss_cell_rows",
+                           bounds=CELL_ROWS_BOUNDS)
+        for r, sc in zip(np.asarray(exact_idx).astype(np.int64), exact_sc):
+            if not np.isfinite(float(sc)) or not 0 <= r < assign.shape[0]:
+                continue
+            occ = float(counts[assign[r]])
+            (hit if int(r) in served else miss).observe(occ)
+
+    def _cell_occupancy(self, slot, ivf):
+        """Host copies of the slot's row->cell map and per-cell occupancy
+        (index.cell_stats — REAL rows only, padding excluded, both
+        layouts), cached per (slot, version): one device_get per promoted
+        index, not per sample."""
+        key = (id(slot), int(getattr(slot, "version", 0)))
+        cached = self._occupancy
+        if cached is not None and cached[0] == key:
+            return cached[1], cached[2]
+        from ..index import cell_stats
+
+        counts = np.asarray(cell_stats(ivf)["counts"], np.int64)
+        assign = np.asarray(ivf.assign).astype(np.int64)
+        self._occupancy = (key, counts, assign)
+        return counts, assign
+
+    # ------------------------------------------------------------ lifecycle
+    def flush(self, timeout=5.0):
+        """Block until every enqueued sample has been scored (or errored) —
+        the chaos harnesses call this before evaluating quality SLOs, so an
+        assertion never races the shadow thread. Returns True when drained."""
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._done >= self._offered:
+                    return True
+            time.sleep(0.002)
+        with self._lock:
+            return self._done >= self._offered
+
+    def stop(self, timeout=5.0):
+        """Drain and join: the shadow thread scores everything already
+        queued, then exits."""
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+
+    # ------------------------------------------------------------ reporting
+    def recall_mean(self):
+        with self._lock:
+            vals = list(self._recalls)
+        return round(float(np.mean(vals)), 6) if vals else None
+
+    def recall_min(self):
+        with self._lock:
+            vals = list(self._recalls)
+        return round(float(np.min(vals)), 6) if vals else None
+
+    def summary(self):
+        """Manifest/bundle fragment: counts, the recall window stats, and
+        the bounded per-sample record tail."""
+        with self._lock:
+            counts = dict(self.counts)
+            samples = list(self.samples)
+        return {"rate": self.rate, "period": self._period, "counts": counts,
+                "recall_mean": self.recall_mean(),
+                "recall_min": self.recall_min(),
+                "n_samples": len(samples), "samples": samples[-64:]}

@@ -28,7 +28,7 @@ from .metrics_registry import (DEFAULT_LATENCY_BOUNDS_MS, Counter, Gauge,
                                histogram_percentile)
 from .profile_db import ProfileDB, row_key
 from .recorder import FlightRecorder, summarize_batch
-from .slo import SLOMonitor, SLOSpec, serving_slo_specs
+from .slo import SLOMonitor, SLOSpec, quality_slo_specs, serving_slo_specs
 from .tracer import (Tracer, counters, current_tracer, device_fence, disable,
                      enable, enabled, instrument, record_transfer, span)
 from .xla_events import XlaEventListener
@@ -59,6 +59,7 @@ __all__ = [
     "histogram_percentile",
     "instrument",
     "mining_health",
+    "quality_slo_specs",
     "read_manifest",
     "record_transfer",
     "row_key",

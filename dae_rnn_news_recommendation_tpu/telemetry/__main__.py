@@ -2,7 +2,7 @@
 
     report <trace.json> [--metrics PATH] [--bench PATH] [--health PATH]
                         [--churn PATH] [--fleet [PATH]] [--profile [PATH]]
-                        [--json]
+                        [--quality [PATH]] [--json]
 
 Prints the per-span p50/p95/total table (with feed-stall and compile-count
 columns) from a trace exported by a traced fit; optionally joins metrics.jsonl
@@ -48,6 +48,10 @@ def main(argv=None):
                      help="profile_db.json written by devprof/ProfileDB; "
                           "bare --profile (or no flag) auto-detects next "
                           "to the trace")
+    rep.add_argument("--quality", nargs="?", const="auto", default=None,
+                     help="quality_observability.json dumped by "
+                          "dump_quality_observability; bare --quality (or "
+                          "no flag) auto-detects next to the trace")
     rep.add_argument("--json", action="store_true",
                      help="emit the report as JSON instead of a table")
     args = parser.parse_args(argv)
@@ -56,7 +60,8 @@ def main(argv=None):
         text, code = report(args.trace, metrics_path=args.metrics,
                             bench_path=args.bench, health_path=args.health,
                             churn_path=args.churn, fleet_path=args.fleet,
-                            profile_path=args.profile, as_json=args.json)
+                            profile_path=args.profile,
+                            quality_path=args.quality, as_json=args.json)
     except (OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2

@@ -29,6 +29,12 @@ renders a serving-fleet observability bundle (fleet/observability.py
 replica, latency and its timing decomposition), the fleet-aggregate
 counter/gauge rollup, SLO alerts, rollout stages, and the outcome-ledger
 cross-check — auto-detecting `fleet_observability.json` next to the trace.
+`--quality` renders a retrieval-quality bundle (fleet/observability.py
+`dump_quality_observability`) — the shadow scorer's sampled recall /
+rank-displacement / score-delta story, the corpus & index quality gauges
+(live coverage, swap-time quantization error, cell imbalance, staleness),
+and the quality SLO alert history — auto-detecting
+`quality_observability.json` next to the trace.
 
 Optional sections degrade gracefully: an unreadable metrics/bench/health
 input becomes a warning note in the report instead of an error, and a trace
@@ -143,6 +149,19 @@ def load_fleet(path):
     if not isinstance(obj, dict) or not any(
             k in obj for k in ("requests", "registries", "aggregate")):
         raise ValueError(f"{path}: not a fleet observability bundle")
+    return obj
+
+
+def load_quality(path):
+    """A retrieval-quality observability bundle (fleet/observability.py
+    dump_quality_observability): shadow-scorer summary, corpus
+    coverage/ledger tail, registry snapshots + aggregate, quality SLO
+    summary."""
+    with open(path, encoding="utf-8") as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict) or not any(
+            k in obj for k in ("shadow", "registries", "aggregate", "slo")):
+        raise ValueError(f"{path}: not a quality observability bundle")
     return obj
 
 
@@ -415,7 +434,81 @@ def fleet_summary(bundle, max_rows=12):
         # the join check: every router record must be a ledger submission
         if isinstance(ledger.get("n_submitted"), int):
             out["ledger"]["join_ok"] = (ledger["n_submitted"] == len(rows))
+    # aggregate() records keep-first decisions (mismatched histogram bounds
+    # across registries) in "notes" — surface them instead of silently
+    # winning: a skewed fleet histogram merge must be visible in the report
+    if isinstance(agg, dict) and agg.get("notes"):
+        out["aggregate_notes"] = list(agg["notes"])
     return out
+
+
+_QUALITY_GAUGES = ("shadow_recall", "shadow_recall_mean", "corpus_coverage",
+                   "int8_score_error", "ivf_imbalance", "ivf_frac_empty",
+                   "ivf_n_cells", "ivf_stale_cycles", "corpus_staleness")
+
+
+def quality_summary(bundle):
+    """Join a quality observability bundle into the retrieval-quality
+    story: the shadow scorer's sample counts and recall window, the quality
+    gauges (live coverage, quantization error, index shape/staleness), the
+    shadow counters the recall SLO burns on, and the quality alert
+    history."""
+    if not bundle:
+        return None
+    out = {}
+    shadow = bundle.get("shadow")
+    if isinstance(shadow, dict):
+        counts = shadow.get("counts") or {}
+        out["shadow"] = {
+            "rate": shadow.get("rate"),
+            "counts": counts,
+            "recall_mean": shadow.get("recall_mean"),
+            "recall_min": shadow.get("recall_min"),
+            "n_samples": shadow.get("n_samples"),
+        }
+        worst = sorted((s for s in shadow.get("samples") or []
+                        if isinstance(s.get("recall"), (int, float))),
+                       key=lambda s: s["recall"])[:5]
+        if worst:
+            out["shadow"]["worst_samples"] = [
+                {"rid": s.get("rid"), "recall": s.get("recall"),
+                 "rank_displacement": s.get("rank_displacement"),
+                 "score_delta": s.get("score_delta"),
+                 "corpus_version": s.get("corpus_version")}
+                for s in worst]
+    corpus = bundle.get("corpus")
+    if isinstance(corpus, dict):
+        out["coverage"] = corpus.get("coverage")
+        ledger = corpus.get("ledger") or []
+        out["corpus_versions"] = len(ledger)
+    agg = bundle.get("aggregate")
+    if isinstance(agg, dict):
+        gauges = {}
+        for name in _QUALITY_GAUGES:
+            g = (agg.get("gauges") or {}).get(name)
+            if g is None:
+                continue
+            gauges[name] = (round(g["mean"], 4)
+                            if isinstance(g, dict) and "mean" in g else g)
+        if gauges:
+            out["gauges"] = gauges
+        counters = {k: v for k, v in (agg.get("counters") or {}).items()
+                    if k.startswith("shadow_") or k.startswith("shard_")}
+        if counters:
+            out["counters"] = counters
+        if agg.get("notes"):
+            out["aggregate_notes"] = list(agg["notes"])
+    slo = bundle.get("slo")
+    if isinstance(slo, dict):
+        out["alerts"] = [
+            {"slo": a.get("slo"), "kind": a.get("kind"), "t": a.get("t"),
+             "value": a.get("value"),
+             "short_burn": a.get("short_burn"),
+             "long_burn": a.get("long_burn")}
+            for a in slo.get("alerts") or []]
+        out["n_specs"] = len(slo.get("specs") or [])
+        out["active_alerts"] = slo.get("active") or []
+    return out or None
 
 
 def profile_summary(dump, top=10):
@@ -564,6 +657,66 @@ def _render_fleet(fleet, lines):
             line += ("  [join ok]" if ledger["join_ok"]
                      else "  [JOIN MISMATCH vs request table]")
         lines.append(line)
+    for note in fleet.get("aggregate_notes") or ():
+        lines.append(f"  aggregate note: {note}")
+
+
+def _render_quality(quality, lines):
+    shadow = quality.get("shadow")
+    if shadow:
+        counts = shadow.get("counts") or {}
+        lines.append(
+            "retrieval quality: shadow rate "
+            f"{shadow.get('rate')}, {counts.get('scored', 0)} scored / "
+            f"{counts.get('sampled', 0)} sampled / "
+            f"{counts.get('seen', 0)} seen "
+            f"(dropped {counts.get('dropped', 0)}, "
+            f"errors {counts.get('errors', 0)})")
+        lines.append(f"  shadow recall: mean {shadow.get('recall_mean')}  "
+                     f"min {shadow.get('recall_min')}  over "
+                     f"{shadow.get('n_samples')} samples")
+        worst = shadow.get("worst_samples") or []
+        if worst:
+            lines.append("  worst samples (rid / recall / rank disp / "
+                         "score delta / corpus v):")
+            for s in worst:
+                lines.append(
+                    f"    {str(s.get('rid')):<14} {s.get('recall'):>7} "
+                    f"{s.get('rank_displacement'):>9} "
+                    f"{s.get('score_delta'):>11} "
+                    f"v{s.get('corpus_version')}")
+    else:
+        lines.append("retrieval quality:")
+    if quality.get("coverage") is not None:
+        line = f"  live coverage: {quality['coverage']}"
+        if quality.get("corpus_versions"):
+            line += f"  (ledger: {quality['corpus_versions']} records)"
+        lines.append(line)
+    if quality.get("gauges"):
+        items = ", ".join(f"{k}={v}" for k, v in
+                          sorted(quality["gauges"].items()))
+        lines.append(f"  quality gauges: {items}")
+    if quality.get("counters"):
+        items = ", ".join(f"{k}={v}" for k, v in
+                          sorted(quality["counters"].items()))
+        lines.append(f"  shadow counters: {items}")
+    if "alerts" in quality:
+        alerts = quality["alerts"]
+        if alerts:
+            names = ", ".join(
+                f"{a['slo']}"
+                + (f" (burn {a['short_burn']})"
+                   if a.get("short_burn") is not None
+                   else (f" (value {a['value']})"
+                         if a.get("value") is not None else ""))
+                for a in alerts)
+            lines.append(f"  quality alerts ({quality.get('n_specs', '?')} "
+                         f"specs): {names}")
+        else:
+            lines.append(f"  quality alerts: none "
+                         f"({quality.get('n_specs', '?')} specs quiet)")
+    for note in quality.get("aggregate_notes") or ():
+        lines.append(f"  aggregate note: {note}")
 
 
 def _fmt_quantity(v):
@@ -604,7 +757,7 @@ def _render_profile(profile, lines):
 
 def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
                 health=None, faults=None, churn=None, fleet=None,
-                profile=None, notes=None):
+                profile=None, quality=None, notes=None):
     lines = []
     if manifest:
         lines.append("run: git %s  backend=%s  feed=%s  created %s" % (
@@ -726,6 +879,9 @@ def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
     if fleet:
         lines.append("")
         _render_fleet(fleet, lines)
+    if quality:
+        lines.append("")
+        _render_quality(quality, lines)
     if profile:
         lines.append("")
         _render_profile(profile, lines)
@@ -734,7 +890,7 @@ def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
 
 def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
            churn_path=None, fleet_path=None, profile_path=None,
-           as_json=False):
+           quality_path=None, as_json=False):
     """Build the report. Returns (text, exit_code).
 
     The trace is the report's backbone — an unreadable trace still raises
@@ -749,8 +905,9 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
     SILENT when it isn't there (an r12-era run directory renders exactly as
     before); the sentinel "auto" (the CLI's bare `--fleet`) also auto-detects
     but notes the absence, since the section was explicitly asked for.
-    `profile_path` (a ProfileDB file, default name `profile_db.json`)
-    follows the same sentinel contract."""
+    `profile_path` (a ProfileDB file, default name `profile_db.json`) and
+    `quality_path` (a retrieval-quality bundle, default name
+    `quality_observability.json`) follow the same sentinel contract."""
     trace = load_trace(trace_path)
     rows = span_table(trace)
     meta = trace.get("metadata", {}) or {}
@@ -819,6 +976,19 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
             profile_path = None
     profile = profile_summary(optional(profile_path, load_profile,
                                        "profile DB"))
+    if quality_path in (None, "auto"):
+        cand = os.path.join(os.path.dirname(os.path.abspath(trace_path)),
+                            "quality_observability.json")
+        if os.path.exists(cand):
+            quality_path = cand
+        elif quality_path == "auto":
+            notes.append("quality bundle unavailable, section skipped "
+                         "(no quality_observability.json next to trace)")
+            quality_path = None
+        else:
+            quality_path = None
+    quality = quality_summary(optional(quality_path, load_quality,
+                                       "quality bundle"))
     faults = faults_summary(manifest)
     if as_json:
         return json.dumps({"spans": rows, "counters": counters,
@@ -826,12 +996,13 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
                            "bench": bench, "health": health,
                            "faults": faults, "churn": churn,
                            "fleet": fleet, "profile": profile,
+                           "quality": quality,
                            "notes": notes or None},
                           indent=2, default=str), 0
     if not rows and not (metrics or bench or health or churn or fleet
-                         or profile):
+                         or profile or quality):
         return "no span events in trace", 1
     return render_text(rows, counters=counters, manifest=manifest,
                        metrics=metrics, bench=bench, health=health,
                        faults=faults, churn=churn, fleet=fleet,
-                       profile=profile, notes=notes), 0
+                       profile=profile, quality=quality, notes=notes), 0

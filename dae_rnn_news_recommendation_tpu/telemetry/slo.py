@@ -45,7 +45,10 @@ class SLOSpec:
     :param name: stable alert id ("deadline-miss-rate", "hedge-faults").
     :param kind: "rate_max" (numerator/denominator counters, objective is
         the max acceptable ratio; objective 0.0 = the event must never
-        happen), "gauge_min" (gauge must stay >= objective),
+        happen), "gauge_min" (gauge must stay >= objective), "gauge_max"
+        (gauge must stay <= objective — a quality CEILING such as the
+        swap-time quantization score error; evaluated on the aggregate's
+        worst/`max` value, and an absent gauge never breaches),
         "latency_max" (histogram percentile must stay <= objective, in the
         histogram's own unit), or "gauge_growth_max" (the gauge's
         long-window GROWTH — latest minus window baseline — must stay <=
@@ -77,8 +80,8 @@ class SLOSpec:
     slow_burn: float = 1.0
 
     def __post_init__(self):
-        assert self.kind in ("rate_max", "gauge_min", "latency_max",
-                             "gauge_growth_max"), (
+        assert self.kind in ("rate_max", "gauge_min", "gauge_max",
+                             "latency_max", "gauge_growth_max"), (
             f"unknown SLO kind {self.kind!r}")
         assert self.short_window_s <= self.long_window_s
 
@@ -139,6 +142,8 @@ class SLOMonitor:
             return self._eval_rate(spec, ring, now)
         if spec.kind == "gauge_min":
             return self._eval_gauge(spec, ring, now)
+        if spec.kind == "gauge_max":
+            return self._eval_gauge_max(spec, ring, now)
         if spec.kind == "gauge_growth_max":
             return self._eval_gauge_growth(spec, ring, now)
         return self._eval_latency(spec, ring, now)
@@ -198,6 +203,20 @@ class SLOMonitor:
         _, (t1, last) = self._window(ring, now, spec.long_window_s)
         val = self._gauge_in(last, spec.gauge)
         breached = val is not None and float(val) < spec.objective
+        return {"breached": breached,
+                "evidence": {"gauge": spec.gauge,
+                             "value": None if val is None else round(
+                                 float(val), 6)}}
+
+    def _eval_gauge_max(self, spec, ring, now):
+        """The quality-ceiling mirror of gauge_min: breach when the gauge
+        RISES past the objective, judged on the aggregate's worst (`max`)
+        component. An absent gauge never breaches — a float32 corpus
+        publishes no quantization error, so the ceiling stays silent by
+        absence."""
+        _, (t1, last) = self._window(ring, now, spec.long_window_s)
+        val = self._gauge_peak(last, spec.gauge)
+        breached = val is not None and float(val) > spec.objective
         return {"breached": breached,
                 "evidence": {"gauge": spec.gauge,
                              "value": None if val is None else round(
@@ -307,4 +326,39 @@ def serving_slo_specs(*, deadline_miss_max=0.05, shed_max=0.05,
         SLOSpec("device-memory-growth", "gauge_growth_max",
                 float(memory_growth_bytes_max), gauge="hbm_bytes_in_use",
                 **w),
+    )
+
+
+def quality_slo_specs(*, recall_miss_max=0.05, coverage_floor=0.99,
+                      quant_error_max=0.05,
+                      short_window_s=60.0, long_window_s=300.0):
+    """The retrieval-quality SLO set fed by the shadow scorer and the
+    corpus quality gauges (serve/shadow.py, ServingCorpus):
+
+    - ``quality-recall``: windowed recall burn-rate. The shadow scorer
+      counts every exact-top-k row it expected (`shadow_expected`) and
+      every one the served shortlist missed (`shadow_misses`); the miss
+      RATIO must stay under `recall_miss_max` in both windows. With no
+      shadow samples in the window the denominator is zero and the spec
+      stays silent — quality alerting is pass-by-absence like every
+      other optional signal.
+    - ``quality-coverage``: live row coverage floor over the
+      `corpus_coverage` gauge the corpus publishes at promote /
+      quarantine / recover time. Named distinctly from the serving
+      "corpus-coverage" spec so a fleet run can carry both sets without
+      colliding in alert history.
+    - ``quality-quant-error``: ceiling on the swap-time int8 score error
+      (`int8_score_error` gauge, measured against the fp32 reference
+      Gram matrix at build time). float32 corpora never publish the
+      gauge, so the ceiling is silent by absence.
+    """
+    w = {"short_window_s": short_window_s, "long_window_s": long_window_s}
+    return (
+        SLOSpec("quality-recall", "rate_max", float(recall_miss_max),
+                numerator="shadow_misses", denominator="shadow_expected",
+                fast_burn=1.0, slow_burn=1.0, **w),
+        SLOSpec("quality-coverage", "gauge_min", float(coverage_floor),
+                gauge="corpus_coverage", **w),
+        SLOSpec("quality-quant-error", "gauge_max", float(quant_error_max),
+                gauge="int8_score_error", **w),
     )

@@ -330,6 +330,12 @@ FLEET_TRACING_OVERHEAD_MAX = 0.03
 # (fenced best-of-N both legs, telemetry/devprof.measure), and the wrapped
 # leg may cost at most this fraction of the bare throughput.
 PROFILE_OVERHEAD_MAX = 0.01
+# ISSUE 19: shadow re-scoring must stay off the request critical path — the
+# bench races the same Zipf trace through the same warmed replicas with
+# 100% shadow sampling on, and the shadow leg may trail the bare fleet_qps
+# by at most this fraction. Tighter than tracing: the exact re-score rides
+# the scorer's own thread strictly after every primary reply resolves.
+SHADOW_OVERHEAD_MAX = 0.02
 
 
 def _bench_history():
@@ -418,48 +424,72 @@ def _bench_trajectory_gate():
     return True, detail
 
 
-def _fleet_tracing_overhead_gate():
-    """(ok, detail) for the tracing-overhead check: the LATEST bench record
-    carrying both legs of the race must keep `fleet_qps_traced` within
-    FLEET_TRACING_OVERHEAD_MAX of `fleet_qps`. Pass-by-absence like the
-    trajectory gate: a history without the race (pre-r14 records) is a note,
-    not a failure — the gate fails only on a measured slowdown."""
+def _overhead_race_gate(bare_field, loaded_field, max_overhead, *,
+                        race_name, bare_label, loaded_label):
+    """Shared pass-by-absence gate for the bench's instrumentation races.
+
+    Three gates ride this one shape (tracing, profiling-off, shadow): the
+    LATEST bench record carrying both legs of a race must keep the loaded
+    leg's throughput within `max_overhead` of the bare leg's. A history
+    without the race (records predating it) is a note, not a failure —
+    "absent record passes, present record must meet the threshold". The
+    gate fails only on a measured slowdown; it never recomputes anything.
+
+    :param bare_field: extra-dict field of the uninstrumented leg (> 0).
+    :param loaded_field: extra-dict field of the instrumented leg (> 0).
+    :param max_overhead: max allowed `1 - loaded / bare` fraction.
+    :param race_name: short race id for the pass-by-absence note.
+    :param bare_label: human label for the bare figure in the detail line.
+    :param loaded_label: human label for the loaded figure.
+    """
     hist = _bench_history()
     for name, extra in reversed(hist):
-        bare, traced = extra.get("fleet_qps"), extra.get("fleet_qps_traced")
+        bare, loaded = extra.get(bare_field), extra.get(loaded_field)
         if (isinstance(bare, (int, float)) and bare > 0
-                and isinstance(traced, (int, float)) and traced > 0):
-            overhead = 1.0 - float(traced) / float(bare)
-            ok = overhead <= FLEET_TRACING_OVERHEAD_MAX
-            return ok, (f"{name}: fleet_qps_traced {traced} vs fleet_qps "
-                        f"{bare} — tracing overhead {overhead:.2%} "
-                        f"{'<=' if ok else '>'} "
-                        f"{FLEET_TRACING_OVERHEAD_MAX:.0%}")
-    return True, ("no bench record carries the fleet_qps_traced race yet — "
+                and isinstance(loaded, (int, float)) and loaded > 0):
+            overhead = 1.0 - float(loaded) / float(bare)
+            ok = overhead <= max_overhead
+            return ok, (f"{name}: {loaded_label} {loaded} vs {bare_label} "
+                        f"{bare} — overhead {overhead:.2%} "
+                        f"{'<=' if ok else '>'} {max_overhead:.0%}")
+    return True, (f"no bench record carries the {race_name} race yet — "
                   "pass by absence, not by measurement")
+
+
+def _fleet_tracing_overhead_gate():
+    """(ok, detail): the latest bench record carrying both legs of the
+    tracing race must keep `fleet_qps_traced` within
+    FLEET_TRACING_OVERHEAD_MAX of `fleet_qps` (pre-r14 histories pass by
+    absence)."""
+    return _overhead_race_gate(
+        "fleet_qps", "fleet_qps_traced", FLEET_TRACING_OVERHEAD_MAX,
+        race_name="fleet_qps_traced", bare_label="fleet_qps",
+        loaded_label="fleet_qps_traced (tracing on)")
 
 
 def _profile_overhead_gate():
-    """(ok, detail) for the disabled-profiling overhead check: the LATEST
-    bench record carrying both legs of the devprof race must keep the
-    instrumented-disabled train-step throughput within PROFILE_OVERHEAD_MAX
-    of the bare leg. Pass-by-absence like the tracing gate: a history without
-    the race (pre-r18 records) is a note, not a failure — the gate fails only
-    on a measured slowdown."""
-    hist = _bench_history()
-    for name, extra in reversed(hist):
-        bare = extra.get("profile_overhead_bare_aps")
-        instr = extra.get("profile_overhead_instrumented_aps")
-        if (isinstance(bare, (int, float)) and bare > 0
-                and isinstance(instr, (int, float)) and instr > 0):
-            overhead = 1.0 - float(instr) / float(bare)
-            ok = overhead <= PROFILE_OVERHEAD_MAX
-            return ok, (f"{name}: instrumented-disabled step {instr} aps vs "
-                        f"bare {bare} aps — profiling-off overhead "
-                        f"{overhead:.2%} {'<=' if ok else '>'} "
-                        f"{PROFILE_OVERHEAD_MAX:.0%}")
-    return True, ("no bench record carries the devprof overhead race yet — "
-                  "pass by absence, not by measurement")
+    """(ok, detail): the latest bench record carrying both legs of the
+    devprof race must keep the instrumented-disabled train-step throughput
+    within PROFILE_OVERHEAD_MAX of the bare leg (pre-r18 histories pass by
+    absence). The zero-host-sync half of the contract is pinned by the
+    fetch-count + compile_guard regression test in tests/test_profile.py."""
+    return _overhead_race_gate(
+        "profile_overhead_bare_aps", "profile_overhead_instrumented_aps",
+        PROFILE_OVERHEAD_MAX,
+        race_name="devprof overhead", bare_label="bare aps",
+        loaded_label="instrumented-disabled aps")
+
+
+def _shadow_overhead_gate():
+    """(ok, detail): the latest bench record carrying both legs of the
+    shadow race must keep `fleet_qps_shadow` (100% shadow sampling, exact
+    re-score on the scorer's own thread) within SHADOW_OVERHEAD_MAX of
+    `fleet_qps` (pre-r19 histories pass by absence). The never-blocks /
+    never-reorders half of the contract is pinned by tests/test_shadow.py."""
+    return _overhead_race_gate(
+        "fleet_qps", "fleet_qps_shadow", SHADOW_OVERHEAD_MAX,
+        race_name="fleet_qps_shadow", bare_label="fleet_qps",
+        loaded_label="fleet_qps_shadow (100% sampling)")
 
 
 def main(argv=None):
@@ -1112,6 +1142,13 @@ def main(argv=None):
     # fetch-count + compile_guard regression test in tests/test_profile.py.
     prof_ok, prof_detail = _profile_overhead_gate()
     check("profile_overhead_lt_1pct", prof_ok, prof_detail)
+    # ISSUE 19: shadow re-scoring (serve/shadow.py) samples live replies and
+    # re-scores them with the exact path on its own thread — the bench races
+    # the same trace with 100% sampling on, and the shadow leg may trail the
+    # bare qps by at most 2%. Same pass-by-absence shape as the two gates
+    # above (_overhead_race_gate).
+    shadow_ok, shadow_detail = _shadow_overhead_gate()
+    check("shadow_overhead_lt_2pct", shadow_ok, shadow_detail)
     check("user_category_top1", user["category_top1_accuracy"] > 0.6,
           f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.6 "
           "(chance ~1/8; scored against 5-candidate category means — one "

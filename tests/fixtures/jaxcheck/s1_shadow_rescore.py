@@ -1,0 +1,71 @@
+"""S1 fixture (ISSUE 19): the shadow scorer's exact re-score is a device
+dispatch from a BACKGROUND thread — on a sharded service it is a collective
+program, so dispatching it without the process-wide mesh dispatch lock can
+interleave with the batcher's own collective and deadlock the mesh (the
+r16 bug class serve/shadow.py exists to never reintroduce). Clean twins
+wrap the re-score in `with dispatch_lock():` — the sanctioned idiom the
+real ShadowScorer._score uses.
+"""
+
+import threading
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dae_rnn_news_recommendation_tpu.parallel.mesh import dispatch_lock
+
+MESH_AXIS_NAMES = ("data",)
+
+
+def make_exact_rescore(mesh):
+    """Factory: the exact full-scan top-k as a collective (never
+    dispatches it here)."""
+
+    def local(emb, q):
+        scores = emb @ q.T
+        return jax.lax.psum(scores, "data")
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=(P("data", None), P(None, None)),
+                     out_specs=P(None, None))
+
+
+class ShadowRescorer:
+    """One scorer shared with the batcher thread; offer() feeds a queue the
+    scorer thread drains (it owns a lock -> thread-shared)."""
+
+    def __init__(self, mesh):
+        self._lock = threading.Lock()
+        self._fn = make_exact_rescore(mesh)
+
+    def rescore(self, emb, q):
+        return self._fn(emb, q)               # planted: S1
+
+    def rescore_guarded(self, emb, q):
+        # the real shadow path: a background-thread collective serializes
+        # with every other dispatcher in the process
+        with dispatch_lock():
+            return self._fn(emb, q)
+
+
+def shadow_worker(mesh, emb, q):
+    """Runs on the scorer thread (see start_shadow) — bare dispatch."""
+    fn = make_exact_rescore(mesh)
+    return fn(emb, q)                         # planted: S1
+
+
+def shadow_worker_guarded(mesh, emb, q):
+    fn = make_exact_rescore(mesh)
+    with dispatch_lock():
+        return fn(emb, q)
+
+
+def start_shadow(mesh, emb, q):
+    t = threading.Thread(target=shadow_worker, args=(mesh, emb, q),
+                         daemon=True)
+    t.start()
+    u = threading.Thread(target=shadow_worker_guarded,
+                         args=(mesh, emb, q), daemon=True)
+    u.start()
+    return t, u

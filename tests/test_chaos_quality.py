@@ -1,0 +1,75 @@
+"""Chaos-quality: the both-ways retrieval-quality alert contract (ISSUE 19).
+
+Every plan (serve/chaos_quality.py) runs a real sharded/IVF service with
+100% shadow sampling and the quality SLO monitor attached. Tier-1 smokes
+one fault plan plus its fault-free reference per family; the multi-seed
+soak is `slow`.
+
+The contract, both ways:
+  * cell-owning-shard-loss fires `quality-coverage` (and was provably
+    clean BEFORE the fault);
+  * churn-drift (serving params drifted from the corpus build params,
+    materiality-verified at plan construction) fires `quality-recall`
+    while coverage stays pinned at 1.0 — `quality-coverage` must NOT fire;
+  * the fault-free reference replay fires NOTHING, with shadow recall
+    exactly 1.0 (structural: each query is a corpus row's own features,
+    and kmeans assigns every row to its nearest final centroid, so the
+    probed cell always contains the exact top-1);
+  * `quality-quant-error` stays silent everywhere (fp32 corpora);
+  * zero post-warm compiles in every plan — the shadow path never
+    retraces live.
+"""
+
+import pytest
+
+from dae_rnn_news_recommendation_tpu.serve import (QUALITY_FAMILIES,
+                                                   chaos_quality_soak,
+                                                   run_quality_plan,
+                                                   run_quality_reference)
+
+
+def test_quality_families_map_onto_the_fleet_alert_contract():
+    from dae_rnn_news_recommendation_tpu.fleet import QUALITY_FAMILY_ALERTS
+    assert set(QUALITY_FAMILIES) == set(QUALITY_FAMILY_ALERTS) == {
+        "cell-owning-shard-loss", "churn-drift"}
+    assert set(QUALITY_FAMILY_ALERTS.values()) == {
+        "quality-coverage", "quality-recall"}
+
+
+@pytest.mark.parametrize("family", QUALITY_FAMILIES)
+def test_quality_fault_plan_fires_the_mapped_alert(family):
+    result = run_quality_plan(0, family, n_requests=24)
+    assert result.ok, result.detail
+    assert result.injected
+    assert result.n_scored > 0
+    assert result.n_post_warm_compiles == 0
+    fired = set(result.alerts)
+    if family == "cell-owning-shard-loss":
+        assert "quality-coverage" in fired
+        assert result.min_coverage < 1.0
+    else:
+        assert "quality-recall" in fired
+        assert "quality-coverage" not in fired
+        assert result.min_coverage == 1.0
+        assert result.recall_mean < 1.0
+    assert "quality-quant-error" not in fired
+
+
+@pytest.mark.parametrize("family", QUALITY_FAMILIES)
+def test_quality_reference_replay_is_silent(family):
+    result = run_quality_reference(0, family, n_requests=24)
+    assert result.ok, result.detail
+    assert not result.injected
+    assert result.alerts == []
+    assert result.recall_mean == 1.0
+    assert result.min_coverage == 1.0
+    assert result.n_post_warm_compiles == 0
+
+
+@pytest.mark.slow
+def test_chaos_quality_full_soak():
+    out = chaos_quality_soak(n_seeds=3, n_requests=24)
+    failing = [r.detail for r in out["results"] if not r.ok]
+    assert out["all_ok"], failing
+    # n_seeds x |families| x {fault, reference}
+    assert out["n_ok"] == out["n_plans"] == 3 * len(QUALITY_FAMILIES) * 2

@@ -115,6 +115,64 @@ def test_gauge_min_fires_below_floor_and_reads_aggregate_min():
     assert fired[0]["value"] == 0.5
 
 
+def test_gauge_max_fires_above_ceiling_and_reads_aggregate_max():
+    """gauge_max is gauge_min's mirror (ISSUE 19: the int8 score-error
+    ceiling): the WORST replica is the aggregate max, an absent gauge never
+    breaches, and recovery closes the episode."""
+    clk = {"t": 0.0}
+    spec = SLOSpec("quant", "gauge_max", 0.05, gauge="int8_score_error",
+                   short_window_s=10.0, long_window_s=10.0)
+    mon = SLOMonitor([spec], clock=_clock(clk))
+    # absent gauge: pass by absence, never a breach
+    mon.observe(_snap(gauges={}))
+    assert mon.evaluate() == []
+    clk["t"] = 1.0
+    mon.observe(_snap(gauges={"int8_score_error":
+                              {"min": 0.001, "max": 0.01, "mean": 0.004}}))
+    assert mon.evaluate() == []
+    # one replica's error spikes past the ceiling -> aggregate max breaches
+    clk["t"] = 2.0
+    mon.observe(_snap(gauges={"int8_score_error":
+                              {"min": 0.001, "max": 0.2, "mean": 0.05}}))
+    fired = mon.evaluate()
+    assert [a["slo"] for a in fired] == ["quant"]
+    assert fired[0]["value"] == 0.2
+    # sustained breach: same episode, no second alert
+    clk["t"] = 3.0
+    mon.observe(_snap(gauges={"int8_score_error": {"max": 0.2}}))
+    assert mon.evaluate() == []
+    # recovery (raw-value gauge form): the episode closes
+    clk["t"] = 4.0
+    mon.observe(_snap(gauges={"int8_score_error": 0.01}))
+    assert mon.evaluate() == []
+    assert mon.summary()["active"] == []
+
+
+def test_quality_specs_cover_recall_coverage_and_quant_error():
+    """quality_slo_specs wires the ISSUE 19 trio: shadow-miss burn rate,
+    coverage floor, quantization-error ceiling — and a quiet fleet fires
+    none of them."""
+    from dae_rnn_news_recommendation_tpu.telemetry import quality_slo_specs
+    clk = {"t": 0.0}
+    mon = SLOMonitor(quality_slo_specs(), clock=_clock(clk))
+    assert {s.name for s in mon.specs} == {
+        "quality-recall", "quality-coverage", "quality-quant-error"}
+    mon.observe(_snap(counters={"shadow_misses": 0, "shadow_expected": 0},
+                      gauges={"corpus_coverage": 1.0,
+                              "int8_score_error": 0.001}))
+    clk["t"] = 1.0
+    mon.observe(_snap(counters={"shadow_misses": 0, "shadow_expected": 40},
+                      gauges={"corpus_coverage": 1.0,
+                              "int8_score_error": 0.001}))
+    assert mon.evaluate() == []
+    # a burst of shadow misses past the 5% objective fires quality-recall
+    clk["t"] = 2.0
+    mon.observe(_snap(counters={"shadow_misses": 10, "shadow_expected": 80},
+                      gauges={"corpus_coverage": 1.0,
+                              "int8_score_error": 0.001}))
+    assert [a["slo"] for a in mon.evaluate()] == ["quality-recall"]
+
+
 def test_latency_percentile_evaluated_on_window_delta():
     clk = {"t": 0.0}
     spec = SLOSpec("p95", "latency_max", 100.0,

@@ -411,3 +411,118 @@ def test_report_degrades_gracefully_on_r12_era_layout(tmp_path, capsys):
     assert rc == 0
     assert "fleet bundle unavailable" in out
     assert "serving fleet" not in out
+
+
+def test_report_cli_quality_flag_renders_bundle(tmp_path, capsys):
+    """`report --quality PATH` renders the retrieval-quality section from a
+    dump_quality_observability bundle: the shadow recall story, worst
+    samples, coverage, the quality gauges, and the alert history."""
+    bundle = {
+        "shadow": {
+            "rate": 1.0, "period": 1,
+            "counts": {"seen": 20, "sampled": 20, "scored": 20,
+                       "dropped": 0, "errors": 0},
+            "recall_mean": 0.85, "recall_min": 0.4, "n_samples": 20,
+            "samples": [
+                {"rid": "q-7", "k": 10, "expected": 10, "hits": 4,
+                 "recall": 0.4, "rank_displacement": 2.5,
+                 "score_delta": 0.012, "corpus_version": 3,
+                 "coverage": 1.0},
+                {"rid": "q-8", "k": 10, "expected": 10, "hits": 10,
+                 "recall": 1.0, "rank_displacement": 0.0,
+                 "score_delta": 0.0, "corpus_version": 3,
+                 "coverage": 1.0}]},
+        "corpus": {"coverage": 0.75,
+                   "ledger": [{"note": "initial"}, {"note": "lost"}]},
+        "registries": [{"registry": "svc", "counters": {}, "gauges": {},
+                        "histograms": {}}],
+        "aggregate": {"registry": "fleet", "n_sources": 1,
+                      "counters": {"shadow_misses": 12,
+                                   "shadow_expected": 200, "replied": 20},
+                      "gauges": {"corpus_coverage": 0.75,
+                                 "int8_score_error": 0.003},
+                      "histograms": {}},
+        "slo": {"specs": [{"name": "quality-recall"},
+                          {"name": "quality-coverage"},
+                          {"name": "quality-quant-error"}],
+                "alerts": [{"slo": "quality-coverage", "kind": "gauge_min",
+                            "t": 4.0, "value": 0.75, "short_burn": None,
+                            "long_burn": None}],
+                "active": ["quality-coverage"], "n_observations": 3},
+    }
+    (tmp_path / "quality_observability.json").write_text(json.dumps(bundle))
+    trace = tmp_path / "trace.json"
+    trace.write_text('{"traceEvents": []}')
+    rc = cli_main(["report", str(trace), "--quality",
+                   str(tmp_path / "quality_observability.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "retrieval quality: shadow rate 1.0, 20 scored" in out
+    assert "shadow recall: mean 0.85  min 0.4  over 20 samples" in out
+    assert "q-7" in out  # the worst sample leads the table
+    assert "live coverage: 0.75  (ledger: 2 records)" in out
+    assert "corpus_coverage=0.75" in out
+    assert "int8_score_error=0.003" in out
+    assert "shadow_misses=12" in out
+    assert "replied" not in out.split("shadow counters:")[1].splitlines()[0]
+    assert "quality alerts (3 specs): quality-coverage (value 0.75)" in out
+
+
+def test_report_cli_quality_auto_detects_and_degrades(tmp_path, capsys):
+    """The --quality sentinel contract matches --fleet/--profile: omitted
+    flag auto-detects silently, bare flag on a directory without the bundle
+    degrades to a note, exit 0 either way."""
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps({"traceEvents": [
+        {"name": "fit/epoch", "ph": "X", "ts": 0, "dur": 1000,
+         "pid": 1, "tid": 1}]}))
+    rc = cli_main(["report", str(trace)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "retrieval quality" not in out
+    assert "quality bundle unavailable" not in out  # silent when not asked
+
+    rc = cli_main(["report", str(trace), "--quality"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "quality bundle unavailable" in out
+    assert "retrieval quality" not in out
+
+    # auto-detect: the bundle sitting next to the trace is picked up with
+    # NO flag at all
+    (tmp_path / "quality_observability.json").write_text(json.dumps({
+        "shadow": {"rate": 0.25, "counts": {"scored": 4, "sampled": 4,
+                                            "seen": 16},
+                   "recall_mean": 1.0, "recall_min": 1.0, "n_samples": 4,
+                   "samples": []}}))
+    rc = cli_main(["report", str(trace)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "retrieval quality: shadow rate 0.25, 4 scored" in out
+
+
+def test_report_fleet_aggregate_notes_are_rendered(tmp_path, capsys):
+    """Regression (ISSUE 19 satellite): aggregate() records
+    mismatched-histogram-bounds notes, and `report --fleet` must surface
+    them instead of silently folding partial histogram merges."""
+    bundle = {
+        "registries": [{"registry": "r0", "counters": {"replied": 1},
+                        "gauges": {}, "histograms": {}}],
+        "aggregate": {"registry": "fleet", "n_sources": 2,
+                      "counters": {"replied": 2}, "gauges": {},
+                      "histograms": {},
+                      "notes": ["histogram reply_latency_ms: mismatched "
+                                "bounds, kept 1/2 sources"]},
+        "requests": [], "rollout": [],
+        "slo": {"specs": [], "alerts": [], "active": [],
+                "n_observations": 1},
+    }
+    (tmp_path / "bundle.json").write_text(json.dumps(bundle))
+    trace = tmp_path / "trace.json"
+    trace.write_text('{"traceEvents": []}')
+    rc = cli_main(["report", str(trace), "--fleet",
+                   str(tmp_path / "bundle.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert ("aggregate note: histogram reply_latency_ms: mismatched "
+            "bounds, kept 1/2 sources") in out
