@@ -47,6 +47,7 @@ Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline", "extr
 """
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -1646,6 +1647,86 @@ def _bench_profile(jax, sz, workload=None):
     return out
 
 
+def _bench_tuning(jax, sz):
+    """Measured tile-config autotuner race (tuning/search): tuned vs default.
+
+    TPU-only: the Pallas interpreter measures nothing real, so a CPU
+    fallback emits no ``*_autotuned_speedup`` figure and the evidence gate
+    (evidence/run.py, autotuned_speedup_ge_1) passes by absence. Each
+    ``tune_op`` races every admissible tile config for a
+    bench-representative key; the hand-picked default
+    (ops/tile_defaults.py) is always candidate 0 and every other candidate
+    must match the exact oracle bitwise (tie-exact for top-k) BEFORE it may
+    be timed, so the reported speedup is the measured win of an
+    output-identical config over the default — >= 1.0 by construction
+    (1.0 means the default already wins; faster-but-wrong never races).
+    Winners persist to the shared ProfileDB, so serving/training resolve
+    (tuning.resolve) dispatches with them from the next warmup on.
+    """
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        return {"tuning_note": "autotuner race is TPU-only (interpreter "
+                               "timings measure nothing real); skipped"}
+    from dae_rnn_news_recommendation_tpu.telemetry import ProfileDB
+    from dae_rnn_news_recommendation_tpu.tuning import tune_op
+
+    db_path = os.environ.get(
+        "DAE_TUNING_DB", os.environ.get("DAE_PROFILE_DB", PROFILE_DB_PATH))
+    try:
+        db = ProfileDB(db_path)
+    except ValueError as e:
+        db, db_error = None, repr(e)[-300:]  # still race, just don't persist
+    else:
+        db_error = None
+
+    corpus = sz["serve_corpus"]
+    cap = max(64, -(-corpus // 64 * 2) // 32 * 32)  # 2x avg cell, %32
+    keys = [
+        # serving: fused dense top-k at the bench serve-corpus shape, and
+        # clustered retrieval at the serve-ivf corner's cell layout
+        ("topk_fused", (8, corpus, D, 10), "float32", "serve"),
+        ("ivf_topk", (8, 64, cap, D, 10, 8), "float32", "serve"),
+        # training: batch-hard mining over one train batch of codes
+        ("batch_hard", (sz["train_batch"], D), "bfloat16", "train"),
+    ]
+    out, detail, speedups = {}, {}, {"serve": [], "train": []}
+    for op, shape, dtype, side in keys:
+        _phase(f"tuning: racing {op} {'x'.join(map(str, shape))} {dtype}")
+        try:
+            row = tune_op(op, shape, dtype, db=db, n=5, warmup=1,
+                          budget_s=30.0, device_kind=dev.device_kind)
+        except Exception as e:
+            detail[op] = {"error": repr(e)[-300:]}
+            print(json.dumps({"bench_diag": {
+                "attempt": 0, "note": f"tuning {op}: {e!r}"[:500]}}),
+                file=sys.stderr, flush=True)
+            continue
+        tuner = row.get("tuner", {})
+        sp = tuner.get("speedup_vs_default")
+        detail[op] = {
+            "shape": row.get("shape"), "dtype": row.get("dtype"),
+            "config": row.get("config"), "best_ms": row.get("best_ms"),
+            "default_best_ms": tuner.get("default_best_ms"),
+            "speedup_vs_default": sp,
+            "n_candidates": tuner.get("n_candidates"),
+            "n_measured": tuner.get("n_measured"),
+            "n_rejected": tuner.get("n_rejected"),
+        }
+        if sp:
+            speedups[side].append(float(sp))
+    if speedups["serve"]:
+        gm = math.exp(sum(math.log(s) for s in speedups["serve"])
+                      / len(speedups["serve"]))
+        out["serve_autotuned_speedup"] = round(gm, 4)
+    if speedups["train"]:
+        out["train_autotuned_speedup"] = round(speedups["train"][0], 4)
+    out["tuning"] = {"device_kind": dev.device_kind, "db_path": db_path,
+                     "ops": detail}
+    if db_error:
+        out["tuning"]["db_error"] = db_error
+    return out
+
+
 def child_main():
     _phase("child started; initializing backend")
     import jax
@@ -1874,6 +1955,11 @@ def child_main():
         extra.update(_bench_profile(jax, sz, workload=fit_wl))
     except Exception as e:
         extra["profile_error"] = repr(e)[-300:]
+    try:
+        _phase("tuning: measured tile-config race (autotuned vs default)")
+        extra.update(_bench_tuning(jax, sz))
+    except Exception as e:
+        extra["tuning_error"] = repr(e)[-300:]
 
     unit_kind = "sparse-ingest stream"
     if platform == "tpu":

@@ -282,9 +282,12 @@ def _r2_scope(relpath):
     base = os.path.basename(relpath)
     parts = relpath.replace("\\", "/").split("/")
     # devprof: the device timer itself lives by the same fencing law it
-    # enforces on bench/evidence code
+    # enforces on bench/evidence code; tuning: the autotuner's candidate
+    # race is a timed region like any bench leg (its measure loop must go
+    # through devprof.measure, never a bare perf_counter pair)
     return base.startswith("bench") or "evidence" in parts \
-        or "devprof" in base
+        or "devprof" in base or "tuning" in parts \
+        or base.startswith("r2_tuning")
 
 
 @rule("R2", "timed region without a fetch fence", scope=_r2_scope)

@@ -49,11 +49,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.tile_defaults import IVF_CAP_MULTIPLE
 from ..ops.topk_fused import _IDX_SENTINEL
 
-# uniform cell capacity is rounded up to the int8 sublane tile (32), the
-# strictest of the f32/bf16/int8 minimums, so one layout serves every dtype
-CAP_ROUND = 32
+# uniform cell capacity rounds up to a multiple of the int8 sublane tile
+# (32), the strictest of the f32/bf16/int8 minimums, so one layout serves
+# every dtype; the default multiple lives in ops/tile_defaults.py and the
+# autotuner may recommend a larger one (fewer, longer panel DMAs) via
+# tuning.cap_multiple_hint()
+CAP_ROUND = IVF_CAP_MULTIPLE
 
 
 class IVFCells(NamedTuple):
@@ -154,9 +158,13 @@ def _cell_positions(assign_np, counts, cap, n_slabs, slab_of_cell):
     return pos
 
 
-def _cell_cap(counts, cap_min):
+def _cell_cap(counts, cap_min, cap_multiple=None):
+    mult = int(cap_multiple or CAP_ROUND)
+    if mult < 32 or mult % 32 != 0:
+        raise ValueError(f"cap_multiple must be a positive multiple of 32 "
+                         f"(the int8 sublane tile), got {mult}")
     need = max(int(counts.max(initial=0)), int(cap_min or 0))
-    return int(max(CAP_ROUND, -(-need // CAP_ROUND) * CAP_ROUND))
+    return int(max(mult, -(-need // mult) * mult))
 
 
 def _gathered_slabs(emb, valid, scales, pos):
@@ -189,7 +197,8 @@ def _check_assign(assign, centroids, n):
     return assign_np, c, counts
 
 
-def build_cells(emb, valid, scales, centroids, assign, *, cap_min=None):
+def build_cells(emb, valid, scales, centroids, assign, *, cap_min=None,
+                cap_multiple=None):
     """Permute a (quantized) corpus into cell-major slabs.
 
     :param emb: [N, D] slot embeddings, any corpus dtype — gathered as-is
@@ -200,11 +209,13 @@ def build_cells(emb, valid, scales, centroids, assign, *, cap_min=None):
     :param cap_min: optional floor on the uniform cell capacity — pins the
         layout shapes across swaps whose occupancy skews, so the serving
         variants compiled at warmup keep dispatching (zero-recompile soaks)
+    :param cap_multiple: capacity rounding multiple (%32; default
+        tile_defaults.IVF_CAP_MULTIPLE, autotuner may recommend larger)
     :returns: IVFCells with all large arrays on device
     """
     emb = jnp.asarray(emb)
     assign_np, c, counts = _check_assign(assign, centroids, emb.shape[0])
-    cap = _cell_cap(counts, cap_min)
+    cap = _cell_cap(counts, cap_min, cap_multiple)
     pos = _cell_positions(assign_np, counts, cap, c + 1,
                           np.arange(c, dtype=np.int64))
     cell_emb, cell_valid, cell_scales, row_ids = _gathered_slabs(
@@ -216,7 +227,7 @@ def build_cells(emb, valid, scales, centroids, assign, *, cap_min=None):
 
 
 def build_sharded_cells(emb, valid, scales, centroids, assign, *, n_shards,
-                        cap_min=None, device_put=None):
+                        cap_min=None, cap_multiple=None, device_put=None):
     """Permute a (quantized) corpus into SHARD-MAJOR cell slabs (see module
     docstring): shard s owns whole cells [s*cps, (s+1)*cps) plus a local
     dummy, every shard's region is (cps+1)*cap rows.
@@ -231,7 +242,7 @@ def build_sharded_cells(emb, valid, scales, centroids, assign, *, n_shards,
     n_shards = int(n_shards)
     assert n_shards >= 1
     assign_np, c, counts = _check_assign(assign, centroids, emb.shape[0])
-    cap = _cell_cap(counts, cap_min)
+    cap = _cell_cap(counts, cap_min, cap_multiple)
     cps = -(-c // n_shards)                      # whole cells per shard
     cells = np.arange(c, dtype=np.int64)
     slab_of_cell = (cells // cps) * (cps + 1) + cells % cps

@@ -52,10 +52,22 @@ from .topk_fused import (_ACC_LANES, _IDX_SENTINEL, _on_tpu, topk_fused,
                          topk_sharded)
 
 from ..parallel.mesh import _shard_map
+from .tile_defaults import IVF_BQ as DEFAULT_BQ
 
-# queries per block: the f32 min sublane tile. Shortlists are per-block
-# unions, so a bigger bq widens every query's scanned set — keep it minimal.
-DEFAULT_BQ = 8
+
+def _resolve_bq(bq, queries, cells, emb_dtype, k, probes):
+    """The rescore kernel's query block: explicit caller choice wins, else
+    the autotuner cache (tuned row for this shape/dtype/device if one
+    exists), else the hand-picked tile_defaults.IVF_BQ."""
+    if bq is not None:
+        return bq
+    from .. import tuning  # lazy: ops must import without the cache
+
+    cfg, _ = tuning.resolve(
+        "ivf_topk",
+        (queries.shape[0], cells.n_cells, cells.cell_cap,
+         queries.shape[1], k, probes), emb_dtype)
+    return cfg["bq"]
 
 
 def _ivf_kernel(cells_ref, q_ref, p_ref, e_ref, r_ref, v_ref, s_ref,
@@ -229,8 +241,7 @@ def ivf_topk(queries, emb, valid, k, *, cells, probes, scales=None,
                                   cell_ids, k, n_cells)
     if interpret is None:
         interpret = not _on_tpu()
-    if bq is None:
-        bq = DEFAULT_BQ
+    bq = _resolve_bq(bq, queries, cells, emb.dtype, k, probes)
     cell_scales = (cells.cell_scales if scales is not None else
                    jnp.ones((cells.row_ids.shape[0],), jnp.float32))
     # trace-time label only (host-side wrapper — never inside the kernel)
@@ -318,8 +329,7 @@ def sharded_ivf_topk(queries, emb, valid, k, *, cells, probes, mesh,
                                  impl=impl, interpret=interpret)
     if interpret is None:
         interpret = not _on_tpu()
-    if bq is None:
-        bq = DEFAULT_BQ
+    bq = _resolve_bq(bq, queries, cells, emb.dtype, k, probes)
     cell_scales = (cells.cell_scales if scales is not None else
                    jnp.ones(cells.row_ids.shape, jnp.float32))
 

@@ -548,7 +548,7 @@ _batch_hard_loss_vjp.defvjp(_batch_hard_fwd, _batch_hard_bwd)
 
 
 def batch_hard_triplet_loss_pallas(labels, encode, row_valid=None,
-                                   block_rows=8, interpret=None):
+                                   block_rows=None, interpret=None):
     """Drop-in for ops.triplet.batch_hard_triplet_loss, tiled over anchor
     row-blocks so only [block_rows, B] slabs of the dot matrix live in VMEM.
 
@@ -562,10 +562,17 @@ def batch_hard_triplet_loss_pallas(labels, encode, row_valid=None,
     Same return tuple: (loss, data_weight[B], fraction, num_triplets, extras).
 
     :param block_rows: anchor rows per grid step; compiled requires %8==0.
+        None resolves through the autotuner cache (tuned row for this
+        shape/dtype/device if one exists, tile_defaults otherwise).
     :param interpret: force interpreter mode (defaults to True off-TPU).
     """
     if interpret is None:
         interpret = not _on_tpu()
+    if block_rows is None:
+        from .. import tuning  # lazy: ops must import without the cache
+
+        cfg, _ = tuning.resolve("batch_hard", encode.shape, encode.dtype)
+        block_rows = cfg["block_rows"]
     # trace-time label only (host-side wrapper — never inside the kernel)
     with jax.named_scope("ops/batch_hard_pallas"):
         return _batch_hard_loss_vjp(labels, encode, row_valid,
@@ -607,7 +614,7 @@ def _masking_pallas(seed, x, v, block_rows, interpret):
     )(seed, x)
 
 
-def masking_noise_pallas(seed, x, v, block_rows=256, interpret=None):
+def masking_noise_pallas(seed, x, v, block_rows=None, interpret=None):
     """Masking corruption (reference utils.py:94-115 semantics: each element zeroed
     independently with prob v) fused into one pass with on-chip hardware randomness.
 
@@ -635,6 +642,11 @@ def masking_noise_pallas(seed, x, v, block_rows=256, interpret=None):
         # (u >= 0 holds for every draw), so skip the pallas_call outright
         return x
     b, f = x.shape
+    if block_rows is None:
+        from .. import tuning  # lazy: ops must import without the cache
+
+        cfg, _ = tuning.resolve("masking", (b, f), x.dtype)
+        block_rows = cfg["block_rows"]
     # keep the (rows, F) block near 2 MB so in+out+temps stay inside ~16 MB VMEM
     vmem_rows = max(8, (2 << 20) // (x.dtype.itemsize * f) // 8 * 8)
     block_rows = min(block_rows, vmem_rows, b)

@@ -1,0 +1,90 @@
+"""Named tile-size defaults for every Pallas kernel — one table, two readers.
+
+Before r20 these numbers were magic literals scattered through the kernel
+wrappers (`topk_fused.py` fixed the VMEM panel at 512 and derived `bq` from
+an inline `min(256, ...)`, `pallas_kernels.py` hardcoded 8/256 row blocks,
+`wire.py` buried `rows = 8` inside its pallas_call builder). They now live
+here, named and documented, because two subsystems must agree on them:
+
+  * the kernel dispatch fallback — `tuning.resolve()` returns exactly these
+    when the ProfileDB has no tuned row for an (op, shape, dtype, device)
+    key, so an untuned run behaves bit-for-bit like every run before r20;
+  * the autotuner's candidate grids (`tuning/space.py`) — each grid is
+    centered on its default, so the hand-picked choice is always a measured
+    candidate and "tuned" can never mean "worse than before".
+
+The alignment rationale for each number is the kernel's own: panels stream
+through VMEM in sublane-tile multiples (8 f32 / 16 bf16 / 32 int8, lane
+width 128), and the grid axes that revisit an accumulator block must keep
+that block identical across steps (ops/topk_fused.py module docstring).
+"""
+
+# ---------------------------------------------------------------- topk_fused
+# corpus rows per VMEM panel: 512 x 128 lanes of f32 panel + [bq, block]
+# scores stay ~1 MB per step, far under the ~16 MB VMEM budget, and 512 is a
+# multiple of every dtype's min sublane tile (8 f32 / 16 bf16 / 32 int8)
+TOPK_FUSED_PANEL = 512
+# queries per grid row-block, capped: past ~256 queries the [bq, block] score
+# slab starts crowding the panel out of VMEM with no MXU utilization gain
+TOPK_FUSED_BQ_CAP = 256
+
+# ------------------------------------------------------------------ ivf_topk
+# queries per block: the f32 min sublane tile. Shortlists are per-block
+# unions, so a bigger bq widens every query's scanned set — keep it minimal.
+IVF_BQ = 8
+# uniform cell capacity rounds up to the int8 sublane tile (32), the
+# strictest of the f32/bf16/int8 minimums, so one layout serves every dtype.
+# Larger multiples trade padding waste for fewer, longer panel DMAs.
+IVF_CAP_MULTIPLE = 32
+
+# ---------------------------------------------------------------- batch_hard
+# anchor rows per grid step of the O(B^2) mining scan; compiled requires %8
+BATCH_HARD_BLOCK_ROWS = 8
+
+# ------------------------------------------------------------------- masking
+# rows per PRNG block of the corruption kernel (clamped so the block stays
+# ~2 MB whatever the feature width — see masking_noise_pallas)
+MASKING_BLOCK_ROWS = 256
+
+# --------------------------------------------------------------- wire unpack
+# rows per grid step of the bit-plane unpack; the prefix-sum matmul is
+# [rows, Wp] x [Wp, Wp], so small row blocks keep the triangular operand hot
+WIRE_UNPACK_BLOCK_ROWS = 8
+
+
+def ceil_to(n, multiple):
+    """Smallest multiple of `multiple` >= n (n >= 1)."""
+    return int(-(-int(n) // int(multiple)) * int(multiple))
+
+
+def topk_fused_default_bq(batch_rows):
+    """The pre-r20 inline heuristic, named: queries round up to the f32
+    sublane tile and cap at TOPK_FUSED_BQ_CAP."""
+    return int(min(TOPK_FUSED_BQ_CAP, ceil_to(batch_rows, 8)))
+
+
+def default_config(op, shape=None):
+    """The hand-picked fallback config for one op, as the dict
+    `tuning.resolve()` returns on a cache miss.
+
+    `shape` is the op's tuning-key shape tuple (see tuning/space.py for the
+    per-op conventions); only topk_fused consumes it (its default bq depends
+    on the batch)."""
+    if op == "topk_fused":
+        bq = (topk_fused_default_bq(shape[0]) if shape
+              else TOPK_FUSED_BQ_CAP)
+        return {"block": TOPK_FUSED_PANEL, "bq": bq}
+    if op == "ivf_topk":
+        return {"bq": IVF_BQ, "cap_multiple": IVF_CAP_MULTIPLE}
+    if op == "batch_hard":
+        return {"block_rows": BATCH_HARD_BLOCK_ROWS}
+    if op == "masking":
+        return {"block_rows": MASKING_BLOCK_ROWS}
+    if op == "wire_unpack":
+        return {"block_rows": WIRE_UNPACK_BLOCK_ROWS}
+    raise KeyError(f"no tile defaults for op {op!r}")
+
+
+# every op the table (and the tuner) knows, in stable order
+TUNED_OPS = ("topk_fused", "ivf_topk", "batch_hard", "masking",
+             "wire_unpack")

@@ -43,11 +43,8 @@ from jax.experimental import pallas as pl
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import _shard_map
-
-# corpus rows per VMEM panel: 512 x 128 lanes of f32 panel + [bq, block]
-# scores stay ~1 MB per step, far under the ~16 MB VMEM budget, and 512 is a
-# multiple of every dtype's min sublane tile (8 f32 / 16 bf16 / 32 int8)
-DEFAULT_PANEL = 512
+from .tile_defaults import TOPK_FUSED_PANEL as DEFAULT_PANEL
+from .tile_defaults import topk_fused_default_bq
 
 # accumulator lane width: one lane tile; k must fit in it (serving k is ~5-10)
 _ACC_LANES = 128
@@ -159,7 +156,7 @@ def _topk_reference(queries, emb, valid, k, scales=None):
     return jax.lax.top_k(scores, k)
 
 
-def topk_fused(queries, emb, valid, k, *, scales=None, block=DEFAULT_PANEL,
+def topk_fused(queries, emb, valid, k, *, scales=None, block=None,
                bq=None, impl=None, interpret=None):
     """Top-k cosine matches of each query against a resident corpus.
 
@@ -171,7 +168,9 @@ def topk_fused(queries, emb, valid, k, *, scales=None, block=DEFAULT_PANEL,
         descending score, ties broken by ascending index — `lax.top_k`'s
         contract exactly
     :param scales: [N] f32 per-row dequant scales (int8 corpus), else None
-    :param block: corpus rows per VMEM panel (multiple of 128)
+    :param block: corpus rows per VMEM panel (multiple of 128); None
+        resolves through the autotuner cache (tuned row for this
+        shape/dtype/device if one exists, tile_defaults otherwise)
     :param impl: "pallas" | "jnp" | None (None: pallas on TPU, jnp elsewhere)
     :param interpret: Pallas interpreter mode; None = not on TPU
     """
@@ -181,8 +180,17 @@ def topk_fused(queries, emb, valid, k, *, scales=None, block=DEFAULT_PANEL,
         raise ValueError(f"k={k} outside [1, N={n}]")
     if impl is None:
         impl = "pallas" if _on_tpu() else "jnp"
-    if impl == "pallas" and (k > _ACC_LANES or k > block):
+    if impl == "pallas" and k > _ACC_LANES:
         impl = "jnp"   # the accumulator holds k lanes; huge k is top_k's game
+    if impl == "pallas" and (block is None or bq is None):
+        from .. import tuning  # lazy: ops must import without the cache
+
+        cfg, _ = tuning.resolve(
+            "topk_fused", (queries.shape[0], n, emb.shape[1], k), emb.dtype)
+        block = cfg["block"] if block is None else block
+        bq = cfg["bq"] if bq is None else bq
+    if impl == "pallas" and k > block:
+        impl = "jnp"   # a panel must hold k candidate rows
     if impl == "jnp":
         with jax.named_scope(f"ops/topk_fused_jnp_k{k}"):
             return _topk_reference(queries, emb, valid, k, scales)
@@ -191,7 +199,7 @@ def topk_fused(queries, emb, valid, k, *, scales=None, block=DEFAULT_PANEL,
     if interpret is None:
         interpret = not _on_tpu()
     if bq is None:
-        bq = min(256, -(-queries.shape[0] // 8) * 8)
+        bq = topk_fused_default_bq(queries.shape[0])
     if scales is None:
         scales = jnp.ones((n,), jnp.float32)
     # trace-time label only (host-side wrapper — never inside the kernel

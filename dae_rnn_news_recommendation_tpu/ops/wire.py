@@ -308,8 +308,9 @@ def _lane_pad(n):
     return int(-(-n // 128) * 128)
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "interpret"))
-def _unpack_pallas_call(words, first, nnz, spec, interpret):
+@functools.partial(jax.jit,
+                   static_argnames=("spec", "block_rows", "interpret"))
+def _unpack_pallas_call(words, first, nnz, spec, block_rows, interpret):
     # import-light at module level (mirrors ops/__init__'s lazy pallas
     # policy): the experimental API loads only when the kernel path runs
     from jax.experimental import pallas as pl
@@ -320,7 +321,7 @@ def _unpack_pallas_call(words, first, nnz, spec, interpret):
     fpw = spec.fields_per_word
     bits = spec.bits
     pad_index = spec.pad_index
-    rows = 8
+    rows = block_rows
     bp = int(-(-b // rows) * rows)
     if bp != b or w_pad != w_real:
         words = jnp.pad(words, ((0, bp - b), (0, w_pad - w_real)))
@@ -378,16 +379,30 @@ def _unpack_pallas_call(words, first, nnz, spec, interpret):
 
 
 def unpack_wire_pallas(words, first, nnz, spec, values=None, scale=None,
-                       interpret=None):
+                       block_rows=None, interpret=None):
     """Pallas-kernel unpack (interpret mode off-TPU). Exactness bound: the
     in-kernel prefix sums run on the MXU in f32, exact while every column
     index < 2**24 — `unpack_wire` auto-routes wider corpora to the jnp path.
+
+    :param block_rows: rows per grid step (%8); None resolves through the
+        autotuner cache (tuned row for this batch/width/device if one
+        exists, tile_defaults.WIRE_UNPACK_BLOCK_ROWS otherwise)
     """
     if interpret is None:
         interpret = not _on_tpu()
     assert spec.n_features < (1 << 24), (
         "Pallas unpack is exact only for n_features < 2**24; use the jnp path")
-    indices = _unpack_pallas_call(words, first, nnz, spec, bool(interpret))
+    if block_rows is None:
+        from .. import tuning  # lazy: ops must import without the cache
+
+        cfg, _ = tuning.resolve(
+            "wire_unpack", (words.shape[0], spec.words_per_row), "int32")
+        block_rows = cfg["block_rows"]
+    if block_rows % 8 != 0 or block_rows < 8:
+        raise ValueError(f"block_rows must be a positive multiple of 8, "
+                         f"got {block_rows}")
+    indices = _unpack_pallas_call(words, first, nnz, spec, int(block_rows),
+                                  bool(interpret))
     return (indices.astype(spec.np_index_dtype),
             _dequantize_jnp(spec, values, scale))
 

@@ -831,6 +831,13 @@ class ServingCorpus:
             else:
                 centroids = base.ivf.centroids
                 assign = assign_cells(x, centroids)
+            # capacity rounding multiple: a tuned TPU capture may recommend
+            # a larger panel multiple (fewer, longer cell DMAs); defaults to
+            # tile_defaults.IVF_CAP_MULTIPLE, and `cap_min` still pins the
+            # layout shapes across swaps either way
+            from .. import tuning
+
+            cap_multiple = tuning.cap_multiple_hint()
             n_shards = self._row_mult
             if n_shards is None and _slot_is_sharded(slot):
                 n_shards = len(slot.emb.sharding.device_set)
@@ -838,11 +845,12 @@ class ServingCorpus:
                 slot.ivf = build_sharded_cells(
                     slot.emb, slot.valid, slot.scales, centroids, assign,
                     n_shards=n_shards, cap_min=self.cell_cap,
-                    device_put=self._device_put)
+                    cap_multiple=cap_multiple, device_put=self._device_put)
             else:
                 slot.ivf = build_cells(slot.emb, slot.valid, slot.scales,
                                        centroids, assign,
-                                       cap_min=self.cell_cap)
+                                       cap_min=self.cell_cap,
+                                       cap_multiple=cap_multiple)
         st = cell_stats(slot.ivf)
         with self._lock:
             if refit:

@@ -771,6 +771,13 @@ class RecommendationService:
         ivf_missing = self.retrieval == "ivf" and slot.ivf is None
         fns = ({k: self._fallback_fn(k) for k in self._serve_fns}
                if ivf_missing else self._serve_fns)
+        # load the autotuner cache BEFORE compiling the serving variants:
+        # every kernel config resolves here, once, so post-warm traffic can
+        # never see a different tile choice (and with it a recompile) —
+        # the r09/r19 zero-post-warm-recompile contract with tuning on
+        from .. import tuning
+
+        tuning.prime()
         args = self._slot_args(slot, fallback=ivf_missing)
         f = int(self.config.n_features)
         watcher = CompileWatcher().start()
@@ -867,8 +874,23 @@ class RecommendationService:
                 "shadow": (self.shadow.summary() if self.shadow is not None
                            else None),
                 "floor_ms": round(self._floor_s * 1e3, 3),
+                "tuning": self._tuning_summary(),
                 "compiles": {
                     "warmup": self._warmup_compiles,
                     "post_warmup": (self._post_warm_watcher.count
                                     if self._post_warm_watcher is not None
                                     else None)}}
+
+    @staticmethod
+    def _tuning_summary():
+        """Which tile configs this process's kernels dispatched with and
+        where each came from (tuned capture vs hand-picked default) —
+        compact: full per-shape resolutions live in the run manifest."""
+        try:
+            from .. import tuning
+
+            m = tuning.resolution_manifest()
+            return {"enabled": m["enabled"], "n_tuned": m["n_tuned"],
+                    "n_default": m["n_default"]}
+        except Exception:
+            return None

@@ -2,7 +2,7 @@
 
     report <trace.json> [--metrics PATH] [--bench PATH] [--health PATH]
                         [--churn PATH] [--fleet [PATH]] [--profile [PATH]]
-                        [--quality [PATH]] [--json]
+                        [--quality [PATH]] [--tuning [PATH]] [--json]
 
 Prints the per-span p50/p95/total table (with feed-stall and compile-count
 columns) from a trace exported by a traced fit; optionally joins metrics.jsonl
@@ -52,6 +52,11 @@ def main(argv=None):
                      help="quality_observability.json dumped by "
                           "dump_quality_observability; bare --quality (or "
                           "no flag) auto-detects next to the trace")
+    rep.add_argument("--tuning", nargs="?", const="auto", default=None,
+                     help="a ProfileDB with autotuner rows (tuning/search); "
+                          "renders tuned-vs-default configs; bare --tuning "
+                          "(or no flag) auto-detects profile_db.json next "
+                          "to the trace")
     rep.add_argument("--json", action="store_true",
                      help="emit the report as JSON instead of a table")
     args = parser.parse_args(argv)
@@ -61,7 +66,8 @@ def main(argv=None):
                             bench_path=args.bench, health_path=args.health,
                             churn_path=args.churn, fleet_path=args.fleet,
                             profile_path=args.profile,
-                            quality_path=args.quality, as_json=args.json)
+                            quality_path=args.quality,
+                            tuning_path=args.tuning, as_json=args.json)
     except (OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2

@@ -74,6 +74,15 @@ def build_manifest(config=None, feed_mode=None, buckets=None, extra=None):
         manifest["config"] = (dataclasses.asdict(config)
                               if dataclasses.is_dataclass(config)
                               else dict(config))
+    try:
+        # which tile config every kernel dispatched with this process and
+        # where it came from (tuned capture vs hand-picked default) —
+        # degrade-never-raise like the device fields above
+        from .. import tuning
+
+        manifest["tuning"] = tuning.resolution_manifest()
+    except Exception:
+        manifest.setdefault("tuning", None)
     if extra:
         manifest.update(extra)
     return manifest

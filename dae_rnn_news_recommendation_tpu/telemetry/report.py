@@ -551,6 +551,49 @@ def profile_summary(dump, top=10):
             "n_rows_omitted": max(0, len(rows) - top)}
 
 
+def tuning_summary(dump):
+    """The ProfileDB's autotuner story: every row the measured search
+    recorded (tuning/search.py — rows carrying `config` + `tuner`
+    provenance), tuned-vs-default timing side by side, parity discipline,
+    and how much of each candidate grid the static pruner rejected before
+    any compile. Plain measurement rows (r18 devprof captures) are not
+    tuning rows and are skipped."""
+    rows = [r for r in ((dump or {}).get("rows") or {}).values()
+            if isinstance(r.get("config"), dict)
+            and isinstance(r.get("tuner"), dict)]
+    if not rows:
+        return None
+    rows.sort(key=lambda r: (str(r.get("op")), str(r.get("shape")),
+                             str(r.get("dtype"))))
+    table = []
+    n_interpret = 0
+    for r in rows:
+        t = r["tuner"]
+        if t.get("interpret"):
+            n_interpret += 1
+        table.append({
+            "op": str(r.get("op", "?")),
+            "shape": str(r.get("shape", "?")),
+            "dtype": str(r.get("dtype", "?")),
+            "device_kind": str(r.get("device_kind", "?")),
+            "config": dict(r["config"]),
+            "best_ms": r.get("best_ms"),
+            "default_config": t.get("default_config"),
+            "default_best_ms": t.get("default_best_ms"),
+            "speedup": t.get("speedup_vs_default"),
+            "parity": t.get("parity"),
+            "n_candidates": t.get("n_candidates"),
+            "n_rejected": t.get("n_rejected"),
+            "n_pruned": (t.get("n_pruned_illegal") or 0)
+            + (t.get("n_pruned_vmem") or 0),
+            "interpret": bool(t.get("interpret")),
+            "alias_of": t.get("alias_of"),
+        })
+    kinds = sorted({r["device_kind"] for r in table})
+    return {"n_rows": len(table), "device_kinds": kinds,
+            "n_interpret": n_interpret, "rows": table}
+
+
 def faults_summary(manifest):
     """The manifest's `faults` section (models/estimator.py
     `_write_fault_manifest`): injected chaos faults, recorded I/O retries,
@@ -755,9 +798,44 @@ def _render_profile(profile, lines):
         lines.append(f"    ... {profile['n_rows_omitted']} more")
 
 
+def _fmt_config(cfg):
+    if not isinstance(cfg, dict):
+        return "-"
+    return ",".join(f"{k}={v}" for k, v in sorted(cfg.items()))
+
+
+def _render_tuning(tuning, lines):
+    head = (f"kernel autotuner: {tuning['n_rows']} tuned rows, device kinds "
+            + (", ".join(tuning["device_kinds"]) or "?"))
+    if tuning.get("n_interpret"):
+        head += (f"  ({tuning['n_interpret']} interpreter captures — "
+                 "parity only, not hardware timings)")
+    lines.append(head)
+    lines.append("  op / shape / dtype / tuned config / best ms / "
+                 "default ms / speedup / parity")
+    for r in tuning.get("rows") or ():
+        spd = r.get("speedup")
+        spd_txt = f"x{spd:.3f}" if isinstance(spd, (int, float)) else "-"
+        best = r.get("best_ms")
+        dflt = r.get("default_best_ms")
+        best_txt = f"{best:.3f}" if isinstance(best, (int, float)) else "-"
+        dflt_txt = f"{dflt:.3f}" if isinstance(dflt, (int, float)) else "-"
+        parity = r.get("parity") or "?"
+        extras = []
+        if r.get("alias_of"):
+            extras.append(f"alias of {r['alias_of']}")
+        if r.get("interpret"):
+            extras.append("interpret")
+        tail = f"  [{'; '.join(extras)}]" if extras else ""
+        lines.append(
+            f"    {r['op']:<14} {r['shape']:>16} {r['dtype']:>9} "
+            f"{_fmt_config(r.get('config')):>24} {best_txt:>9} "
+            f"{dflt_txt:>10} {spd_txt:>8}  {parity}{tail}")
+
+
 def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
                 health=None, faults=None, churn=None, fleet=None,
-                profile=None, quality=None, notes=None):
+                profile=None, quality=None, tuning=None, notes=None):
     lines = []
     if manifest:
         lines.append("run: git %s  backend=%s  feed=%s  created %s" % (
@@ -885,12 +963,15 @@ def render_text(rows, counters=None, manifest=None, metrics=None, bench=None,
     if profile:
         lines.append("")
         _render_profile(profile, lines)
+    if tuning:
+        lines.append("")
+        _render_tuning(tuning, lines)
     return "\n".join(lines)
 
 
 def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
            churn_path=None, fleet_path=None, profile_path=None,
-           quality_path=None, as_json=False):
+           quality_path=None, tuning_path=None, as_json=False):
     """Build the report. Returns (text, exit_code).
 
     The trace is the report's backbone — an unreadable trace still raises
@@ -905,9 +986,11 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
     SILENT when it isn't there (an r12-era run directory renders exactly as
     before); the sentinel "auto" (the CLI's bare `--fleet`) also auto-detects
     but notes the absence, since the section was explicitly asked for.
-    `profile_path` (a ProfileDB file, default name `profile_db.json`) and
+    `profile_path` (a ProfileDB file, default name `profile_db.json`),
     `quality_path` (a retrieval-quality bundle, default name
-    `quality_observability.json`) follow the same sentinel contract."""
+    `quality_observability.json`) and `tuning_path` (also a ProfileDB —
+    the autotuner's rows render as tuned-vs-default) follow the same
+    sentinel contract."""
     trace = load_trace(trace_path)
     rows = span_table(trace)
     meta = trace.get("metadata", {}) or {}
@@ -989,6 +1072,19 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
             quality_path = None
     quality = quality_summary(optional(quality_path, load_quality,
                                        "quality bundle"))
+    if tuning_path in (None, "auto"):
+        cand = os.path.join(os.path.dirname(os.path.abspath(trace_path)),
+                            "profile_db.json")
+        if os.path.exists(cand):
+            tuning_path = cand
+        elif tuning_path == "auto":
+            notes.append("tuning DB unavailable, section skipped "
+                         "(no profile_db.json next to trace)")
+            tuning_path = None
+        else:
+            tuning_path = None
+    tuning = tuning_summary(optional(tuning_path, load_profile,
+                                     "tuning DB"))
     faults = faults_summary(manifest)
     if as_json:
         return json.dumps({"spans": rows, "counters": counters,
@@ -996,13 +1092,14 @@ def report(trace_path, metrics_path=None, bench_path=None, health_path=None,
                            "bench": bench, "health": health,
                            "faults": faults, "churn": churn,
                            "fleet": fleet, "profile": profile,
-                           "quality": quality,
+                           "quality": quality, "tuning": tuning,
                            "notes": notes or None},
                           indent=2, default=str), 0
     if not rows and not (metrics or bench or health or churn or fleet
-                         or profile or quality):
+                         or profile or quality or tuning):
         return "no span events in trace", 1
     return render_text(rows, counters=counters, manifest=manifest,
                        metrics=metrics, bench=bench, health=health,
                        faults=faults, churn=churn, fleet=fleet,
-                       profile=profile, quality=quality, notes=notes), 0
+                       profile=profile, quality=quality, tuning=tuning,
+                       notes=notes), 0

@@ -391,6 +391,15 @@ def make_train_step(config, optimizer, loss_fn=loss_and_metrics, donate=True,
     crash can never checkpoint a half-accumulated phase — the step cursor
     in docs/reliability.md counts these atomic calls, which is what makes
     crash-exact resume possible without persisting any intra-step state."""
+    # Load the autotuner cache now, on the host, before the first trace:
+    # the Pallas kernel wrappers inside the step (mining, masking
+    # corruption, wire unpack) resolve their tile configs at trace time
+    # through tuning.resolve(), and priming here keeps that resolution a
+    # warm dict lookup instead of a DB file read mid-trace. The manifest
+    # then records each kernel's resolved config + provenance.
+    from .. import tuning
+
+    tuning.prime()
 
     def step(params, opt_state, key, batch):
         with jax.named_scope("train/grads"):

@@ -1,0 +1,260 @@
+"""Autotuned kernel configs: one resolve() between the cache and every kernel.
+
+The dispatch contract (ROADMAP item 4a, r20):
+
+    config, provenance = tuning.resolve(op, shape, dtype)
+
+Cache **hit** — a ProfileDB row recorded by the measured search
+(tuning/search.py) whose ``config`` passes today's legality laws
+(space.validate) — returns the tuned config with provenance ``"tuned"``.
+**Miss** — no DB, no row for this op|shape|dtype|device_kind key, a stale or
+foreign row, an interpreter-tuned row on a real TPU host — falls back to the
+hand-picked defaults (ops/tile_defaults.py) with provenance ``"default"``,
+bit-for-bit the pre-r20 behavior. Either way the resolution is memoized per
+process and logged, so the run manifest records exactly which config every
+kernel dispatched with and where it came from.
+
+Zero-recompile discipline: resolve() is pure host work — one DB file read
+per process (at first resolve or at ``prime()``), then dict lookups. For a
+fixed key it always returns the same config, so jit caches keyed on the
+resolved tile sizes never see a second value; `ServingCorpus`/service
+``warmup()`` call ``prime()`` before compiling the serving variants, and the
+r09/r19 zero-post-warm-recompile contract holds with tuning enabled (pinned
+by tests/test_tuning.py).
+
+Off switch: ``DAE_TUNING=0`` (or ``configure(enabled=False)``) makes every
+resolution a default-provenance miss — the bench's default leg and the
+fallback story in one line. ``DAE_TUNING_DB`` points resolution at a
+specific capture (defaults to the repo ProfileDB next to the evidence,
+``DAE_PROFILE_DB`` honored as the shared location).
+"""
+
+import os
+import threading
+import warnings
+
+from ..ops import tile_defaults as td
+from . import space
+
+__all__ = ["resolve", "prime", "reset", "configure", "resolutions",
+           "resolution_manifest", "cap_multiple_hint", "default_db_path",
+           "tune_op", "tune_default_shapes", "space"]
+
+
+def tune_op(*args, **kwargs):
+    from . import search
+
+    return search.tune_op(*args, **kwargs)
+
+
+def tune_default_shapes(*args, **kwargs):
+    from . import search
+
+    return search.tune_default_shapes(*args, **kwargs)
+
+
+def default_db_path():
+    """The ProfileDB resolution reads, first match wins: ``DAE_TUNING_DB``,
+    ``DAE_PROFILE_DB``, the repo's evidence DB (where bench.py records)."""
+    for var in ("DAE_TUNING_DB", "DAE_PROFILE_DB"):
+        p = os.environ.get(var)
+        if p:
+            return p
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "evidence", "profile_db.json")
+
+
+_lock = threading.Lock()
+_state = {
+    "enabled": None,      # None: read DAE_TUNING at first resolve
+    "db_path": None,      # None: default_db_path() at first load
+    "rows": None,         # key -> row, loaded once per process
+    "cache": {},          # resolve key -> (config, provenance)
+    "log": {},            # resolve key -> resolution record (insert-ordered)
+}
+
+
+def _enabled_locked():
+    if _state["enabled"] is None:
+        _state["enabled"] = os.environ.get("DAE_TUNING", "1") not in (
+            "0", "false", "no", "off")
+    return _state["enabled"]
+
+
+def _rows_locked():
+    if _state["rows"] is None:
+        rows = {}
+        path = _state["db_path"] or default_db_path()
+        _state["db_path"] = path
+        if os.path.exists(path):
+            try:
+                from ..telemetry.profile_db import ProfileDB, row_key
+
+                for row in ProfileDB(path).rows():
+                    rows[row_key(row["op"], row["shape"], row["dtype"],
+                                 row["device_kind"])] = row
+            except Exception as exc:
+                # a corrupt DB degrades to defaults, never raises — but the
+                # operator should hear about it (a tuned fleet silently
+                # running hand-picked defaults is a perf regression)
+                rows = {}
+                warnings.warn(f"tuning: could not load ProfileDB at {path} "
+                              f"({exc!r}); kernels fall back to defaults",
+                              RuntimeWarning, stacklevel=3)
+        _state["rows"] = rows
+    return _state["rows"]
+
+
+def _device_kind():
+    from ..telemetry.devprof import _device_kind as dk
+
+    return dk()
+
+
+def _tuned_config_locked(op, shape, dtype, device_kind):
+    """The tuned config for one key, or None on any admission doubt."""
+    from ..telemetry.profile_db import row_key
+
+    shape_str = "x".join(str(int(s)) for s in shape)
+    row = _rows_locked().get(row_key(op, shape_str, str(dtype), device_kind))
+    if row is None:
+        return None
+    config = row.get("config")
+    tuner = row.get("tuner")
+    if not isinstance(config, dict) or not isinstance(tuner, dict):
+        return None  # pre-r20 profile row (plain measurement, no tuning)
+    if not tuner.get("admitted"):
+        return None
+    if tuner.get("interpret") and "tpu" in (device_kind or "").lower():
+        return None  # interpreter capture is not a hardware config
+    if not space.validate(op, config, shape, dtype):
+        return None  # stale/foreign row vs today's legality laws
+    return {k: int(v) for k, v in config.items()}
+
+
+def resolve(op, shape, dtype, device_kind=None):
+    """(config dict, provenance) for one kernel dispatch — see module
+    docstring. `shape` follows the per-op key conventions documented in
+    tuning/space.py; `dtype` is the str/np dtype name the key was tuned
+    under."""
+    shape = tuple(int(s) for s in shape)
+    dtype = str(dtype)
+    device_kind = device_kind or _device_kind()
+    key = (op, shape, dtype, device_kind)
+    with _lock:
+        hit = _state["cache"].get(key)
+        if hit is not None:
+            return dict(hit[0]), hit[1]
+        config = (_tuned_config_locked(op, shape, dtype, device_kind)
+                  if _enabled_locked() else None)
+        provenance = "tuned" if config is not None else "default"
+        if config is None:
+            config = td.default_config(op, shape)
+        _state["cache"][key] = (config, provenance)
+        _state["log"][key] = {
+            "op": op, "shape": "x".join(str(s) for s in shape),
+            "dtype": dtype, "device_kind": device_kind,
+            "config": dict(config), "provenance": provenance,
+        }
+        return dict(config), provenance
+
+
+def cap_multiple_hint(device_kind=None):
+    """The IVF layout capacity multiple a tuned capture recommends for this
+    device, else the hand-picked default. Layout build happens before k and
+    probes are known, so this scans every admitted ivf_topk row for the
+    device and takes the most common winning ``cap_multiple`` (ties: the
+    smallest — least padding). The choice is logged like any resolution."""
+    device_kind = device_kind or _device_kind()
+    with _lock:
+        votes = {}
+        if _enabled_locked():
+            for row in _rows_locked().values():
+                if row.get("op") != "ivf_topk":
+                    continue
+                if row.get("device_kind") != device_kind:
+                    continue
+                config = row.get("config")
+                tuner = row.get("tuner")
+                if not isinstance(config, dict) or not isinstance(tuner, dict):
+                    continue
+                if not tuner.get("admitted") or tuner.get("alias_of"):
+                    continue
+                if tuner.get("interpret") and "tpu" in device_kind.lower():
+                    continue
+                mult = int(config.get("cap_multiple", 0))
+                if mult >= 32 and mult % 32 == 0:
+                    votes[mult] = votes.get(mult, 0) + 1
+        if votes:
+            mult, provenance = min(
+                votes, key=lambda m: (-votes[m], m)), "tuned"
+        else:
+            mult, provenance = td.IVF_CAP_MULTIPLE, "default"
+        key = ("ivf_layout", (), "", device_kind)
+        _state["log"][key] = {
+            "op": "ivf_layout", "shape": "", "dtype": "",
+            "device_kind": device_kind,
+            "config": {"cap_multiple": mult}, "provenance": provenance,
+        }
+        return mult
+
+
+def prime(db_path=None):
+    """Load the tuning DB now (one disk read), so every later resolve() is
+    pure dict work — called by service warmup() before compiling serving
+    variants. Returns the number of tuned rows available."""
+    with _lock:
+        if db_path is not None and db_path != _state["db_path"]:
+            _state["db_path"] = db_path
+            _state["rows"] = None
+            _state["cache"].clear()
+        rows = _rows_locked()
+        return sum(1 for r in rows.values()
+                   if isinstance(r.get("config"), dict)
+                   and isinstance(r.get("tuner"), dict)
+                   and r["tuner"].get("admitted"))
+
+
+def configure(enabled=None, db_path=None):
+    """Process-wide tuning switches (tests, bench default leg, CLI)."""
+    with _lock:
+        if enabled is not None:
+            _state["enabled"] = bool(enabled)
+            _state["cache"].clear()
+            _state["log"].clear()
+        if db_path is not None:
+            _state["db_path"] = db_path
+            _state["rows"] = None
+            _state["cache"].clear()
+            _state["log"].clear()
+
+
+def reset():
+    """Forget everything: cache, log, loaded rows, switches (back to env)."""
+    with _lock:
+        _state["enabled"] = None
+        _state["db_path"] = None
+        _state["rows"] = None
+        _state["cache"].clear()
+        _state["log"].clear()
+
+
+def resolutions():
+    """Every distinct resolution this process made, in first-use order."""
+    with _lock:
+        return [dict(r) for r in _state["log"].values()]
+
+
+def resolution_manifest():
+    """The run-manifest fragment: where configs came from, per kernel."""
+    with _lock:
+        recs = [dict(r) for r in _state["log"].values()]
+        return {
+            "enabled": bool(_state["enabled"]) if _state["enabled"] is not None
+            else os.environ.get("DAE_TUNING", "1") not in (
+                "0", "false", "no", "off"),
+            "db_path": _state["db_path"] or default_db_path(),
+            "n_tuned": sum(1 for r in recs if r["provenance"] == "tuned"),
+            "n_default": sum(1 for r in recs if r["provenance"] == "default"),
+            "resolutions": recs,
+        }

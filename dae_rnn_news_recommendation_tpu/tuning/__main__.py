@@ -1,0 +1,147 @@
+"""Offline autotuner CLI.
+
+    python -m dae_rnn_news_recommendation_tpu.tuning tune \
+        [--select topk_fused,ivf_topk] [--budget-s 120] [--db PATH] \
+        [--n 5] [--warmup 1] [--seed 0] [--shape 64x4096x512x10] \
+        [--dtype float32] [--interpret]
+    python -m dae_rnn_news_recommendation_tpu.tuning show  [--db PATH]
+    python -m dae_rnn_news_recommendation_tpu.tuning clear [--select op] \
+        [--db PATH]
+
+``tune`` races the candidate grids for each selected op over its
+representative shapes (tuning/space.default_shapes; override one key with
+--shape/--dtype) and records winners into the ProfileDB. On a TPU host this
+is the capture workflow: tune there, commit the DB, and every later serving/
+training run resolves the tuned tiles. ``show`` renders the tuned-vs-default
+table (the same renderer as ``telemetry report --tuning``); ``clear`` drops
+tuned rows (plain profile measurements are left alone).
+"""
+
+import argparse
+import sys
+
+from ..ops import tile_defaults as td
+
+
+def _parse_ops(select):
+    if not select:
+        return list(td.TUNED_OPS)
+    ops = [s.strip() for s in select.split(",") if s.strip()]
+    unknown = [o for o in ops if o not in td.TUNED_OPS]
+    if unknown:
+        raise SystemExit(f"unknown op(s) {unknown}; have {list(td.TUNED_OPS)}")
+    return ops
+
+
+def _cmd_tune(args):
+    from ..telemetry.profile_db import ProfileDB
+    from . import default_db_path
+    from .search import tune_default_shapes, tune_op
+
+    path = args.db or default_db_path()
+    db = ProfileDB(path)
+    ops = _parse_ops(args.select)
+    budget = None if args.budget_s is None else float(args.budget_s)
+    per_op = None if budget is None else max(budget / len(ops), 1.0)
+    log = lambda msg: print(msg, file=sys.stderr)  # noqa: E731
+    n_rows = 0
+    for op in ops:
+        if args.shape:
+            shape = tuple(int(s) for s in args.shape.split("x"))
+            row = tune_op(op, shape, args.dtype, db=db, n=args.n,
+                          warmup=args.warmup, seed=args.seed,
+                          budget_s=per_op, interpret=args.interpret,
+                          log=log)
+            rows = [row] if row is not None else []
+        else:
+            rows = tune_default_shapes(op, db=db, n=args.n,
+                                       warmup=args.warmup, seed=args.seed,
+                                       budget_s=per_op,
+                                       interpret=args.interpret, log=log)
+        for row in rows:
+            t = row["tuner"]
+            print(f"{op} {row['shape']} {row['dtype']} "
+                  f"[{row['device_kind']}]: {row['config']} "
+                  f"{row['best_ms']:.3f} ms "
+                  f"(default {t['default_best_ms']:.3f} ms, "
+                  f"x{t['speedup_vs_default']:.3f})")
+        n_rows += len(rows)
+    print(f"recorded {n_rows} tuned row(s) -> {path}")
+    return 0
+
+
+def _cmd_show(args):
+    from ..telemetry.report import load_profile, render_text, tuning_summary
+    from . import default_db_path
+
+    path = args.db or default_db_path()
+    try:
+        dump = load_profile(path)
+    except Exception as e:
+        print(f"cannot read ProfileDB at {path}: {e}", file=sys.stderr)
+        return 1
+    print(render_text([], tuning=tuning_summary(dump)))
+    return 0
+
+
+def _cmd_clear(args):
+    from ..telemetry.profile_db import ProfileDB
+    from . import default_db_path
+
+    path = args.db or default_db_path()
+    db = ProfileDB(path)
+    ops = set(_parse_ops(args.select))
+    keep, dropped = {}, 0
+    for key, row in db._rows.items():
+        if isinstance(row.get("tuner"), dict) and row.get("op") in ops:
+            dropped += 1
+        else:
+            keep[key] = row
+    db._rows = keep
+    db.save()
+    print(f"dropped {dropped} tuned row(s) from {path}")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m dae_rnn_news_recommendation_tpu.tuning",
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("tune", help="race candidate grids, record winners")
+    t.add_argument("--select", default=None,
+                   help="comma-separated ops (default: all tunable ops)")
+    t.add_argument("--budget-s", default=None, type=float,
+                   help="total wall-clock budget, split across selected ops")
+    t.add_argument("--db", default=None, help="ProfileDB path")
+    t.add_argument("--n", default=5, type=int, help="timed iterations")
+    t.add_argument("--warmup", default=1, type=int)
+    t.add_argument("--seed", default=0, type=int)
+    t.add_argument("--shape", default=None,
+                   help="one explicit AxBxC tuning shape instead of the "
+                        "representative set (requires --select with one op)")
+    t.add_argument("--dtype", default="float32")
+    t.add_argument("--interpret", action="store_true",
+                   help="force Pallas interpreter mode (parity exercising "
+                        "off-TPU; timings are not hardware figures)")
+    t.set_defaults(fn=_cmd_tune)
+
+    s = sub.add_parser("show", help="tuned-vs-default table from a ProfileDB")
+    s.add_argument("--db", default=None)
+    s.set_defaults(fn=_cmd_show)
+
+    c = sub.add_parser("clear", help="drop tuned rows (measurements stay)")
+    c.add_argument("--select", default=None)
+    c.add_argument("--db", default=None)
+    c.set_defaults(fn=_cmd_clear)
+
+    args = p.parse_args(argv)
+    if getattr(args, "shape", None) and (not args.select
+                                         or "," in args.select):
+        p.error("--shape requires --select with exactly one op")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

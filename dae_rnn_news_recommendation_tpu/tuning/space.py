@@ -1,0 +1,242 @@
+"""Per-kernel candidate spaces: legal tile grids, pruned before any compile.
+
+One declaration per Pallas kernel — the axes the tuner may vary, the
+alignment laws each axis must obey (the kernel wrappers raise on violations,
+so an illegal candidate would waste a compile just to error), and a static
+VMEM-footprint model that rejects tile combinations which cannot fit the
+~16 MB per-core budget. Pruning is pure host arithmetic: no jax import, no
+trace, no compile — the measured search loop (tuning/search.py) only ever
+sees candidates that are worth a compile.
+
+Shape-key conventions (the tuple `tuning.resolve(op, shape, dtype)` takes;
+`profile_db.row_key` renders it "AxBxC"):
+
+    topk_fused   (B, N, D, k)        dtype = corpus emb dtype
+    ivf_topk     (B, C, cap, D, k, probes)   dtype = cell emb dtype
+    batch_hard   (B, D)              dtype = encode dtype
+    masking      (B, F)              dtype = x dtype
+    wire_unpack  (B, words_per_row)  dtype = "int32" (packed words)
+
+The grids are centered on the hand-picked defaults (ops/tile_defaults.py),
+so the default is always one of the measured candidates and a tuned config
+can never lose to it in the race that admits it.
+"""
+
+from ..ops import tile_defaults as td
+
+# static VMEM budget the footprint model prunes against: ~16 MB per core
+# minus headroom for Mosaic's own scratch, semaphores, and the compiler's
+# double-buffering of streamed blocks (modeled explicitly below as x2 on
+# grid-streamed operands)
+VMEM_BUDGET_BYTES = 12 << 20
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "int8": 1, "int32": 4,
+                "float64": 8, "int16": 2, "uint16": 2}
+
+
+def dtype_bytes(dtype):
+    return _DTYPE_BYTES.get(str(dtype), 4)
+
+
+def _lane_pad(n):
+    return td.ceil_to(max(int(n), 1), 128)
+
+
+# ----------------------------------------------------------- footprint model
+
+def vmem_footprint(op, config, shape, dtype):
+    """Estimated peak VMEM bytes for one grid step of `op` at `config`.
+
+    Deliberately simple and conservative: streamed input blocks count twice
+    (the pipeline double-buffers HBM->VMEM fetches), dequantized panels
+    count at f32 on top of their raw bytes, and accumulator output blocks
+    count once (they persist across the revisiting axis). The model only
+    needs to be monotone and roughly right — it prunes the obviously
+    impossible corner of the grid, and the measured race decides the rest.
+    """
+    item = dtype_bytes(dtype)
+    if op == "topk_fused":
+        b, n, d, k = shape
+        block, bq = config["block"], config["bq"]
+        dp = _lane_pad(d)
+        panel = block * dp * item * 2        # raw streamed panel (x2 pipeline)
+        panel += block * dp * 4              # dequantized f32 copy
+        queries = bq * dp * 4 * 2
+        scores = bq * block * 4              # [bq, block] panel scores
+        acc = 2 * bq * 128 * 4               # score + index accumulators
+        masks = 2 * block * 4 * 2            # valid + scales rows
+        return panel + queries + scores + acc + masks
+    if op == "ivf_topk":
+        b, c, cap, d, k, probes = shape
+        bq = config["bq"]
+        cap = td.ceil_to(cap, config.get("cap_multiple", td.IVF_CAP_MULTIPLE))
+        dp = _lane_pad(d)
+        panel = cap * dp * item * 2 + cap * dp * 4
+        queries = bq * dp * 4 * 2
+        probe_lanes = _lane_pad(probes)
+        member = bq * probe_lanes * 4 * 2
+        scores = bq * cap * 4
+        acc = 2 * bq * 128 * 4
+        rows = 3 * cap * 4 * 2               # row_ids + valid + scales
+        return panel + queries + member + scores + acc + rows
+    if op == "batch_hard":
+        b, d = shape
+        block_rows = config["block_rows"]
+        bp = td.ceil_to(b, 8)
+        dots = block_rows * _lane_pad(bp) * 4 * 2   # [rows, B] slab of dp
+        masks = 2 * block_rows * _lane_pad(bp) * 4
+        enc = block_rows * _lane_pad(d) * item * 2
+        return dots + masks + enc
+    if op == "masking":
+        b, f = shape
+        block_rows = config["block_rows"]
+        return block_rows * f * item * 3     # in + out + keep mask
+    if op == "wire_unpack":
+        b, w = shape
+        block_rows = config["block_rows"]
+        wp = _lane_pad(w)
+        words = block_rows * wp * 4 * 2
+        tri = wp * wp * 4                    # upper-triangular operand
+        out = block_rows * wp * 4 * 4        # up to fpw planes of output
+        return words + tri + out
+    raise KeyError(f"no VMEM model for op {op!r}")
+
+
+# ----------------------------------------------------------- candidate grids
+
+# raw axis grids, before legality/footprint pruning; each includes its
+# tile_defaults center
+_TOPK_BLOCKS = (128, 256, 512, 1024, 2048)
+_TOPK_BQS = (8, 16, 32, 64, 128, 256)
+_IVF_BQS = (8, 16, 32)
+_IVF_CAP_MULTIPLES = (32, 64, 128)
+_BATCH_HARD_ROWS = (8, 16, 32, 64, 128)
+_MASKING_ROWS = (64, 128, 256, 512, 1024)
+_WIRE_ROWS = (8, 16, 32, 64)
+
+
+def validate(op, config, shape, dtype=None):
+    """Is `config` legal for `op` at `shape`? The same law the kernel
+    wrappers enforce — used both to prune grids before compiling and to
+    reject a stale/foreign tuned row at resolve() time (a DB captured
+    against different constraints must degrade to the default, never
+    crash the dispatch)."""
+    try:
+        if op == "topk_fused":
+            b, n, d, k = shape
+            block, bq = int(config["block"]), int(config["bq"])
+            return (block % 128 == 0 and block >= 128 and k <= block
+                    and bq % 8 == 0 and 8 <= bq <= max(td.ceil_to(b, 8), 8))
+        if op == "ivf_topk":
+            bq = int(config["bq"])
+            mult = int(config.get("cap_multiple", td.IVF_CAP_MULTIPLE))
+            return bq % 8 == 0 and bq >= 8 and mult % 32 == 0 and mult >= 32
+        if op in ("batch_hard", "wire_unpack"):
+            rows = int(config["block_rows"])
+            return rows % 8 == 0 and rows >= 8
+        if op == "masking":
+            rows = int(config["block_rows"])
+            return rows % 8 == 0 and rows >= 8
+    except (KeyError, TypeError, ValueError):
+        return False
+    return False
+
+
+def candidates(op, shape, dtype, stats=None):
+    """The pruned candidate list for one (op, shape, dtype): every config is
+    legal (validate), fits the VMEM model, and is de-duplicated after the
+    shape-dependent clamps. The default config is always first.
+
+    `stats`, when a dict, receives the pruning ledger:
+    {"n_raw", "n_illegal", "n_vmem"} — what the static model rejected before
+    any compile, provenance the tuner persists alongside the winner."""
+    default = td.default_config(op, shape)
+    grid = []
+    if op == "topk_fused":
+        b, n, d, k = shape
+        n_pad_max = max(td.ceil_to(n, 128), 128)
+        for block in _TOPK_BLOCKS:
+            if block > n_pad_max * 2:
+                continue     # panels past ~2x the padded corpus only add pad
+            for bq in _TOPK_BQS:
+                if bq > td.ceil_to(b, 8):
+                    continue  # pure query padding
+                grid.append({"block": block, "bq": bq})
+    elif op == "ivf_topk":
+        for bq in _IVF_BQS:
+            for mult in _IVF_CAP_MULTIPLES:
+                grid.append({"bq": bq, "cap_multiple": mult})
+    elif op == "batch_hard":
+        b, d = shape
+        for rows in _BATCH_HARD_ROWS:
+            if rows > td.ceil_to(b, 8):
+                continue
+            grid.append({"block_rows": rows})
+    elif op == "masking":
+        b, f = shape
+        item = dtype_bytes(dtype)
+        # the wrapper clamps to its ~2 MB VMEM row budget; candidates past
+        # the clamp would all collapse onto it
+        vmem_rows = max(8, (2 << 20) // (item * max(f, 1)) // 8 * 8)
+        for rows in _MASKING_ROWS:
+            rows = min(rows, vmem_rows, max(td.ceil_to(b, 8), 8))
+            grid.append({"block_rows": rows})
+    elif op == "wire_unpack":
+        b, w = shape
+        for rows in _WIRE_ROWS:
+            if rows > td.ceil_to(b, 8):
+                continue
+            grid.append({"block_rows": rows})
+    else:
+        raise KeyError(f"no candidate space for op {op!r}")
+
+    out, seen = [], set()
+    n_illegal = n_vmem = 0
+    for cfg in [default] + grid:
+        key = tuple(sorted(cfg.items()))
+        if key in seen:
+            continue
+        seen.add(key)
+        if not validate(op, cfg, shape, dtype):
+            n_illegal += 1
+            continue
+        if vmem_footprint(op, cfg, shape, dtype) > VMEM_BUDGET_BYTES:
+            n_vmem += 1
+            continue
+        out.append(dict(cfg))
+    if stats is not None:
+        stats.update({"n_raw": len(seen), "n_illegal": n_illegal,
+                      "n_vmem": n_vmem})
+    return out
+
+
+# per-op parity discipline the search loop enforces before admission:
+#   "exact"      candidate output must be bitwise/tie-exact vs the oracle
+#                AND vs the default config's output
+#   "invariant"  the kernel's random stream is a function of the block grid
+#                (masking mixes pl.program_id into its PRNG seed), so
+#                cross-config outputs are legitimately different bits; the
+#                search checks seeded determinism + structural invariants
+#                instead, and only on real TPU hardware
+PARITY = {"topk_fused": "exact", "ivf_topk": "exact", "batch_hard": "exact",
+          "masking": "invariant", "wire_unpack": "exact"}
+
+
+def default_shapes(op):
+    """Representative (shape, dtype) tuning keys per op for the offline CLI
+    — serving-record and mined-training shapes, small enough that a full
+    sweep stays inside a modest --budget-s."""
+    if op == "topk_fused":
+        return [((8, 4096, 512, 10), "float32"),
+                ((8, 4096, 512, 10), "int8"),
+                ((64, 4096, 512, 10), "float32")]
+    if op == "ivf_topk":
+        return [((8, 64, 64, 512, 10, 8), "float32"),
+                ((8, 64, 64, 512, 10, 8), "int8")]
+    if op == "batch_hard":
+        return [((2048, 500), "float32"), ((8192, 500), "bfloat16")]
+    if op == "masking":
+        return [((2048, 10000), "float32")]
+    if op == "wire_unpack":
+        return [((1024, 25), "int32")]
+    raise KeyError(f"no default tuning shapes for op {op!r}")

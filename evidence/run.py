@@ -306,7 +306,22 @@ def _check_figures(stage, names):
 BENCH_TRAJECTORY_METRICS = ("serve_queries_per_sec",
                             "fit_pipelined_articles_per_sec",
                             "train_articles_per_sec",
-                            "fleet_qps")
+                            "fleet_qps",
+                            # r20: the shadow-sampling leg and both devprof
+                            # overhead-race legs are real throughputs — a
+                            # round that quietly slows them regressed even
+                            # if the overhead FRACTIONS still pass their
+                            # gates (the fraction only compares legs of the
+                            # same record)
+                            "fleet_qps_shadow",
+                            "profile_overhead_bare_aps",
+                            "profile_overhead_instrumented_aps",
+                            # r20 autotuner race: tuned-over-default speedup
+                            # per side; >=1.0 by construction, so a DROP
+                            # means the tuner stopped finding (or keeping)
+                            # its wins
+                            "serve_autotuned_speedup",
+                            "train_autotuned_speedup")
 # ISSUE 12: fleet latency/shed figures gate in the OPPOSITE direction — a
 # p99 or shed-rate that GROWS >tolerance vs the prior same-platform record is
 # the regression. Zero-valued bases (e.g. a 0.0 shed rate) never form a
@@ -336,6 +351,12 @@ PROFILE_OVERHEAD_MAX = 0.01
 # by at most this fraction. Tighter than tracing: the exact re-score rides
 # the scorer's own thread strictly after every primary reply resolves.
 SHADOW_OVERHEAD_MAX = 0.02
+# ISSUE 20: the measured tile-config autotuner must never ship a loss — the
+# default config is always candidate 0 of its own race and the winner is
+# the fenced best-of-N minimum, so tuned-over-default speedup < 1.0 is a
+# broken measurement, not a lost race. CPU records carry no figure (the
+# Pallas interpreter measures nothing real) and pass by absence.
+AUTOTUNED_SPEEDUP_MIN = 1.0
 
 
 def _bench_history():
@@ -490,6 +511,36 @@ def _shadow_overhead_gate():
         "fleet_qps", "fleet_qps_shadow", SHADOW_OVERHEAD_MAX,
         race_name="fleet_qps_shadow", bare_label="fleet_qps",
         loaded_label="fleet_qps_shadow (100% sampling)")
+
+
+def _autotuned_speedup_gate():
+    """(ok, detail): the latest bench record carrying the autotuner race
+    (ISSUE 20, `_bench_tuning`) must show `serve_autotuned_speedup` and
+    `train_autotuned_speedup` >= AUTOTUNED_SPEEDUP_MIN. The race's default
+    config is always candidate 0 and the winner is the measured minimum, so
+    a figure below 1.0 means the race itself is broken (unfenced timing,
+    compile pollution), not that the tuner merely failed to win — exactly
+    what this gate exists to make loud. CPU rounds emit no figure and pass
+    by absence (the interpreter measures nothing real); the
+    bitwise-parity-before-admission half of the contract is pinned by
+    tests/test_tuning.py."""
+    hist = _bench_history()
+    for name, extra in reversed(hist):
+        figures = {m: extra[m] for m in ("serve_autotuned_speedup",
+                                         "train_autotuned_speedup")
+                   if isinstance(extra.get(m), (int, float))}
+        if not figures:
+            continue
+        bad = {m: v for m, v in figures.items()
+               if v < AUTOTUNED_SPEEDUP_MIN}
+        shown = ", ".join(f"{m} {v}" for m, v in sorted(figures.items()))
+        if bad:
+            return False, (f"{name}: {shown} — autotuned speedup below "
+                           f"{AUTOTUNED_SPEEDUP_MIN} means the measured race "
+                           "is broken (default is always a candidate)")
+        return True, f"{name}: {shown} >= {AUTOTUNED_SPEEDUP_MIN}"
+    return True, ("no bench record carries the autotuner race yet — "
+                  "pass by absence, not by measurement")
 
 
 def main(argv=None):
@@ -1149,6 +1200,12 @@ def main(argv=None):
     # above (_overhead_race_gate).
     shadow_ok, shadow_detail = _shadow_overhead_gate()
     check("shadow_overhead_lt_2pct", shadow_ok, shadow_detail)
+    # ISSUE 20: the measured autotuner race (bench _bench_tuning) must show
+    # tuned-over-default >= 1.0 on any record that carries it — below 1.0
+    # the race's own measurement discipline is broken (the default always
+    # races). CPU histories pass by absence.
+    tuned_ok, tuned_detail = _autotuned_speedup_gate()
+    check("autotuned_speedup_ge_1", tuned_ok, tuned_detail)
     check("user_category_top1", user["category_top1_accuracy"] > 0.6,
           f"interest-category top-1 {user['category_top1_accuracy']:.4f} > 0.6 "
           "(chance ~1/8; scored against 5-candidate category means — one "

@@ -1,0 +1,39 @@
+"""Planted R2 violation: an autotuner candidate race timing each config
+with a bare perf_counter pair — no fence, no warmup, so the "winner" is
+whichever candidate's dispatch returned fastest (plus whoever paid the
+compile), not the fastest kernel.
+
+Named r2_tuning_* so it falls inside R2's tuning scope (the real search
+loop, dae_rnn_news_recommendation_tpu/tuning/search.py, lives by the same
+law). The clean twin routes each candidate through `devprof.measure`, which
+R2 knows is a fence: every timed iteration ends with a `device_fence` on
+the call's result, and warmup absorbs the per-config compile.
+"""
+
+import time
+
+from dae_rnn_news_recommendation_tpu.telemetry import devprof
+
+
+def race_wrong(make_fn, candidates):
+    # each candidate's first call compiles inside the timed region and the
+    # clock reads before the device finishes: dispatch time, not kernel time
+    best, best_dt = None, None
+    for cfg in candidates:
+        fn = make_fn(cfg)
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0  # planted: R2
+        if best_dt is None or dt < best_dt:
+            best, best_dt = cfg, dt
+    return best, best_dt
+
+
+def race_right(make_fn, candidates):
+    # the fenced best-of-N timer per candidate IS the fence for this region
+    t0 = time.perf_counter()
+    results = [(cfg, devprof.measure(make_fn(cfg), n=3, warmup=1))
+               for cfg in candidates]
+    host_total = time.perf_counter() - t0
+    best, result = min(results, key=lambda cr: cr[1].best_ms)
+    return best, result, host_total

@@ -221,3 +221,29 @@ def test_profile_overhead_gate_reads_latest_race(evrun, monkeypatch):
     ])
     ok, detail = evrun._profile_overhead_gate()
     assert not ok and detail.startswith("new:") and "5.00%" in detail
+
+
+def test_autotuned_speedup_gate_latest_race(evrun, monkeypatch):
+    """ISSUE 20: the autotuner race gates >= 1.0 on the LATEST record
+    carrying a speedup figure; CPU-only histories (no figure) pass by
+    absence, and a figure below 1.0 fails — the default always races, so
+    sub-1.0 means the measurement itself broke."""
+    monkeypatch.setattr(evrun, "_bench_history",
+                        lambda: [("r1", {"platform": "cpu"})])
+    ok, detail = evrun._autotuned_speedup_gate()
+    assert ok and "pass by absence" in detail
+
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [
+        ("old", {"serve_autotuned_speedup": 0.5}),   # superseded: ignored
+        ("new", {"serve_autotuned_speedup": 1.07,
+                 "train_autotuned_speedup": 1.0}),
+    ])
+    ok, detail = evrun._autotuned_speedup_gate()
+    assert ok and detail.startswith("new:")
+
+    monkeypatch.setattr(evrun, "_bench_history", lambda: [
+        ("bad", {"serve_autotuned_speedup": 0.93,
+                 "train_autotuned_speedup": 1.2}),
+    ])
+    ok, detail = evrun._autotuned_speedup_gate()
+    assert not ok and "0.93" in detail

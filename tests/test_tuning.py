@@ -1,0 +1,452 @@
+"""Measured Pallas autotuner contracts (ISSUE 20): legality pruning before
+any compile, bitwise-parity-gated admission (re-verified independently here,
+not just trusted from the tuner's own bookkeeping), schema-additive
+ProfileDB persistence, resolve() provenance and fallbacks, the CLI, and the
+zero-post-warm-recompile regression with tuning enabled.
+
+Everything runs on the CPU Pallas interpreter (interpret=True), which is a
+parity instrument, not a timing instrument — the admission logic under test
+is identical on hardware; only the recorded milliseconds are synthetic.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dae_rnn_news_recommendation_tpu import tuning
+from dae_rnn_news_recommendation_tpu.analysis.runtime import compile_guard
+from dae_rnn_news_recommendation_tpu.ops import tile_defaults as td
+from dae_rnn_news_recommendation_tpu.telemetry.profile_db import (ProfileDB,
+                                                                  row_key)
+from dae_rnn_news_recommendation_tpu.tuning import space
+from dae_rnn_news_recommendation_tpu.tuning import search as tsearch
+from dae_rnn_news_recommendation_tpu.tuning.search import tune_op
+
+TOPK_SHAPE = (8, 256, 8, 3)          # (B, N, D, k) — tiny but panel-real
+BATCH_HARD_SHAPE = (32, 8)
+IVF_SHAPE = (4, 8, 64, 8, 3, 2)      # (B, C, cap, D, k, probes)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_tuning(tmp_path):
+    """Every test starts from a fresh resolution state pointed at an empty
+    DB path — never the committed repo ProfileDB — and leaves no state for
+    the next test file."""
+    tuning.reset()
+    tuning.configure(enabled=True, db_path=str(tmp_path / "tuning_db.json"))
+    yield
+    tuning.reset()
+
+
+# -------------------------------------------------------------- candidates
+
+def test_candidate_space_prunes_before_any_compile():
+    """The static pruner rejects misaligned and VMEM-overflowing configs up
+    front (stats say how many), always emits the hand-picked default FIRST,
+    and never emits a duplicate or an illegal survivor."""
+    stats = {}
+    cands = space.candidates("topk_fused", (64, 8192, 512, 10), "float32",
+                             stats=stats)
+    assert cands[0] == td.default_config("topk_fused", (64, 8192, 512, 10))
+    assert len({tuple(sorted(c.items())) for c in cands}) == len(cands)
+    for c in cands:
+        assert space.validate("topk_fused", c, (64, 8192, 512, 10),
+                              "float32")
+        assert space.vmem_footprint("topk_fused", c, (64, 8192, 512, 10),
+                                    "float32") <= space.VMEM_BUDGET_BYTES
+    assert stats["n_raw"] == (len(cands) + stats["n_illegal"]
+                              + stats["n_vmem"])
+
+
+def test_vmem_budget_actually_prunes():
+    """A huge key must lose candidates to the VMEM model — if nothing is
+    ever pruned the footprint model is dead code."""
+    stats = {}
+    space.candidates("topk_fused", (256, 65536, 2048, 10), "float32",
+                     stats=stats)
+    assert stats["n_vmem"] > 0
+
+
+# ---------------------------------------------- parity-gated admission
+
+def _reverify(op, shape, dtype, row, *, seed=0):
+    """Re-run every candidate the tuner ADMITTED against the rebuilt
+    problem's oracle and the default config's outputs — independent
+    re-verification of the acceptance bar (admitted == output-identical)."""
+    prob = tsearch._PROBLEMS[op](tuple(shape), dtype, seed, True)
+    default_out = None
+    for rep in row["tuner"]["candidates"]:
+        if not rep["admitted"]:
+            assert rep["reject"], rep
+            continue
+        out = jax.device_get(prob["make_fn"](rep["config"])())
+        if default_out is None:           # candidate 0 is always the default
+            default_out = out
+        assert prob["compare"](out, default_out), rep["config"]
+        if prob["oracle"] is not None:
+            assert prob["compare"](out, prob["oracle"]), rep["config"]
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_topk_admitted_candidates_are_bitwise_exact(dtype):
+    row = tune_op("topk_fused", TOPK_SHAPE, dtype, n=2, warmup=1,
+                  interpret=True)
+    t = row["tuner"]
+    assert t["admitted"] and t["parity"] == "exact"
+    assert t["candidates"][0]["admitted"]          # the default always races
+    assert t["candidates"][0]["config"] == t["default_config"]
+    assert t["speedup_vs_default"] >= 1.0          # winner = measured min
+    assert space.validate("topk_fused", row["config"], TOPK_SHAPE, dtype)
+    _reverify("topk_fused", TOPK_SHAPE, dtype, row)
+
+
+@pytest.mark.parametrize("op,shape,dtype", [
+    ("batch_hard", BATCH_HARD_SHAPE, "float32"),
+    ("batch_hard", BATCH_HARD_SHAPE, "bfloat16"),
+    ("ivf_topk", IVF_SHAPE, "float32"),
+    ("ivf_topk", IVF_SHAPE, "int8"),
+    ("wire_unpack", (16, 25), "int32"),
+])
+def test_admitted_candidates_are_output_identical(op, shape, dtype):
+    row = tune_op(op, shape, dtype, n=2, warmup=1, interpret=True)
+    t = row["tuner"]
+    assert t["admitted"]
+    assert t["candidates"][0]["admitted"]
+    assert t["speedup_vs_default"] >= 1.0
+    key_shape = tuple(int(s) for s in row["shape"].split("x"))
+    _reverify(op, key_shape, dtype, row)
+
+
+def test_batch_hard_foreign_blocks_reject_not_admit_wrong():
+    """block_rows changes f32 summation order, so a differing block either
+    produces the same bytes or is REJECTED on parity — it can never be
+    admitted with different outputs (checked via _reverify above; here we
+    pin that the race actually tried a non-default block)."""
+    row = tune_op("batch_hard", BATCH_HARD_SHAPE, "float32", n=2, warmup=1,
+                  interpret=True)
+    tried = {rep["config"]["block_rows"]
+             for rep in row["tuner"]["candidates"]}
+    assert len(tried) > 1, "grid degenerated to the default only"
+    for rep in row["tuner"]["candidates"]:
+        assert rep["admitted"] or rep["reject"]
+
+
+def test_masking_interpret_capture_is_refused():
+    """The masking kernel's PRNG is stubbed in the interpreter, so an
+    off-TPU 'capture' would admit configs on fake bytes — tune_op refuses
+    and returns None instead of recording."""
+    notes = []
+    row = tune_op("masking", (8, 16), "float32", interpret=True,
+                  log=notes.append)
+    assert row is None
+    assert any("masking" in n for n in notes)
+
+
+def test_wire_unpack_key_shape_is_the_real_wire_layout():
+    """The recorded key uses the spec's actual words_per_row (the shape a
+    serving unpack resolves under), not the requested synthetic guess."""
+    row = tune_op("wire_unpack", (16, 8), "int32", n=2, warmup=1,
+                  interpret=True)
+    words = int(row["shape"].split("x")[1])
+    assert row["shape"].startswith("16x")
+    assert words >= 8 and words % 8 == 0
+
+
+# ------------------------------------------------------------- persistence
+
+def test_db_round_trips_old_rows_unchanged(tmp_path):
+    """Schema-additive: a pre-r20 plain measurement row (no config/tuner)
+    survives record/save/load byte-identically next to a tuned row, and
+    resolve() treats it as a miss, not an error."""
+    path = str(tmp_path / "db.json")
+    old = {"op": "topk_fused", "shape": "8x256x8x3", "dtype": "float32",
+           "device_kind": "cpu", "best_ms": 0.5, "median_ms": 0.6,
+           "n": 5, "n_clean": 5}
+    db = ProfileDB(path)
+    db.record(dict(old))
+    db.save()
+    row = tune_op("topk_fused", TOPK_SHAPE, "bfloat16", db=ProfileDB(path),
+                  n=2, warmup=1, interpret=True)
+    reloaded = ProfileDB(path)
+    back = reloaded._rows[row_key("topk_fused", "8x256x8x3", "float32",
+                                  "cpu")]
+    assert back == old
+    assert len(reloaded) == 2
+
+    tuning.configure(db_path=path)
+    cfg, prov = tuning.resolve("topk_fused", TOPK_SHAPE, "float32",
+                               device_kind="cpu")
+    assert prov == "default"
+    assert cfg == td.default_config("topk_fused", TOPK_SHAPE)
+    cfg, prov = tuning.resolve("topk_fused", TOPK_SHAPE, "bfloat16",
+                               device_kind=row["device_kind"])
+    assert prov == "tuned" and cfg == row["config"]
+
+
+def test_corrupt_db_degrades_to_defaults_with_a_warning(tmp_path):
+    path = tmp_path / "broken.json"
+    path.write_text("{not json")
+    tuning.configure(db_path=str(path))
+    with pytest.warns(RuntimeWarning, match="fall back to defaults"):
+        cfg, prov = tuning.resolve("topk_fused", TOPK_SHAPE, "float32")
+    assert prov == "default"
+
+
+# ----------------------------------------------------------------- resolve
+
+def _plant_row(path, *, op="topk_fused", shape="8x256x8x3",
+               dtype="float32", device_kind="cpu",
+               config=None, tuner=None):
+    db = ProfileDB(str(path))
+    row = {"op": op, "shape": shape, "dtype": dtype,
+           "device_kind": device_kind, "best_ms": 0.1,
+           "config": config if config is not None
+           else {"block": 256, "bq": 8},
+           "tuner": tuner if tuner is not None else {"admitted": True}}
+    db.record(row)
+    db.save()
+    return row
+
+
+def test_resolve_hit_miss_and_resolution_log(tmp_path):
+    path = tmp_path / "db.json"
+    planted = _plant_row(path)
+    tuning.configure(db_path=str(path))
+    assert tuning.prime() == 1
+
+    cfg, prov = tuning.resolve("topk_fused", TOPK_SHAPE, "float32",
+                               device_kind="cpu")
+    assert (cfg, prov) == (planted["config"], "tuned")
+    # memoized: same key resolves from the cache to the identical answer
+    assert tuning.resolve("topk_fused", TOPK_SHAPE, "float32",
+                          device_kind="cpu") == (cfg, prov)
+    # miss: a foreign shape falls back to the hand-picked default
+    miss_shape = (8, 512, 8, 3)
+    cfg2, prov2 = tuning.resolve("topk_fused", miss_shape, "float32",
+                                 device_kind="cpu")
+    assert prov2 == "default"
+    assert cfg2 == td.default_config("topk_fused", miss_shape)
+
+    recs = tuning.resolutions()
+    assert [r["provenance"] for r in recs] == ["tuned", "default"]
+    man = tuning.resolution_manifest()
+    assert man["enabled"] is True
+    assert (man["n_tuned"], man["n_default"]) == (1, 1)
+    assert man["db_path"] == str(path)
+
+
+def test_resolve_rejects_stale_and_interpret_rows(tmp_path):
+    # an illegal tuned config (fails today's legality laws) degrades to
+    # the default instead of dispatching a misaligned tile
+    stale = tmp_path / "stale.json"
+    _plant_row(stale, config={"block": 100, "bq": 8})
+    tuning.configure(db_path=str(stale))
+    cfg, prov = tuning.resolve("topk_fused", TOPK_SHAPE, "float32",
+                               device_kind="cpu")
+    assert prov == "default"
+
+    # an interpreter capture must never drive a real TPU dispatch...
+    interp = tmp_path / "interp.json"
+    _plant_row(interp, device_kind="TPU v4",
+               tuner={"admitted": True, "interpret": True})
+    tuning.configure(db_path=str(interp))
+    cfg, prov = tuning.resolve("topk_fused", TOPK_SHAPE, "float32",
+                               device_kind="TPU v4")
+    assert prov == "default"
+    # ...but the same row is an honest hit on the host kind it ran on
+    host = tmp_path / "host.json"
+    _plant_row(host, device_kind="cpu",
+               tuner={"admitted": True, "interpret": True})
+    tuning.configure(db_path=str(host))
+    cfg, prov = tuning.resolve("topk_fused", TOPK_SHAPE, "float32",
+                               device_kind="cpu")
+    assert prov == "tuned"
+
+
+def test_tuning_off_switch_forces_defaults(tmp_path):
+    path = tmp_path / "db.json"
+    planted = _plant_row(path)
+    tuning.configure(enabled=False, db_path=str(path))
+    cfg, prov = tuning.resolve("topk_fused", TOPK_SHAPE, "float32",
+                               device_kind="cpu")
+    assert prov == "default"
+    assert tuning.resolution_manifest()["enabled"] is False
+    tuning.configure(enabled=True)
+    cfg, prov = tuning.resolve("topk_fused", TOPK_SHAPE, "float32",
+                               device_kind="cpu")
+    assert (cfg, prov) == (planted["config"], "tuned")
+
+
+def test_cap_multiple_hint_votes_admitted_rows_only(tmp_path):
+    path = tmp_path / "db.json"
+    db = ProfileDB(str(path))
+    base = {"op": "ivf_topk", "dtype": "float32", "device_kind": "cpu",
+            "best_ms": 0.1}
+    db.record({**base, "shape": "4x8x64x8x3x2",
+               "config": {"bq": 16, "cap_multiple": 64},
+               "tuner": {"admitted": True}})
+    # the alias row echoes the winner at the new layout cap — not a vote
+    db.record({**base, "shape": "4x8x128x8x3x2",
+               "config": {"bq": 16, "cap_multiple": 64},
+               "tuner": {"admitted": True, "alias_of": "4x8x64x8x3x2"}})
+    # a plain r18 measurement row is not a vote either
+    db.record({**base, "shape": "4x8x32x8x3x2"})
+    db.save()
+    tuning.configure(db_path=str(path))
+    assert tuning.cap_multiple_hint(device_kind="cpu") == 64
+    assert tuning.cap_multiple_hint(device_kind="TPU v4") \
+        == td.IVF_CAP_MULTIPLE
+    ops = {r["op"] for r in tuning.resolutions()}
+    assert "ivf_layout" in ops
+
+
+# --------------------------------------------------- zero post-warm compiles
+
+def test_kernel_dispatch_resolves_without_retrace(tmp_path):
+    """Two jit calls at the same key: resolve() feeds the second call the
+    SAME memoized config, so the warm cache hits and compile_guard sees
+    zero new compiles — the r09/r19 contract with tuning enabled."""
+    from dae_rnn_news_recommendation_tpu.ops.topk_fused import topk_fused
+
+    b, n, d, k = TOPK_SHAPE
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, d)).astype(np.float32))
+    emb = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+    valid = jnp.ones((n,), jnp.float32)
+    fn = jax.jit(lambda a, e, v: topk_fused(a, e, v, k, impl="pallas",
+                                            interpret=True))
+    jax.block_until_ready(fn(q, emb, valid))      # warm: pays the compile
+    with compile_guard() as guard:
+        jax.block_until_ready(fn(q, emb, valid))
+    assert guard.count == 0, guard.entries
+
+
+@pytest.mark.slow
+def test_service_zero_post_warm_compiles_with_tuning_enabled(tmp_path):
+    """Service-level regression: with tuning ON (resolving through an
+    actually-tuned DB row for the serving corpus shape), warmup() still
+    pre-compiles everything a burst needs — zero post-warm compiles."""
+    from dae_rnn_news_recommendation_tpu.models.dae_core import (DAEConfig,
+                                                                 init_params)
+    from dae_rnn_news_recommendation_tpu.serve import (RecommendationService,
+                                                       ServingCorpus)
+
+    n_art, n_feat, n_dim = 64, 24, 8
+    config = DAEConfig(n_features=n_feat, n_components=n_dim,
+                       triplet_strategy="none", corr_frac=0.0)
+    params = init_params(jax.random.PRNGKey(3), config)
+    articles = np.random.default_rng(3).random((n_art, n_feat),
+                                               dtype=np.float32)
+    corpus = ServingCorpus(config, block=16)
+    corpus.swap(params, articles, note="initial")
+    svc = RecommendationService(params, config, corpus, top_k=5,
+                                max_batch=8, max_inflight=64)
+    svc.warmup()
+    try:
+        with compile_guard() as guard:
+            futs = [svc.submit(articles[i % n_art], deadline_s=10.0)
+                    for i in range(10)]
+            assert all(f.result(timeout=10.0).ok for f in futs)
+        assert guard.count == 0, guard.entries
+        assert svc.summary()["tuning"]["enabled"] is True
+    finally:
+        svc.stop()
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_cli_tune_show_clear_round_trip(tmp_path, capsys):
+    from dae_rnn_news_recommendation_tpu.tuning.__main__ import main
+
+    db = str(tmp_path / "db.json")
+    rc = main(["tune", "--select", "wire_unpack", "--shape", "16x8",
+               "--dtype", "int32", "--db", db, "--n", "2", "--warmup", "1",
+               "--interpret"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "recorded 1 tuned row(s)" in out
+    assert "wire_unpack" in out
+
+    rc = main(["show", "--db", db])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "kernel autotuner: 1 tuned rows" in out
+    assert "interpreter captures" in out
+
+    rc = main(["clear", "--select", "wire_unpack", "--db", db])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "dropped 1 tuned row(s)" in out
+    assert len(ProfileDB(db)) == 0
+
+
+def test_cli_shape_requires_single_op():
+    from dae_rnn_news_recommendation_tpu.tuning.__main__ import main
+
+    with pytest.raises(SystemExit):
+        main(["tune", "--shape", "16x8"])
+
+
+# ------------------------------------------------------------ report flag
+
+def test_report_tuning_sentinel_contract(tmp_path, capsys):
+    """--tuning matches the --fleet/--profile/--quality sentinel contract:
+    omitted flag auto-detects silently, bare flag without a DB degrades to
+    a note (exit 0), explicit/auto-detected DB renders the section and the
+    JSON report carries the key."""
+    from dae_rnn_news_recommendation_tpu.telemetry.__main__ import \
+        main as cli_main
+
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps({"traceEvents": [
+        {"name": "fit/epoch", "ph": "X", "ts": 0, "dur": 1000,
+         "pid": 1, "tid": 1}]}))
+    rc = cli_main(["report", str(trace)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "kernel autotuner" not in out
+    assert "tuning DB unavailable" not in out       # silent when not asked
+
+    rc = cli_main(["report", str(trace), "--tuning"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tuning DB unavailable" in out
+    assert "kernel autotuner" not in out
+
+    # a DB next to the trace is picked up with NO flag at all — and plain
+    # r18 measurement rows alone do NOT fabricate a tuning section
+    db = ProfileDB(str(tmp_path / "profile_db.json"))
+    db.record({"op": "train/step", "shape": "800x10000", "dtype": "bfloat16",
+               "device_kind": "cpu", "best_ms": 3.0})
+    db.save()
+    rc = cli_main(["report", str(trace)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "kernel autotuner" not in out
+
+    db.record({"op": "topk_fused", "shape": "8x4096x512x10",
+               "dtype": "float32", "device_kind": "TPU v4", "best_ms": 0.21,
+               "config": {"block": 1024, "bq": 16},
+               "tuner": {"admitted": True, "parity": "exact",
+                         "default_config": {"block": 512, "bq": 8},
+                         "default_best_ms": 0.25,
+                         "speedup_vs_default": 1.19, "n_candidates": 12,
+                         "n_rejected": 1, "n_pruned_illegal": 3,
+                         "n_pruned_vmem": 2}})
+    db.save()
+    rc = cli_main(["report", str(trace)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "kernel autotuner: 1 tuned rows" in out
+    assert "block=1024,bq=16" in out
+    assert "x1.190" in out
+
+    rc = cli_main(["report", str(trace), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload["tuning"]["n_rows"] == 1
+    assert payload["tuning"]["rows"][0]["op"] == "topk_fused"
